@@ -1,0 +1,30 @@
+"""analytics_zoo_tpu — a TPU-native analytics + AI framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Analytics Zoo
+(reference: /root/reference, a Scala/Spark/BigDL system). Where the
+reference runs distributed deep learning as Spark jobs with a
+block-manager all-reduce (reference docs/docs/wp-bigdl.md:148-164), this
+framework compiles models to single SPMD XLA programs over a
+``jax.sharding.Mesh`` and all-reduces gradients with ``jax.lax.psum``
+over ICI.
+
+Public surface (mirrors the reference's pyzoo package layout,
+pyzoo/zoo/__init__.py):
+
+- ``analytics_zoo_tpu.init_zoo_context`` — engine init (reference
+  ``init_nncontext``, pyzoo/zoo/common/nncontext.py:104)
+- ``analytics_zoo_tpu.pipeline.api.keras`` — Keras-1-style model API
+- ``analytics_zoo_tpu.pipeline.api.autograd`` — Variable/CustomLoss
+- ``analytics_zoo_tpu.feature`` — FeatureSet data layer
+- ``analytics_zoo_tpu.models`` — built-in model zoo
+- ``analytics_zoo_tpu.pipeline.estimator`` — Estimator training API
+- ``analytics_zoo_tpu.pipeline.inference`` — pooled InferenceModel
+"""
+
+__version__ = "0.1.0"
+
+from analytics_zoo_tpu.common.engine import (  # noqa: F401
+    ZooContext,
+    get_zoo_context,
+    init_zoo_context,
+)

@@ -1,0 +1,16 @@
+from analytics_zoo_tpu.common.engine import (  # noqa: F401
+    ZooContext,
+    get_zoo_context,
+    init_zoo_context,
+)
+from analytics_zoo_tpu.common.triggers import (  # noqa: F401
+    And,
+    EveryEpoch,
+    MaxEpoch,
+    MaxIteration,
+    MaxScore,
+    MinLoss,
+    Or,
+    SeveralIteration,
+    ZooTrigger,
+)

@@ -1,0 +1,190 @@
+"""Engine & context runtime — the TPU-native equivalent of the reference's
+``NNContext`` layer (reference zoo/.../common/NNContext.scala:133-149 creates a
+SparkContext + BigDL ``Engine.init``; pyzoo/zoo/common/nncontext.py:104-124 is
+the Python twin).
+
+Instead of a SparkContext over a cluster, the runtime here owns a
+``jax.sharding.Mesh`` over the TPU slice.  Mesh axes are first-class: ``data``
+(DP — the reference's only strategy), plus ``model`` (TP), ``seq`` (SP/CP) and
+``expert`` (EP) axes the reference never had (SURVEY.md §2.4).  Everything that
+trains or predicts asks this module for the current mesh; tests force an
+8-device CPU mesh via ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(the analogue of the reference's local[4] Spark testing trick, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+import threading
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+# Canonical mesh-axis names, ordered outermost-first.  DCN-crossing axes
+# (multi-slice data parallelism) must come first so that XLA lays collectives
+# on ICI for the inner axes.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass
+class ZooContext:
+    """Runtime context: the device mesh plus engine-level knobs.
+
+    The reference's ``NNContext.initNNContext`` returns a SparkContext after
+    tuning executor env (KMP_AFFINITY / OMP_NUM_THREADS,
+    NNContext.scala:209-237).  The TPU equivalent owns the mesh and global
+    numerics policy instead.
+    """
+
+    mesh: Mesh
+    platform: str
+    seed: int = 0
+    # matmul/conv accumulation dtype policy; bfloat16 keeps the MXU fed.
+    compute_dtype: object = None
+    _step_rng: jax.Array | None = None
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.mesh.shape.get(DATA_AXIS, 1)
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape.get(axis, 1)
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding on this context's mesh for a PartitionSpec."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, ndim: int) -> NamedSharding:
+        """Shard the leading (batch) dim over the data axis, replicate rest."""
+        return NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+    def shard_batch(self, tree):
+        """Device-put a host batch pytree sharded over the data axis.
+
+        This is the per-chip host infeed replacing the reference's
+        RDD-partition → task iterator feed (FeatureSet.scala:240-289).
+        """
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), self.batch_sharding(np.ndim(x))),
+            tree,
+        )
+
+    def next_rng(self) -> jax.Array:
+        if self._step_rng is None:
+            self._step_rng = jax.random.PRNGKey(self.seed)
+        self._step_rng, out = jax.random.split(self._step_rng)
+        return out
+
+
+_LOCK = threading.Lock()
+_CONTEXT: ZooContext | None = None
+
+
+def _infer_mesh_shape(
+    devices: Sequence, axes: Sequence[str], shape: Mapping[str, int] | None
+) -> dict[str, int]:
+    n = len(devices)
+    if shape is None:
+        # Default: pure data parallelism — the reference's only inter-node
+        # strategy (SURVEY.md §2.4) and the right default for dense training.
+        return {a: (n if a == DATA_AXIS else 1) for a in axes}
+    out = dict(shape)
+    unknown = [a for a in axes if a not in out]
+    given = math.prod(out.values())
+    if n % given != 0:
+        raise ValueError(
+            f"mesh shape {out} does not divide device count {n}"
+        )
+    rest = n // given
+    for a in unknown:
+        out[a] = 1
+    # Fold leftover devices into the data axis.
+    if rest != 1:
+        out[DATA_AXIS] = out.get(DATA_AXIS, 1) * rest
+    return {a: out[a] for a in axes}
+
+
+def init_zoo_context(
+    conf: Mapping[str, object] | str | None = None,
+    *,
+    mesh_shape: Mapping[str, int] | None = None,
+    mesh_axes: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+    seed: int = 0,
+    platform: str | None = None,
+) -> ZooContext:
+    """Initialise (or re-initialise) the global runtime context.
+
+    Mirrors ``init_nncontext`` (reference pyzoo/zoo/common/nncontext.py:104):
+    the reference builds a SparkContext with a tuned conf; here we discover
+    devices, build a Mesh, and fix numerics policy.
+
+    Args:
+      conf: optional dict (or app-name string, accepted for API fidelity with
+        ``init_nncontext("app name")``) of engine options: ``seed``,
+        ``mesh_shape``, ``platform``.
+      mesh_shape: e.g. ``{"data": 8}`` or ``{"data": 4, "model": 2}``; missing
+        axes get size 1 and leftover devices fold into ``data``.
+      mesh_axes: axis names, outermost first.
+      platform: force a jax platform ("cpu", "tpu"); tests use cpu meshes.
+    """
+    global _CONTEXT
+    if isinstance(conf, str):
+        conf = {"app_name": conf}
+    conf = dict(conf or {})
+    seed = int(conf.get("seed", seed))
+    mesh_shape = conf.get("mesh_shape", mesh_shape)
+    platform = conf.get("platform", platform)
+
+    devices = jax.devices(platform) if platform else jax.devices()
+    axes = tuple(mesh_axes)
+    shape = _infer_mesh_shape(devices, axes, mesh_shape)
+    n_used = math.prod(shape.values())
+    dev_array = np.asarray(devices[:n_used]).reshape([shape[a] for a in axes])
+    mesh = Mesh(dev_array, axes)
+    ctx = ZooContext(
+        mesh=mesh, platform=devices[0].platform, seed=seed
+    )
+    with _LOCK:
+        _CONTEXT = ctx
+    logger.info(
+        "init_zoo_context: %d %s device(s), mesh %s",
+        len(devices), ctx.platform, dict(mesh.shape),
+    )
+    return ctx
+
+
+def get_zoo_context() -> ZooContext:
+    """Current context, creating a default (all-devices DP mesh) on demand.
+
+    Matches the reference's lazy ``getOrCreateSparkContext``
+    (pyzoo/zoo/common/nncontext.py:127-135).
+    """
+    global _CONTEXT
+    with _LOCK:
+        if _CONTEXT is None:
+            pass  # created below outside the lock (init takes the lock)
+        else:
+            return _CONTEXT
+    return init_zoo_context()
+
+
+def num_devices() -> int:
+    return get_zoo_context().num_devices

@@ -1,0 +1,55 @@
+"""Small shared utilities: named timers, shape helpers, pytree helpers.
+
+``time_it`` mirrors the reference's lightweight tracing
+(``Utils.timeIt`` zoo/.../common/Utils.scala:40, used around TF session calls
+at TFNet.scala:176) — elapsed time per named block, logged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def time_it(name: str, log: bool = False):
+    """Time a block; accumulate under ``name`` (Utils.scala:40 equivalent)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _TIMINGS[name].append(dt)
+        if log:
+            logger.info("[%s] %.3f ms", name, dt * 1e3)
+
+
+def get_timings() -> dict[str, list[float]]:
+    return dict(_TIMINGS)
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()
+
+
+def to_tuple_shape(shape) -> tuple:
+    """Normalize a shape argument to a tuple of ints/None."""
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def canonicalize_axis(axis: int, ndim: int) -> int:
+    if axis < 0:
+        axis += ndim
+    if not 0 <= axis < ndim:
+        raise ValueError(f"axis {axis} out of range for ndim {ndim}")
+    return axis

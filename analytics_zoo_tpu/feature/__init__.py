@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.feature.common import (  # noqa: F401
+    ChainedPreprocessing,
+    Preprocessing,
+)
+from analytics_zoo_tpu.feature.dataset import FeatureSet  # noqa: F401

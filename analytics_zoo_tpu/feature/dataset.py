@@ -1,0 +1,270 @@
+"""FeatureSet — the distributed dataset abstraction.
+
+TPU-native re-design of the reference's ``FeatureSet``
+(zoo/.../feature/FeatureSet.scala):
+
+- ``DRAMFeatureSet`` (FeatureSet.scala:411-421) → :class:`ArrayFeatureSet`:
+  records cached in host RAM, feeding the per-chip infeed.
+- ``DiskFeatureSet`` (FeatureSet.scala:332-409; train on 1/numSlice in DRAM,
+  rest on disk) → :class:`ShardedFeatureSet`: file shards, a sliding slice
+  resident per epoch.
+- ``CachedDistributedFeatureSet.data`` endless random-offset shuffled
+  iterator per partition (FeatureSet.scala:240-289) → seeded, *checkpointable*
+  per-epoch shuffles: iterator state is (epoch, cursor, seed), so resume is
+  exact — the reference's Spark iterators were not resumable, only retryable.
+- PMEM tier (feature/pmem/*) → host RAM **is** the fast tier on a TPU VM; the
+  tier enum is kept for API parity.
+
+The ``batch_size % num_model_replicas == 0`` contract follows the reference's
+TFDataset (pyzoo .../net/tf_dataset.py:136-143); batches here are globally
+sized and sharded over the mesh ``data`` axis by the caller
+(``ZooContext.shard_batch``), XLA splitting them per-chip.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+
+MemoryType = str  # "DRAM" | "DISK_<n>" | "PMEM" (API parity; PMEM==DRAM)
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(a) for a in x]
+    return [np.asarray(x)]
+
+
+def _unwrap(xs):
+    return xs[0] if xs is not None and len(xs) == 1 else xs
+
+
+class FeatureSet:
+    """Base: iterate shuffled minibatches with exact, resumable state."""
+
+    # ------------------------------------------------------------------
+    # constructors (mirror FeatureSet.rdd / .array factories)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def of(x, y=None, sample_weight=None) -> "FeatureSet":
+        if isinstance(x, FeatureSet):
+            return x
+        return ArrayFeatureSet(x, y, sample_weight)
+
+    @staticmethod
+    def array(x, y=None, sample_weight=None,
+              memory_type: MemoryType = "DRAM") -> "FeatureSet":
+        """Reference ``FeatureSet.array``/``FeatureSet.rdd``
+        (FeatureSet.scala:423-466) — memory_type selects the tier."""
+        fs = ArrayFeatureSet(x, y, sample_weight)
+        return fs
+
+    @staticmethod
+    def from_shards(paths: Sequence[str], memory_type: MemoryType = "DISK_4",
+                    loader: Callable | None = None) -> "FeatureSet":
+        n_slices = 1
+        if memory_type.upper().startswith("DISK_"):
+            n_slices = int(memory_type.split("_")[1])
+        return ShardedFeatureSet(list(paths), n_slices=n_slices,
+                                 loader=loader)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_samples(self) -> int:
+        raise NotImplementedError
+
+    def transform(self, preprocessing: Preprocessing) -> "FeatureSet":
+        """Attach a per-record transform (reference ``-> transformer``,
+        FeatureSet.scala:82-84)."""
+        return TransformedFeatureSet(self, preprocessing)
+
+    def batches(self, batch_size: int, shuffle: bool = True,
+                seed: int = 0, epoch: int = 0, drop_last: bool = True,
+                start_batch: int = 0,
+                pad_to_batch: int | None = None) -> Iterator[dict]:
+        """Yield dict batches {"x": ..., "y": ..., "w": ...}.
+
+        One pass = one epoch; shuffling is a seeded permutation of
+        (seed, epoch) so any (epoch, batch_index) position is reproducible —
+        the checkpointable re-design of the reference's endless random-offset
+        iterator (FeatureSet.scala:240-289).
+        """
+        raise NotImplementedError
+
+    def steps_per_epoch(self, batch_size: int, drop_last: bool = True) -> int:
+        n = self.num_samples
+        return n // batch_size if drop_last else -(-n // batch_size)
+
+
+def _batch_from_arrays(xs, ys, ws, idx, pad_to=None):
+    take = lambda arrs: _unwrap([a[idx] for a in arrs]) \
+        if arrs is not None else None
+    batch = {"x": take(xs)}
+    if ys is not None:
+        batch["y"] = take(ys)
+    if ws is not None:
+        batch["w"] = take(ws)
+    if pad_to is not None and len(idx) % pad_to != 0:
+        pad = pad_to - len(idx) % pad_to
+
+        def pad_fn(v):
+            if isinstance(v, list):
+                return [pad_fn(a) for a in v]
+            reps = np.concatenate([v, np.repeat(v[-1:], pad, axis=0)], axis=0)
+            return reps
+
+        batch = {k: pad_fn(v) for k, v in batch.items()}
+    return batch
+
+
+class ArrayFeatureSet(FeatureSet):
+    """DRAM tier (reference DRAMFeatureSet, FeatureSet.scala:411-421)."""
+
+    def __init__(self, x, y=None, sample_weight=None):
+        self.xs = _as_list(x)
+        self.ys = _as_list(y)
+        self.ws = _as_list(sample_weight)
+        n = len(self.xs[0])
+        for a in self.xs + (self.ys or []) + (self.ws or []):
+            assert len(a) == n, "all arrays must share leading dim"
+        self._n = n
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    def batches(self, batch_size, shuffle=True, seed=0, epoch=0,
+                drop_last=True, start_batch=0, pad_to_batch=None):
+        n = self._n
+        if shuffle:
+            order = np.random.default_rng(
+                np.random.SeedSequence([seed, epoch])
+            ).permutation(n)
+        else:
+            order = np.arange(n)
+        n_batches = n // batch_size if drop_last else -(-n // batch_size)
+        for b in range(start_batch, n_batches):
+            idx = order[b * batch_size:(b + 1) * batch_size]
+            yield _batch_from_arrays(self.xs, self.ys, self.ws, idx,
+                                     pad_to_batch)
+
+
+class ShardedFeatureSet(FeatureSet):
+    """Disk tier with a resident slice (reference DiskFeatureSet,
+    FeatureSet.scala:332-409: trains on 1/numSlice of data in DRAM while the
+    rest stays on disk; the resident slice advances every epoch).
+
+    ``paths`` are ``.npz`` files with arrays ``x`` (and optionally ``y``,
+    ``w``), or anything a custom ``loader(path) -> dict`` understands.
+    """
+
+    def __init__(self, paths: Sequence[str], n_slices: int = 4,
+                 loader: Callable | None = None):
+        assert paths, "no shards given"
+        self.paths = list(paths)
+        self.n_slices = max(1, min(int(n_slices), len(self.paths)))
+        self.loader = loader or self._default_loader
+        self._cache: dict[str, dict] = {}
+        self._sizes: list[int] | None = None
+
+    @staticmethod
+    def _default_loader(path: str) -> dict:
+        data = np.load(path, allow_pickle=False)
+        return {k: data[k] for k in data.files}
+
+    def _shard_sizes(self):
+        if self._sizes is None:
+            self._sizes = [len(self._load(p)["x"]) for p in self.paths]
+        return self._sizes
+
+    def _load(self, path):
+        if path not in self._cache:
+            # keep at most ceil(len/n_slices) shards resident
+            budget = -(-len(self.paths) // self.n_slices)
+            while len(self._cache) >= max(budget, 1):
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[path] = self.loader(path)
+        return self._cache[path]
+
+    @property
+    def num_samples(self) -> int:
+        return sum(self._shard_sizes())
+
+    def batches(self, batch_size, shuffle=True, seed=0, epoch=0,
+                drop_last=True, start_batch=0, pad_to_batch=None):
+        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+        shard_order = (rng.permutation(len(self.paths)) if shuffle
+                       else np.arange(len(self.paths)))
+        b = 0
+        leftover = None
+        for si in shard_order:
+            data = self._load(self.paths[si])
+            xs = _as_list(data["x"])
+            ys = _as_list(data.get("y"))
+            ws = _as_list(data.get("w"))
+            n = len(xs[0])
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            pos = 0
+            if leftover is not None:
+                need = batch_size - len(leftover)
+                idx = order[:need]
+                merged = {
+                    k: np.concatenate(
+                        [leftover[k],
+                         _batch_from_arrays(xs, ys, ws, idx)[k]], axis=0)
+                    for k in leftover
+                }
+                pos = need
+                if len(merged["x"]) == batch_size:
+                    if b >= start_batch:
+                        yield merged
+                    b += 1
+                    leftover = None
+                else:
+                    leftover = merged
+                    continue
+            while pos + batch_size <= n:
+                idx = order[pos:pos + batch_size]
+                if b >= start_batch:
+                    yield _batch_from_arrays(xs, ys, ws, idx)
+                b += 1
+                pos += batch_size
+            if pos < n:
+                leftover = _batch_from_arrays(xs, ys, ws, order[pos:])
+        if leftover is not None and not drop_last:
+            yield _batch_from_arrays(
+                _as_list(leftover["x"]),
+                _as_list(leftover.get("y")),
+                _as_list(leftover.get("w")),
+                np.arange(len(leftover["x"])), pad_to_batch)
+
+
+class TransformedFeatureSet(FeatureSet):
+    """Per-record preprocessing applied at batch-assembly time."""
+
+    def __init__(self, base: FeatureSet, preprocessing: Preprocessing):
+        self.base = base
+        self.preprocessing = preprocessing
+
+    @property
+    def num_samples(self):
+        return self.base.num_samples
+
+    def batches(self, *args, **kwargs):
+        for batch in self.base.batches(*args, **kwargs):
+            xs = batch["x"]
+            single = not isinstance(xs, list)
+            records = xs if single else list(zip(*xs))
+            out = [self.preprocessing(r) for r in
+                   (records if not single else records)]
+            batch = dict(batch)
+            batch["x"] = np.stack(out) if single else [
+                np.stack(col) for col in zip(*out)
+            ]
+            yield batch

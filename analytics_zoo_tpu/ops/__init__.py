@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.ops.activations import get_activation  # noqa: F401

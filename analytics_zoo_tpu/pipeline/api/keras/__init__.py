@@ -1,0 +1,13 @@
+"""Keras-1-style API (mirrors reference pyzoo/zoo/pipeline/api/keras)."""
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (  # noqa: F401
+    Input,
+    Layer,
+    Variable,
+)
+from analytics_zoo_tpu.pipeline.api.keras.topology import (  # noqa: F401
+    KerasNet,
+    Model,
+    Sequential,
+    merge,
+)

@@ -1,0 +1,70 @@
+"""Layer namespace — mirrors reference
+pyzoo/zoo/pipeline/api/keras/layers/__init__.py (120 Keras-1 layers)."""
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (  # noqa: F401
+    Input,
+    InputLayer,
+    Layer,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.core import (  # noqa: F401
+    Activation,
+    Dense,
+    Dropout,
+    ExpandDim,
+    Flatten,
+    GaussianDropout,
+    GaussianNoise,
+    Highway,
+    Identity,
+    Masking,
+    Permute,
+    RepeatVector,
+    Reshape,
+    Select,
+    SpatialDropout1D,
+    SpatialDropout2D,
+    Squeeze,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
+    AtrousConvolution1D,
+    AtrousConvolution2D,
+    Convolution1D,
+    Convolution2D,
+    Convolution3D,
+    Cropping1D,
+    Cropping2D,
+    Cropping3D,
+    Deconvolution2D,
+    LocallyConnected1D,
+    SeparableConvolution2D,
+    UpSampling1D,
+    UpSampling2D,
+    UpSampling3D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+    ZeroPadding3D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.merge import (  # noqa: F401
+    Merge,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (  # noqa: F401
+    AveragePooling1D,
+    AveragePooling2D,
+    AveragePooling3D,
+    GlobalAveragePooling1D,
+    GlobalAveragePooling2D,
+    GlobalAveragePooling3D,
+    GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    GlobalMaxPooling3D,
+    MaxPooling1D,
+    MaxPooling2D,
+    MaxPooling3D,
+)
+
+# Keras-2-style aliases (reference keras2 package provides these names).
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D
+SeparableConv2D = SeparableConvolution2D
+Conv2DTranspose = Deconvolution2D

@@ -1,0 +1,412 @@
+"""Convolution layers — NHWC/NWC layouts, lowered to
+``lax.conv_general_dilated`` so XLA tiles them onto the MXU.
+
+Reference: pipeline/api/keras/layers/{Convolution1D,Convolution2D,
+Convolution3D,SeparableConvolution2D,Deconvolution2D,AtrousConvolution1D,
+AtrousConvolution2D,LocallyConnected1D,LocallyConnected2D,Cropping1D/2D/3D,
+ZeroPadding1D/2D/3D,UpSampling1D/2D/3D}.scala.  The reference defaults to
+Torch-style NCHW ("th" dim ordering); this rebuild is channels-last (NHWC)
+throughout — the layout the TPU vector units and XLA convolution emitters
+prefer — and kernels are stored HWIO.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.ops.activations import get_activation
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _ntuple(x, n):
+    if isinstance(x, int):
+        return (x,) * n
+    t = tuple(int(v) for v in x)
+    assert len(t) == n, f"expected {n} values, got {t}"
+    return t
+
+
+def _conv_out_dim(size, k, stride, mode, dilation=1):
+    if size is None:
+        return None
+    eff = (k - 1) * dilation + 1
+    if mode == "same":
+        return (size + stride - 1) // stride
+    return (size - eff) // stride + 1
+
+
+_DIMNUMS = {1: ("NWC", "WIO", "NWC"),
+            2: ("NHWC", "HWIO", "NHWC"),
+            3: ("NDHWC", "DHWIO", "NDHWC")}
+
+
+class _ConvND(Layer):
+    """Shared N-d convolution over the trailing channel axis."""
+
+    rank: int = 2
+
+    def __init__(self, nb_filter, kernel_size, subsample=1,
+                 border_mode="valid", activation=None, bias=True,
+                 dilation=1, init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _ntuple(kernel_size, self.rank)
+        self.subsample = _ntuple(subsample, self.rank)
+        self.dilation = _ntuple(dilation, self.rank)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode {border_mode!r}")
+        self.border_mode = border_mode
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+        self._config = dict(nb_filter=nb_filter, kernel_size=self.kernel_size,
+                            subsample=self.subsample,
+                            border_mode=border_mode, bias=bias)
+
+    def build(self, input_shape):
+        in_ch = int(input_shape[-1])
+        self.add_weight("kernel",
+                        self.kernel_size + (in_ch, self.nb_filter),
+                        self.init)
+        if self.bias:
+            self.add_weight("bias", (self.nb_filter,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"],
+            window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=_DIMNUMS[self.rank],
+        )
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        spatial = input_shape[1:-1]
+        out_spatial = tuple(
+            _conv_out_dim(s, k, st, self.border_mode, d)
+            for s, k, st, d in zip(spatial, self.kernel_size,
+                                   self.subsample, self.dilation)
+        )
+        return (input_shape[0],) + out_spatial + (self.nb_filter,)
+
+
+class Convolution1D(_ConvND):
+    """Reference Convolution1D.scala; input (batch, steps, channels)."""
+    rank = 1
+
+    def __init__(self, nb_filter, filter_length, subsample_length=1,
+                 border_mode="valid", activation=None, bias=True,
+                 dilation_rate=1, init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(nb_filter, filter_length, subsample_length,
+                         border_mode, activation, bias, dilation_rate, init,
+                         input_shape, name, **kwargs)
+
+
+class Convolution2D(_ConvND):
+    """Reference Convolution2D.scala; input NHWC."""
+    rank = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 border_mode="valid", activation=None, bias=True,
+                 dilation=(1, 1), init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        ksize = (nb_row, nb_col) if nb_col is not None else nb_row
+        super().__init__(nb_filter, ksize, subsample, border_mode,
+                         activation, bias, dilation, init, input_shape, name,
+                         **kwargs)
+
+
+class Convolution3D(_ConvND):
+    """Reference Convolution3D.scala; input NDHWC."""
+    rank = 3
+
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2=None,
+                 kernel_dim3=None, subsample=(1, 1, 1), border_mode="valid",
+                 activation=None, bias=True, init="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        if kernel_dim2 is None:
+            ksize = kernel_dim1
+        else:
+            ksize = (kernel_dim1, kernel_dim2, kernel_dim3)
+        super().__init__(nb_filter, ksize, subsample, border_mode,
+                         activation, bias, 1, init, input_shape, name,
+                         **kwargs)
+
+
+class AtrousConvolution1D(Convolution1D):
+    """Dilated conv (reference AtrousConvolution1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length, atrous_rate=1, **kwargs):
+        super().__init__(nb_filter, filter_length,
+                         dilation_rate=atrous_rate, **kwargs)
+
+
+class AtrousConvolution2D(Convolution2D):
+    def __init__(self, nb_filter, nb_row, nb_col=None, atrous_rate=(1, 1),
+                 **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col, dilation=atrous_rate,
+                         **kwargs)
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise conv (reference
+    SeparableConvolution2D.scala), NHWC."""
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 border_mode="valid", depth_multiplier=1, activation=None,
+                 bias=True, init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _ntuple((nb_row, nb_col) if nb_col else nb_row, 2)
+        self.subsample = _ntuple(subsample, 2)
+        self.border_mode = border_mode
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        in_ch = int(input_shape[-1])
+        self.add_weight(
+            "depthwise_kernel",
+            self.kernel_size + (1, in_ch * self.depth_multiplier), self.init
+        )
+        self.add_weight(
+            "pointwise_kernel",
+            (1, 1, in_ch * self.depth_multiplier, self.nb_filter), self.init
+        )
+        if self.bias:
+            self.add_weight("bias", (self.nb_filter,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        in_ch = inputs.shape[-1]
+        y = lax.conv_general_dilated(
+            inputs, params["depthwise_kernel"],
+            window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            dimension_numbers=_DIMNUMS[2],
+            feature_group_count=in_ch,
+        )
+        y = lax.conv_general_dilated(
+            y, params["pointwise_kernel"], window_strides=(1, 1),
+            padding="VALID", dimension_numbers=_DIMNUMS[2],
+        )
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        spatial = input_shape[1:-1]
+        out = tuple(
+            _conv_out_dim(s, k, st, self.border_mode)
+            for s, k, st in zip(spatial, self.kernel_size, self.subsample)
+        )
+        return (input_shape[0],) + out + (self.nb_filter,)
+
+
+class Deconvolution2D(Layer):
+    """Transposed convolution (reference Deconvolution2D.scala), NHWC."""
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 border_mode="valid", activation=None, bias=True,
+                 init="glorot_uniform", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _ntuple((nb_row, nb_col) if nb_col else nb_row, 2)
+        self.subsample = _ntuple(subsample, 2)
+        self.border_mode = border_mode
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        in_ch = int(input_shape[-1])
+        self.add_weight("kernel", self.kernel_size + (in_ch, self.nb_filter),
+                        self.init)
+        if self.bias:
+            self.add_weight("bias", (self.nb_filter,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = lax.conv_transpose(
+            inputs, params["kernel"], strides=self.subsample,
+            padding=self.border_mode.upper(),
+            dimension_numbers=_DIMNUMS[2],
+        )
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        spatial = input_shape[1:-1]
+        out = []
+        for s, k, st in zip(spatial, self.kernel_size, self.subsample):
+            if s is None:
+                out.append(None)
+            elif self.border_mode == "same":
+                out.append(s * st)
+            else:
+                out.append(s * st + max(k - st, 0))
+        return (input_shape[0],) + tuple(out) + (self.nb_filter,)
+
+
+class _ZeroPaddingND(Layer):
+    rank = 2
+
+    def __init__(self, padding, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        if isinstance(padding, int):
+            padding = ((padding, padding),) * self.rank
+        else:
+            padding = tuple(
+                (p, p) if isinstance(p, int) else tuple(p) for p in padding
+            )
+        self.padding = padding
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        cfg = ((0, 0),) + self.padding + ((0, 0),)
+        return jnp.pad(inputs, cfg)
+
+    def compute_output_shape(self, input_shape):
+        spatial = [
+            None if s is None else s + p[0] + p[1]
+            for s, p in zip(input_shape[1:-1], self.padding)
+        ]
+        return (input_shape[0],) + tuple(spatial) + (input_shape[-1],)
+
+
+class ZeroPadding1D(_ZeroPaddingND):
+    rank = 1
+
+
+class ZeroPadding2D(_ZeroPaddingND):
+    rank = 2
+
+
+class ZeroPadding3D(_ZeroPaddingND):
+    rank = 3
+
+
+class _CroppingND(Layer):
+    rank = 2
+
+    def __init__(self, cropping, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.cropping = tuple(
+            (c, c) if isinstance(c, int) else tuple(c) for c in cropping
+        )
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        idx = [slice(None)]
+        for (lo, hi), size in zip(self.cropping, inputs.shape[1:-1]):
+            idx.append(slice(lo, size - hi))
+        idx.append(slice(None))
+        return inputs[tuple(idx)]
+
+    def compute_output_shape(self, input_shape):
+        spatial = [
+            None if s is None else s - lo - hi
+            for s, (lo, hi) in zip(input_shape[1:-1], self.cropping)
+        ]
+        return (input_shape[0],) + tuple(spatial) + (input_shape[-1],)
+
+
+class Cropping1D(_CroppingND):
+    rank = 1
+
+    def __init__(self, cropping=(1, 1), **kwargs):
+        super().__init__((cropping,), **kwargs)
+
+
+class Cropping2D(_CroppingND):
+    rank = 2
+
+
+class Cropping3D(_CroppingND):
+    rank = 3
+
+
+class _UpSamplingND(Layer):
+    rank = 2
+
+    def __init__(self, size=2, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.size = _ntuple(size, self.rank)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = inputs
+        for ax, rep in enumerate(self.size):
+            y = jnp.repeat(y, rep, axis=ax + 1)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        spatial = [
+            None if s is None else s * r
+            for s, r in zip(input_shape[1:-1], self.size)
+        ]
+        return (input_shape[0],) + tuple(spatial) + (input_shape[-1],)
+
+
+class UpSampling1D(_UpSamplingND):
+    rank = 1
+
+
+class UpSampling2D(_UpSamplingND):
+    rank = 2
+
+
+class UpSampling3D(_UpSamplingND):
+    rank = 3
+
+
+class LocallyConnected1D(Layer):
+    """Unshared-weights 1D conv (reference LocallyConnected1D.scala).
+    Implemented as an einsum over unfolded patches — a single MXU-friendly
+    contraction rather than a per-position loop."""
+
+    def __init__(self, nb_filter, filter_length, subsample_length=1,
+                 activation=None, bias=True, init="glorot_uniform",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.subsample = int(subsample_length)
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+
+    def _out_len(self, steps):
+        return (steps - self.filter_length) // self.subsample + 1
+
+    def build(self, input_shape):
+        steps, in_ch = int(input_shape[-2]), int(input_shape[-1])
+        out_len = self._out_len(steps)
+        self.add_weight(
+            "kernel", (out_len, self.filter_length * in_ch, self.nb_filter),
+            self.init,
+        )
+        if self.bias:
+            self.add_weight("bias", (out_len, self.nb_filter), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        b, steps, ch = inputs.shape
+        out_len = self._out_len(steps)
+        starts = np.arange(out_len) * self.subsample
+        gather = starts[:, None] + np.arange(self.filter_length)[None, :]
+        patches = inputs[:, gather, :].reshape(b, out_len, -1)
+        y = jnp.einsum("blk,lko->blo", patches, params["kernel"])
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self._out_len(input_shape[1]),
+                self.nb_filter)

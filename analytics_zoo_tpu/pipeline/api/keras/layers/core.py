@@ -1,0 +1,292 @@
+"""Core layers: Dense, Activation, Dropout, Flatten, Reshape, Permute,
+RepeatVector, Masking, Highway, GaussianNoise/Dropout, SpatialDropout.
+
+Reference: pipeline/api/keras/layers/{Dense,Activation,Dropout,Flatten,
+Reshape,Permute,RepeatVector,Masking,Highway,GaussianNoise,GaussianDropout,
+SpatialDropout1D/2D/3D}.scala — BigDL module wrappers with
+``computeOutputShape``.  Here each is a pure function over a params pytree;
+dropout takes an explicit rng (threaded by the graph executor) so a whole
+training step stays reproducible and jit-pure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.activations import get_activation
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+class Dense(Layer):
+    """Fully connected: ``y = act(x @ W + b)``.
+
+    Reference keras/layers (Dense.scala); kernel shaped (in, out) so the
+    batched matmul maps straight onto the MXU.  Applies to the last axis for
+    >2D inputs (Keras-1 semantics).
+    """
+
+    def __init__(self, output_dim, init="glorot_uniform", activation=None,
+                 bias=True, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.init = init
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self._config = dict(output_dim=output_dim, init=init, bias=bias)
+
+    def build(self, input_shape):
+        in_dim = int(input_shape[-1])
+        self.add_weight("kernel", (in_dim, self.output_dim), self.init)
+        if self.bias:
+            self.add_weight("bias", (self.output_dim,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = inputs @ params["kernel"]
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(Layer):
+    def __init__(self, activation, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.activation = get_activation(activation)
+        self._config = dict(activation=str(activation))
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return self.activation(inputs)
+
+
+class Dropout(Layer):
+    """Inverted dropout; identity at inference (reference Dropout.scala)."""
+
+    def __init__(self, p, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.p = float(p)
+        self._config = dict(p=p)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, inputs.shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+class SpatialDropout1D(Dropout):
+    """Drops whole feature maps (reference SpatialDropout1D.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        shape = (inputs.shape[0], 1, inputs.shape[2])
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+class SpatialDropout2D(Dropout):
+    """NHWC feature-map dropout (reference SpatialDropout2D.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        shape = (inputs.shape[0], 1, 1, inputs.shape[3])
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.sigma = float(sigma)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if not training or rng is None:
+            return inputs
+        return inputs + self.sigma * jax.random.normal(
+            rng, inputs.shape, inputs.dtype
+        )
+
+
+class GaussianDropout(Layer):
+    def __init__(self, p, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.p = float(p)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return inputs
+        std = np.sqrt(self.p / (1.0 - self.p))
+        return inputs * (
+            1.0 + std * jax.random.normal(rng, inputs.shape, inputs.dtype)
+        )
+
+
+class Flatten(Layer):
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs.reshape(inputs.shape[0], -1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], int(np.prod(input_shape[1:])))
+
+
+class Reshape(Layer):
+    """Reshape non-batch dims; one dim may be -1 (reference Reshape.scala)."""
+
+    def __init__(self, target_shape, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.target_shape = tuple(int(d) for d in target_shape)
+        self._config = dict(target_shape=self.target_shape)
+
+    def _resolve(self, input_shape):
+        in_elems = int(np.prod(input_shape[1:]))
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            known = int(np.prod([d for d in tgt if d != -1]))
+            tgt[tgt.index(-1)] = in_elems // known
+        return tuple(tgt)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        tgt = self._resolve((None,) + inputs.shape[1:])
+        return inputs.reshape((inputs.shape[0],) + tgt)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._resolve(input_shape)
+
+
+class Permute(Layer):
+    """Permute non-batch axes; dims are 1-based (Keras-1 / reference
+    Permute.scala convention)."""
+
+    def __init__(self, dims, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dims = tuple(int(d) for d in dims)
+        self._config = dict(dims=self.dims)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        return jnp.transpose(inputs, perm)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(
+            input_shape[d] for d in self.dims
+        )
+
+
+class RepeatVector(Layer):
+    """(b, f) -> (b, n, f). Reference RepeatVector.scala."""
+
+    def __init__(self, n, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.n = int(n)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.repeat(inputs[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+
+class Masking(Layer):
+    """Zero out timesteps equal to mask_value (reference Masking.scala).
+    Under XLA's static-shape regime masking is value-level, not shape-level."""
+
+    def __init__(self, mask_value=0.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.mask_value = float(mask_value)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        keep = jnp.any(inputs != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, inputs, 0.0)
+
+
+class Highway(Layer):
+    """Highway network layer: ``y = t*h(xW_h) + (1-t)*x`` (reference
+    Highway.scala)."""
+
+    def __init__(self, activation="tanh", bias=True, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.activation = get_activation(activation)
+        self.bias = bias
+
+    def build(self, input_shape):
+        d = int(input_shape[-1])
+        self.add_weight("kernel", (d, d))
+        self.add_weight("gate_kernel", (d, d))
+        if self.bias:
+            self.add_weight("bias", (d,), "zero")
+            # negative gate bias → start as identity (standard highway init)
+            self.add_weight("gate_bias", (d,), -1.0)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        h = inputs @ params["kernel"]
+        t = inputs @ params["gate_kernel"]
+        if self.bias:
+            h = h + params["bias"]
+            t = t + params["gate_bias"]
+        t = jax.nn.sigmoid(t)
+        return t * self.activation(h) + (1.0 - t) * inputs
+
+
+class Identity(Layer):
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs
+
+
+class Select(Layer):
+    """Select one index along an axis (reference Select.scala); axis is
+    0-based including batch for fidelity with Zoo's Select(dim, index)."""
+
+    def __init__(self, dim, index, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.take(inputs, self.index, axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        dim = self.dim if self.dim >= 0 else len(shape) + self.dim
+        del shape[dim]
+        return tuple(shape)
+
+
+class Squeeze(Layer):
+    """Squeeze singleton dims (reference Squeeze.scala); dims 0-based
+    including batch."""
+
+    def __init__(self, dims, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dims = (dims,) if isinstance(dims, int) else tuple(dims)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.squeeze(inputs, axis=self.dims)
+
+    def compute_output_shape(self, input_shape):
+        nd = len(input_shape)
+        drop = {d % nd for d in self.dims}
+        return tuple(s for i, s in enumerate(input_shape) if i not in drop)
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.dim = int(dim)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.expand_dims(inputs, self.dim)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        dim = self.dim if self.dim >= 0 else len(shape) + 1 + self.dim
+        shape.insert(dim, 1)
+        return tuple(shape)

@@ -1,0 +1,85 @@
+"""Merge layers (reference pipeline/api/keras/layers/Merge.scala and keras2
+Maximum/Minimum/Average): combine a list of inputs by
+sum/mul/max/min/ave/concat/dot/cosine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+class Merge(Layer):
+    """Reference Merge.scala: modes sum, mul, max, min, ave, concat, dot,
+    cosine."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self._config = dict(mode=mode, concat_axis=concat_axis)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        xs = list(inputs)
+        m = self.mode
+        if m == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "ave":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out / len(xs)
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if m == "cosine":
+            a, b = xs
+            an = a / jnp.clip(jnp.linalg.norm(a, axis=-1, keepdims=True),
+                              1e-7)
+            bn = b / jnp.clip(jnp.linalg.norm(b, axis=-1, keepdims=True),
+                              1e-7)
+            return jnp.sum(an * bn, axis=-1, keepdims=True)
+        raise ValueError(f"unknown merge mode {self.mode!r}")
+
+    def compute_output_shape(self, input_shapes):
+        shapes = list(input_shapes)
+        if self.mode in ("sum", "mul", "max", "min", "ave"):
+            return shapes[0]
+        if self.mode == "concat":
+            base = list(shapes[0])
+            ax = self.concat_axis
+            if ax < 0:
+                ax += len(base)
+            total = 0
+            for s in shapes:
+                if s[ax] is None:
+                    total = None
+                    break
+                total += s[ax]
+            base[ax] = total
+            return tuple(base)
+        if self.mode in ("dot", "cosine"):
+            return (shapes[0][0], 1)
+        raise ValueError(self.mode)

@@ -1,0 +1,132 @@
+"""Pooling layers, NHWC, via ``lax.reduce_window`` (XLA-native windows).
+
+Reference: pipeline/api/keras/layers/{MaxPooling1D/2D/3D,
+AveragePooling1D/2D/3D,GlobalMaxPooling1D/2D/3D,GlobalAveragePooling1D/2D/3D}
+.scala.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    _conv_out_dim,
+    _ntuple,
+)
+
+
+class _PoolND(Layer):
+    rank = 2
+    mode = "max"
+
+    def __init__(self, pool_size=2, strides=None, border_mode="valid",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.pool_size = _ntuple(pool_size, self.rank)
+        self.strides = _ntuple(strides, self.rank) if strides is not None \
+            else self.pool_size
+        self.border_mode = border_mode
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        if self.mode == "max":
+            init = -jnp.inf
+            y = lax.reduce_window(
+                inputs, init, lax.max, window, strides,
+                self.border_mode.upper(),
+            )
+        else:
+            y = lax.reduce_window(
+                inputs, 0.0, lax.add, window, strides,
+                self.border_mode.upper(),
+            )
+            if self.border_mode == "same":
+                ones = jnp.ones_like(inputs)
+                counts = lax.reduce_window(
+                    ones, 0.0, lax.add, window, strides, "SAME"
+                )
+                y = y / counts
+            else:
+                y = y / float(jnp.prod(jnp.asarray(self.pool_size)))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        spatial = tuple(
+            _conv_out_dim(s, k, st, self.border_mode)
+            for s, k, st in zip(input_shape[1:-1], self.pool_size,
+                                self.strides)
+        )
+        return (input_shape[0],) + spatial + (input_shape[-1],)
+
+
+class MaxPooling1D(_PoolND):
+    rank, mode = 1, "max"
+
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 **kwargs):
+        super().__init__(pool_length, stride, border_mode, **kwargs)
+
+
+class MaxPooling2D(_PoolND):
+    rank, mode = 2, "max"
+
+
+class MaxPooling3D(_PoolND):
+    rank, mode = 3, "max"
+
+
+class AveragePooling1D(_PoolND):
+    rank, mode = 1, "avg"
+
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 **kwargs):
+        super().__init__(pool_length, stride, border_mode, **kwargs)
+
+
+class AveragePooling2D(_PoolND):
+    rank, mode = 2, "avg"
+
+
+class AveragePooling3D(_PoolND):
+    rank, mode = 3, "avg"
+
+
+class _GlobalPoolND(Layer):
+    rank = 2
+    mode = "max"
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        axes = tuple(range(1, 1 + self.rank))
+        if self.mode == "max":
+            return jnp.max(inputs, axis=axes)
+        return jnp.mean(inputs, axis=axes)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[-1])
+
+
+class GlobalMaxPooling1D(_GlobalPoolND):
+    rank, mode = 1, "max"
+
+
+class GlobalMaxPooling2D(_GlobalPoolND):
+    rank, mode = 2, "max"
+
+
+class GlobalMaxPooling3D(_GlobalPoolND):
+    rank, mode = 3, "max"
+
+
+class GlobalAveragePooling1D(_GlobalPoolND):
+    rank, mode = 1, "avg"
+
+
+class GlobalAveragePooling2D(_GlobalPoolND):
+    rank, mode = 2, "avg"
+
+
+class GlobalAveragePooling3D(_GlobalPoolND):
+    rank, mode = 3, "avg"

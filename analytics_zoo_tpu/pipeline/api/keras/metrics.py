@@ -1,0 +1,186 @@
+"""Validation metrics — reference ``pipeline/api/keras/metrics/*.scala``
+(Accuracy, SparseCategoricalAccuracy, BinaryAccuracy, CategoricalAccuracy,
+Top5Accuracy, MAE, MSE, AUC) re-designed as pure streaming aggregators.
+
+Each metric maps a device-resident batch to a small ``(numerator,
+denominator)`` pair inside the jitted eval step (so evaluation is one XLA
+program, not a host loop over layers), and the host accumulates pairs —
+the role of BigDL ``ValidationMethod.apply`` + ``ValidationResult`` merging.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    """Streaming metric: ``batch_stats`` runs jitted; ``finalize`` on host."""
+
+    name = "metric"
+    #: number of scalar accumulators this metric produces
+    n_stats = 2
+
+    def batch_stats(self, y_true, y_pred):
+        """Return a tuple of scalars to accumulate (device side)."""
+        raise NotImplementedError
+
+    def finalize(self, stats) -> float:
+        num, den = stats
+        return float(num) / max(float(den), 1e-12)
+
+
+def _match_binary(y_true, y_pred):
+    pred = (y_pred > 0.5).astype(jnp.int32)
+    return (pred == y_true.astype(jnp.int32)).astype(jnp.float32)
+
+
+class Accuracy(Metric):
+    """Auto-dispatching accuracy like the reference's ``Accuracy``
+    (keras/metrics/Accuracy.scala): binary if the prediction is scalar,
+    else categorical over the last axis; integer or one-hot targets."""
+
+    name = "accuracy"
+
+    def batch_stats(self, y_true, y_pred):
+        if y_pred.ndim >= 1 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            if y_true.ndim == y_pred.ndim:
+                true = jnp.argmax(y_true, axis=-1) \
+                    if y_true.shape[-1] > 1 else y_true[..., 0]
+            else:
+                true = y_true
+            correct = (pred == true.astype(pred.dtype)).astype(jnp.float32)
+        else:
+            yp = y_pred[..., 0] if y_pred.ndim > 1 else y_pred
+            yt = y_true[..., 0] if y_true.ndim > 1 else y_true
+            correct = _match_binary(yt, yp)
+        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+
+class CategoricalAccuracy(Accuracy):
+    name = "categorical_accuracy"
+
+
+class BinaryAccuracy(Metric):
+    name = "binary_accuracy"
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def batch_stats(self, y_true, y_pred):
+        yp = y_pred.reshape(y_pred.shape[0], -1)
+        yt = y_true.reshape(y_true.shape[0], -1).astype(jnp.int32)
+        correct = ((yp > self.threshold).astype(jnp.int32) == yt)
+        correct = correct.astype(jnp.float32)
+        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+
+
+class Top5Accuracy(Metric):
+    """Reference keras/metrics Top5Accuracy.scala."""
+
+    name = "top5_accuracy"
+
+    def batch_stats(self, y_true, y_pred):
+        true = y_true
+        if true.ndim == y_pred.ndim:
+            true = jnp.argmax(true, axis=-1) if true.shape[-1] > 1 \
+                else true[..., 0]
+        true = true.astype(jnp.int32)
+        top5 = jnp.argsort(y_pred, axis=-1)[..., -5:]
+        correct = jnp.any(top5 == true[..., None], axis=-1)
+        correct = correct.astype(jnp.float32)
+        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def batch_stats(self, y_true, y_pred):
+        err = jnp.abs(y_pred - y_true)
+        return jnp.sum(err), jnp.asarray(err.size, jnp.float32)
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def batch_stats(self, y_true, y_pred):
+        err = (y_pred - y_true) ** 2
+        return jnp.sum(err), jnp.asarray(err.size, jnp.float32)
+
+
+class Loss(Metric):
+    """Wraps the compiled loss as a validation metric (reference keras
+    metrics use `Loss(criterion)` similarly)."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn):
+        self.loss_fn = loss_fn
+        self.name = "loss"
+
+    def batch_stats(self, y_true, y_pred):
+        per_sample = self.loss_fn(y_true, y_pred)
+        return jnp.sum(per_sample), jnp.asarray(
+            per_sample.shape[0], jnp.float32
+        )
+
+
+class AUC(Metric):
+    """Thresholded streaming ROC-AUC (reference keras/metrics/AUC.scala):
+    accumulates TP/FP/TN/FN histograms over fixed thresholds on device,
+    trapezoidal ROC integration on host."""
+
+    name = "auc"
+    n_stats = 4
+
+    def __init__(self, thresholds: int = 200):
+        self.thresholds = np.linspace(0.0, 1.0, thresholds)
+
+    def batch_stats(self, y_true, y_pred):
+        yp = y_pred.reshape(-1)
+        yt = y_true.reshape(-1)
+        th = jnp.asarray(self.thresholds)[:, None]
+        pred_pos = (yp[None, :] >= th)
+        pos = (yt[None, :] > 0.5)
+        tp = jnp.sum(pred_pos & pos, axis=1).astype(jnp.float32)
+        fp = jnp.sum(pred_pos & ~pos, axis=1).astype(jnp.float32)
+        fn = jnp.sum(~pred_pos & pos, axis=1).astype(jnp.float32)
+        tn = jnp.sum(~pred_pos & ~pos, axis=1).astype(jnp.float32)
+        return tp, fp, fn, tn
+
+    def finalize(self, stats) -> float:
+        tp, fp, fn, tn = (np.asarray(s, dtype=np.float64) for s in stats)
+        tpr = tp / np.maximum(tp + fn, 1e-12)
+        fpr = fp / np.maximum(fp + tn, 1e-12)
+        order = np.argsort(fpr)
+        return float(np.trapezoid(tpr[order], fpr[order]))
+
+
+_METRICS = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5_accuracy": Top5Accuracy,
+    "top5": Top5Accuracy,
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+}
+
+
+def get_metric(identifier) -> Metric:
+    if isinstance(identifier, Metric):
+        return identifier
+    if isinstance(identifier, str) and identifier.lower() in _METRICS:
+        return _METRICS[identifier.lower()]()
+    if isinstance(identifier, type) and issubclass(identifier, Metric):
+        return identifier()
+    raise ValueError(f"unknown metric {identifier!r}")

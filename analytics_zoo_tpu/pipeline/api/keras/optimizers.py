@@ -1,0 +1,197 @@
+"""Optimizers — Keras-1 names over optax gradient transforms.
+
+Reference: ``pipeline/api/keras/optimizers/Adam.scala`` (Adam with
+schedule-aware LR), ``AdamWeightDecay.scala`` (BERT-style decoupled weight
+decay with warmup/linear-decay schedule), plus BigDL ``SGD`` schedules used by
+the examples (warmup + epoch decay in examples/resnet/TrainImageNet.scala:36-120).
+
+The reference applies the optimizer per parameter-slice inside its Spark
+all-reduce ("parameter server on Spark", docs/docs/wp-bigdl.md:148-164).  Here
+the optimizer update is fused into the jitted SPMD train step right after the
+psum — the sharding-aware analogue of that slice-wise update, with XLA free to
+shard the update across chips (cf. PAPERS.md "Automatic Cross-Replica Sharding
+of Weight Update in Data-Parallel Training").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import optax
+
+Schedule = Callable[[int], float]  # step -> lr multiplier or absolute lr
+
+
+def warmup_linear_decay(warmup_steps: int, total_steps: int) -> Schedule:
+    """BERT-style warmup-then-linear-decay multiplier
+    (reference AdamWeightDecay.scala warmupPortion semantics)."""
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.maximum(warmup_steps, 1)
+        lin = jnp.maximum(total_steps - warmup_steps, 1)
+        return jnp.where(
+            step < warmup_steps,
+            step / warm,
+            jnp.maximum(0.0, 1.0 - (step - warmup_steps) / lin),
+        )
+
+    return fn
+
+
+def warmup_epoch_decay(
+    warmup_steps: int,
+    steps_per_epoch: int,
+    boundaries_epochs=(30, 60, 80),
+    decay: float = 0.1,
+    warmup_start: float = 0.0,
+) -> Schedule:
+    """ResNet-ImageNet schedule: linear warmup then step decay at epoch
+    boundaries (reference examples/resnet/TrainImageNet.scala:36-120:
+    warmup + decay 0.1 @ epochs 30/60/80)."""
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.minimum(step / jnp.maximum(warmup_steps, 1), 1.0)
+        warm = warmup_start + (1.0 - warmup_start) * frac
+        epoch = step / steps_per_epoch
+        mult = jnp.asarray(1.0, jnp.float32)
+        for b in boundaries_epochs:
+            mult = mult * jnp.where(epoch >= b, decay, 1.0)
+        return jnp.where(step < warmup_steps, warm, mult)
+
+    return fn
+
+
+def poly_decay(power: float, max_steps: int) -> Schedule:
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        return jnp.maximum(0.0, (1.0 - step / max_steps)) ** power
+
+    return fn
+
+
+class Optimizer:
+    """An optax transform + learning-rate schedule, Keras-1-flavored."""
+
+    def __init__(self, tx: optax.GradientTransformation, name: str,
+                 learning_rate: float = 0.01,
+                 schedule: Optional[Schedule] = None):
+        self.name = name
+        self.learning_rate = learning_rate
+        self.schedule = schedule
+        self._tx = tx
+
+    # -- optax protocol ---------------------------------------------------
+    def init(self, params):
+        return self._tx.init(params)
+
+    def update(self, grads, opt_state, params=None):
+        return self._tx.update(grads, opt_state, params)
+
+    def current_lr(self, step: int) -> float:
+        if self.schedule is None:
+            return float(self.learning_rate)
+        return float(self.learning_rate * self.schedule(step))
+
+
+def _scheduled(lr, schedule):
+    if schedule is None:
+        return lr
+    return lambda step: lr * schedule(step)
+
+
+class SGD(Optimizer):
+    def __init__(self, lr=0.01, momentum=0.0, decay=0.0, nesterov=False,
+                 weight_decay=0.0, schedule: Optional[Schedule] = None):
+        sched = schedule
+        if decay and sched is None:
+            sched = lambda step: 1.0 / (1.0 + decay * step)
+        chain = []
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(
+            optax.sgd(_scheduled(lr, sched), momentum=momentum or None,
+                      nesterov=nesterov)
+        )
+        super().__init__(optax.chain(*chain), "sgd", lr, sched)
+
+
+class Adam(Optimizer):
+    """Reference keras/optimizers/Adam.scala (schedule-aware Adam)."""
+
+    def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 decay=0.0, schedule: Optional[Schedule] = None):
+        sched = schedule
+        if decay and sched is None:
+            sched = lambda step: 1.0 / (1.0 + decay * step)
+        tx = optax.adam(_scheduled(lr, sched), b1=beta_1, b2=beta_2,
+                        eps=epsilon)
+        super().__init__(tx, "adam", lr, sched)
+
+
+class AdamWeightDecay(Optimizer):
+    """Decoupled weight decay + warmup/linear-decay (reference
+    keras/optimizers/AdamWeightDecay.scala, used by BERT)."""
+
+    def __init__(self, lr=0.001, warmup_portion=-1.0, total=-1,
+                 schedule=None, beta_1=0.9, beta_2=0.999, epsilon=1e-6,
+                 weight_decay=0.01):
+        sched = schedule
+        if sched is None and total > 0:
+            warmup = int(max(warmup_portion, 0.0) * total)
+            sched = warmup_linear_decay(warmup, total)
+        tx = optax.adamw(_scheduled(lr, sched), b1=beta_1, b2=beta_2,
+                         eps=epsilon, weight_decay=weight_decay)
+        super().__init__(tx, "adamw", lr, sched)
+
+
+class RMSprop(Optimizer):
+    def __init__(self, lr=0.001, rho=0.9, epsilon=1e-8,
+                 schedule: Optional[Schedule] = None):
+        tx = optax.rmsprop(_scheduled(lr, schedule), decay=rho, eps=epsilon)
+        super().__init__(tx, "rmsprop", lr, schedule)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, lr=0.01, epsilon=1e-8,
+                 schedule: Optional[Schedule] = None):
+        tx = optax.adagrad(_scheduled(lr, schedule), eps=epsilon)
+        super().__init__(tx, "adagrad", lr, schedule)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, lr=1.0, rho=0.95, epsilon=1e-8,
+                 schedule: Optional[Schedule] = None):
+        tx = optax.adadelta(_scheduled(lr, schedule), rho=rho, eps=epsilon)
+        super().__init__(tx, "adadelta", lr, schedule)
+
+
+class Adamax(Optimizer):
+    def __init__(self, lr=0.002, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 schedule: Optional[Schedule] = None):
+        tx = optax.adamax(_scheduled(lr, schedule), b1=beta_1, b2=beta_2,
+                          eps=epsilon)
+        super().__init__(tx, "adamax", lr, schedule)
+
+
+_OPTIMIZERS = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+}
+
+
+def get_optimizer(identifier) -> Optimizer:
+    if isinstance(identifier, Optimizer):
+        return identifier
+    if isinstance(identifier, str) and identifier.lower() in _OPTIMIZERS:
+        return _OPTIMIZERS[identifier.lower()]()
+    if isinstance(identifier, optax.GradientTransformation):
+        return Optimizer(identifier, "optax", 0.0)
+    raise ValueError(f"unknown optimizer {identifier!r}")

@@ -1,0 +1,437 @@
+"""KerasNet / Sequential / Model — the user-facing model API.
+
+TPU-native re-design of the reference's
+``pipeline/api/keras/models/Topology.scala``:
+
+- ``KerasNet`` (Topology.scala:63-600): compile/fit/evaluate/predict,
+  TensorBoard wiring, checkpointing, gradient clipping, ``summary()``.
+- ``Model`` (graph, Topology.scala:602-759) and ``Sequential``
+  (Topology.scala:825-959).
+
+Where the reference's ``fit`` spins up ``InternalDistriOptimizer`` (Spark jobs
++ block-manager all-reduce, Topology.scala:1076-1259), here ``fit`` builds a
+single jit-compiled SPMD train step through
+:mod:`analytics_zoo_tpu.pipeline.estimator` — forward, backward, psum over the
+``data`` mesh axis, and the optimizer update fused into one XLA program.
+
+Models are also Layers, so they nest (a Sequential inside a Model graph), and
+their parameters are ordinary pytrees: ``net.params`` / ``net.state``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common.engine import get_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    GraphFunction,
+    InputLayer,
+    Layer,
+    Variable,
+    _ContainerBase,
+)
+
+
+class KerasNet(_ContainerBase):
+    """Base for trainable containers (reference KerasNet,
+    Topology.scala:63-600)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.params: dict | None = None
+        self.state: dict | None = None
+        self._compiled = None   # set by compile()
+        self._tensorboard = None  # (log_dir, app_name)
+        self._checkpoint = None   # (path, over_write)
+        self._grad_clip = None    # ("l2norm", v) | ("const", lo, hi)
+        self._estimator = None
+
+    # ------------------------------------------------------------------
+    # parameter materialization
+    # ------------------------------------------------------------------
+    def build_params(self, rng=None, force: bool = False):
+        """Materialize params/state pytrees (idempotent)."""
+        if self.params is not None and not force:
+            return self.params, self.state
+        rng = rng if rng is not None else jax.random.PRNGKey(
+            get_zoo_context().seed
+        )
+        self.params = self.init_params(rng)
+        self.state = self.init_state()
+        return self.params, self.state
+
+    def forward(self, params, inputs, state=None, training=False, rng=None):
+        """Pure forward; containers implement via call()."""
+        return self.call(params, inputs, state=state, training=training,
+                         rng=rng)
+
+    # ------------------------------------------------------------------
+    # compile / fit / evaluate / predict  (Topology.scala:135-547)
+    # ------------------------------------------------------------------
+    def compile(self, optimizer, loss, metrics=None):
+        """Configure training (reference ``compile`` Topology.scala:135-166)."""
+        from analytics_zoo_tpu.pipeline.api.keras.metrics import get_metric
+        from analytics_zoo_tpu.pipeline.api.keras.objectives import get_loss
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+            get_optimizer,
+        )
+
+        self._compiled = dict(
+            optimizer=get_optimizer(optimizer),
+            loss=get_loss(loss),
+            metrics=[get_metric(m) for m in (metrics or [])],
+        )
+        self._estimator = None
+        return self
+
+    def _require_compiled(self):
+        if self._compiled is None:
+            raise RuntimeError(
+                "model not compiled; call compile(optimizer, loss) first"
+            )
+
+    def set_tensorboard(self, log_dir, app_name):
+        """Reference Topology.scala:183-202."""
+        self._tensorboard = (log_dir, app_name)
+
+    def set_checkpoint(self, path, over_write=True):
+        """Reference Topology.scala:245-255."""
+        self._checkpoint = (path, over_write)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        """Reference Topology.scala (clipping setters ~:168-181)."""
+        self._grad_clip = ("l2norm", float(clip_norm))
+
+    def set_constant_gradient_clipping(self, min_value, max_value):
+        self._grad_clip = ("const", float(min_value), float(max_value))
+
+    def clear_gradient_clipping(self):
+        self._grad_clip = None
+
+    def _make_estimator(self):
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+        self._require_compiled()
+        est = Estimator(
+            self,
+            optimizer=self._compiled["optimizer"],
+            loss=self._compiled["loss"],
+            metrics=self._compiled["metrics"],
+            grad_clip=self._grad_clip,
+            tensorboard=self._tensorboard,
+            checkpoint=self._checkpoint,
+        )
+        return est
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10,
+            validation_data=None, distributed=True, sample_weight=None):
+        """Train (reference ``fit`` Topology.scala:418-431 →
+        InternalDistriOptimizer.train Topology.scala:1076-1259)."""
+        from analytics_zoo_tpu.feature.dataset import FeatureSet
+
+        train_set = FeatureSet.of(x, y, sample_weight=sample_weight)
+        val_set = (FeatureSet.of(*validation_data)
+                   if validation_data is not None else None)
+        if self._estimator is None:
+            self._estimator = self._make_estimator()
+        self._estimator.train(
+            train_set, batch_size=batch_size, nb_epoch=nb_epoch,
+            validation_set=val_set,
+        )
+        return self
+
+    def evaluate(self, x, y=None, batch_size=32):
+        """Reference ``evaluate`` Topology.scala:472-501; returns a dict of
+        metric name -> value (loss always included)."""
+        from analytics_zoo_tpu.feature.dataset import FeatureSet
+
+        if self._estimator is None:
+            self._estimator = self._make_estimator()
+        return self._estimator.evaluate(
+            FeatureSet.of(x, y), batch_size=batch_size
+        )
+
+    def predict(self, x, batch_size=32, distributed=True):
+        """Distributed inference (reference ``predict`` Topology.scala:511-547
+        → Predictor.scala:155-189: broadcast + per-partition batching; here:
+        jitted forward over batches sharded across the mesh)."""
+        from analytics_zoo_tpu.feature.dataset import FeatureSet
+
+        self.build_params()
+        ctx = get_zoo_context()
+        fs = FeatureSet.of(x)
+        n = fs.num_samples
+
+        fwd = jax.jit(
+            lambda p, s, xb: self.forward(p, xb, state=s, training=False)[0]
+        )
+        outs = []
+        for batch in fs.batches(batch_size, shuffle=False, drop_last=False,
+                                pad_to_batch=ctx.data_parallel_size):
+            xb = ctx.shard_batch(batch["x"])
+            out = fwd(self.params, self.state, xb)
+            outs.append(np.asarray(out))
+        full = np.concatenate(outs, axis=0)[:n]
+        return full
+
+    def predict_classes(self, x, batch_size=32, zero_based_label=True):
+        """Reference ``predictClasses`` (Topology.scala:549+)."""
+        probs = self.predict(x, batch_size)
+        cls = np.argmax(probs, axis=-1)
+        return cls if zero_based_label else cls + 1
+
+    # ------------------------------------------------------------------
+    # weights / persistence
+    # ------------------------------------------------------------------
+    def get_weights(self):
+        self.build_params()
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.build_params()
+        jax.tree_util.tree_map(lambda a, b: None, self.params, weights)
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def save_weights(self, path, over_write=True):
+        self.build_params()
+        if os.path.exists(path) and not over_write:
+            raise IOError(f"{path} exists and over_write=False")
+        flat, treedef = jax.tree_util.tree_flatten((self.params, self.state))
+        np.savez(path, treedef=np.frombuffer(
+            pickle.dumps(treedef), dtype=np.uint8),
+            **{str(i): np.asarray(a) for i, a in enumerate(flat)})
+
+    def load_weights(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz",
+                       allow_pickle=False)
+        treedef = pickle.loads(data["treedef"].tobytes())
+        flat = [data[str(i)] for i in range(len(data.files) - 1)]
+        self.params, self.state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in flat]
+        )
+
+    def save(self, path, over_write=True):
+        """Whole-model save (reference ZooModel.saveModel /
+        KerasNet.saveModule): config + weights in one pickle."""
+        if os.path.exists(path) and not over_write:
+            raise IOError(f"{path} exists and over_write=False")
+        est, self._estimator = self._estimator, None
+        compiled, self._compiled = self._compiled, None
+        try:
+            weights = (
+                jax.tree_util.tree_map(np.asarray, (self.params, self.state))
+                if self.params is not None else None
+            )
+            params, state = self.params, self.state
+            self.params = self.state = None
+            try:
+                with open(path, "wb") as f:
+                    pickle.dump({"net": self, "weights": weights}, f)
+            finally:
+                self.params, self.state = params, state
+        finally:
+            self._estimator, self._compiled = est, compiled
+
+    @staticmethod
+    def load(path) -> "KerasNet":
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        net = blob["net"]
+        if blob["weights"] is not None:
+            net.params, net.state = jax.tree_util.tree_map(
+                jnp.asarray, blob["weights"]
+            )
+        return net
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def layers(self) -> list[Layer]:
+        raise NotImplementedError
+
+    def summary(self, line_length: int = 100):
+        """Layer table like the reference's ``summary()``
+        (Topology.scala KerasNet.summary)."""
+        lines = []
+        lines.append("_" * line_length)
+        lines.append(f"{'Layer (type)':<44}{'Output Shape':<28}{'Param #':<12}")
+        lines.append("=" * line_length)
+        total = 0
+        for layer in self.layers:
+            if isinstance(layer, InputLayer):
+                shape, count = layer._build_shape, 0
+            else:
+                try:
+                    shape = layer.compute_output_shape(
+                        (None,) + tuple(layer._build_shape or ())
+                    )
+                except Exception:
+                    shape = "?"
+                count = layer.param_count() if layer.built else 0
+            total += count
+            name = f"{layer.name} ({type(layer).__name__})"
+            lines.append(f"{name:<44}{str(shape):<28}{count:<12}")
+        lines.append("=" * line_length)
+        lines.append(f"Total params: {total:,}")
+        lines.append("_" * line_length)
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Sequential(KerasNet):
+    """Linear stack of layers (reference Sequential,
+    Topology.scala:825-959)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self._layers: list[Layer] = []
+        self._output_shape = None  # batch-less
+
+    @property
+    def layers(self):
+        return self._layers
+
+    def add(self, layer: Layer) -> "Sequential":
+        if not self._layers:
+            in_shape = layer._input_shape
+            if in_shape is None and not layer.built:
+                raise ValueError(
+                    "first layer needs input_shape=..., as in the reference "
+                    "Sequential API"
+                )
+        else:
+            in_shape = self._output_shape
+        layer.ensure_built(in_shape)
+        out_full = layer.compute_output_shape((None,) + tuple(in_shape or ()))
+        self._output_shape = tuple(out_full[1:])
+        self._layers.append(layer)
+        self.params = None  # invalidate materialized params
+        return self
+
+    def build(self, input_shape):
+        pass  # layers build incrementally in add()
+
+    @property
+    def stateful(self):
+        return True
+
+    def get_output_shape(self):
+        return (None,) + tuple(self._output_shape or ())
+
+    def get_input_shape(self):
+        if not self._layers:
+            return None
+        first = self._layers[0]
+        return (None,) + tuple(first._build_shape or ())
+
+    def init_params(self, rng):
+        params = {}
+        for i, layer in enumerate(self._layers):
+            p = (layer.init_params(jax.random.fold_in(rng, i))
+                 if not isinstance(layer, (InputLayer,))
+                 else {})
+            if isinstance(layer, KerasNet):
+                p = layer.init_params(jax.random.fold_in(rng, i))
+            if p:
+                params[layer.name] = p
+        return params
+
+    def init_state(self):
+        state = {}
+        for layer in self._layers:
+            s = layer.init_state()
+            if s:
+                state[layer.name] = s
+        return state
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        state = state or {}
+        new_state = dict(state)
+        y = inputs
+        for i, layer in enumerate(self._layers):
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            y, s = layer.apply(
+                params.get(layer.name, {}), y,
+                state=new_state.get(layer.name),
+                training=training, rng=lrng,
+            )
+            if s is not None:
+                new_state[layer.name] = s
+        return y, new_state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(self._output_shape)
+
+    def ensure_built(self, input_shape):
+        # Built incrementally; verify compatibility.
+        self.built = True
+        self._build_shape = input_shape
+        return input_shape
+
+
+class Model(KerasNet):
+    """Graph model from symbolic inputs/outputs (reference Model,
+    Topology.scala:602-759)."""
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        outputs = output if isinstance(output, (list, tuple)) else [output]
+        for v in list(inputs) + list(outputs):
+            if not isinstance(v, Variable):
+                raise TypeError("Model(input, output) takes symbolic "
+                                "Variables from Input(...)")
+        self._graph = GraphFunction(inputs, outputs)
+        self.built = True
+        self._build_shape = [tuple(v.shape[1:]) for v in inputs]
+        if len(self._build_shape) == 1:
+            self._build_shape = self._build_shape[0]
+        self._output_vars = outputs
+
+    @property
+    def layers(self):
+        return self._graph.layers
+
+    @property
+    def stateful(self):
+        return True
+
+    def get_output_shape(self):
+        shapes = [v.shape for v in self._graph.outputs]
+        return shapes[0] if len(shapes) == 1 else shapes
+
+    def get_input_shape(self):
+        shapes = [v.shape for v in self._graph.inputs]
+        return shapes[0] if len(shapes) == 1 else shapes
+
+    def init_params(self, rng):
+        params, _ = self._graph.init(rng)
+        return params
+
+    def init_state(self):
+        _, state = self._graph.init(jax.random.PRNGKey(0))
+        return state
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return self._graph(params, inputs, state=state, training=training,
+                           rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        shapes = [v.shape for v in self._graph.outputs]
+        return shapes[0] if len(shapes) == 1 else shapes
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional merge helper (reference Merge.scala / ``merge`` in
+    keras API).  Takes symbolic Variables."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge
+
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)

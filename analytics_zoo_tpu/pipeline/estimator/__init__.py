@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.pipeline.estimator.estimator import (  # noqa: F401
+    Estimator,
+)
+from analytics_zoo_tpu.pipeline.estimator.local import (  # noqa: F401
+    LocalEstimator,
+)

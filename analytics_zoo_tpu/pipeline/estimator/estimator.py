@@ -1,0 +1,440 @@
+"""Estimator — the distributed training core.
+
+TPU-native re-design of the reference's training stack:
+
+- ``Estimator.train/evaluate`` facade (reference
+  zoo/.../pipeline/estimator/Estimator.scala:65-183),
+- ``InternalDistriOptimizer.train`` — the distributed driver
+  (Topology.scala:1076-1259).
+
+The reference's per-iteration machinery is two Spark jobs: (1) each task
+forward/backwards its partition slice on core-local model replicas; (2)
+gradient slices are shuffled to owner tasks, updated, and broadcast back
+through the block manager (docs/docs/wp-bigdl.md:148-164).  Here the whole
+iteration is ONE jit-compiled SPMD program: the global batch arrives sharded
+over the mesh ``data`` axis, XLA partitions the forward/backward per chip,
+inserts a reduce-scatter/all-gather (the ``psum``) over ICI for the gradient,
+and fuses the optimizer update — donated buffers, so weights update in place
+in HBM.
+
+Also re-implemented with exact-state semantics instead of best-effort:
+
+- triggers for validation/checkpoint (ZooTrigger),
+- gradient clipping (constant / L2-norm, Topology.scala clipping setters),
+- checkpoint + resume including the *data iterator* position,
+- the retry-from-checkpoint failure loop (Topology.scala:1171-1253,
+  ``bigdl.failure.retryTimes`` default 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+import time
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.engine import ZooContext, get_zoo_context
+from analytics_zoo_tpu.common.triggers import (
+    EveryEpoch,
+    MaxEpoch,
+    TrainingState,
+    ZooTrigger,
+)
+from analytics_zoo_tpu.feature.dataset import FeatureSet
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+RETRY_TIMES = int(os.environ.get("ZOO_FAILURE_RETRY_TIMES", "5"))
+
+
+def _clip_grads(grads, grad_clip):
+    if grad_clip is None:
+        return grads
+    kind = grad_clip[0]
+    if kind == "const":
+        _, lo, hi = grad_clip
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), grads)
+    if kind == "l2norm":
+        _, max_norm = grad_clip
+        leaves = jax.tree_util.tree_leaves(grads)
+        norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                            for g in leaves))
+        scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    raise ValueError(f"unknown grad clip {grad_clip!r}")
+
+
+@dataclasses.dataclass
+class _Checkpointer:
+    """Snapshot (params, opt_state, model state, step/epoch, iterator pos).
+
+    Role of BigDL's ``model.<iter>`` + ``optimMethod.<iter>`` snapshots
+    (Topology.scala:245-255), plus data-iterator state the reference never
+    checkpointed (its RDD iterators restart from scratch on resume).
+    """
+
+    path: str
+    over_write: bool = True
+    keep: int = 3
+
+    def save(self, tag: str, payload: dict) -> str:
+        os.makedirs(self.path, exist_ok=True)
+        host = jax.tree_util.tree_map(np.asarray, payload)
+        fname = os.path.join(self.path, f"ckpt-{tag}.pkl")
+        tmp = fname + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(host, f)
+        os.replace(tmp, fname)
+        self._gc()
+        return fname
+
+    def _gc(self):
+        files = self.list()
+        for f in files[:-self.keep]:
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+
+    def list(self) -> list[str]:
+        if not os.path.isdir(self.path):
+            return []
+        files = [os.path.join(self.path, f) for f in os.listdir(self.path)
+                 if f.startswith("ckpt-") and f.endswith(".pkl")]
+        return sorted(files, key=os.path.getmtime)
+
+    def latest(self) -> dict | None:
+        """Reference ``getLatestFile`` (Topology.scala:1511-1528)."""
+        files = self.list()
+        if not files:
+            return None
+        with open(files[-1], "rb") as f:
+            return pickle.load(f)
+
+
+class Estimator:
+    """Train/evaluate a KerasNet-like model on a device mesh.
+
+    Reference: Estimator.scala:65-183 (facade) driving
+    InternalDistriOptimizer (Topology.scala:1076-1259).
+    """
+
+    def __init__(self, model, optimizer=None, loss=None, metrics=None,
+                 model_dir: str | None = None, grad_clip=None,
+                 tensorboard=None, checkpoint=None,
+                 ctx: ZooContext | None = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = list(metrics or [])
+        self.grad_clip = grad_clip
+        self.ctx = ctx or get_zoo_context()
+        self._ckpt = None
+        ckpt_path = None
+        if checkpoint is not None:
+            ckpt_path, over_write = checkpoint
+            self._ckpt = _Checkpointer(ckpt_path, over_write)
+        elif model_dir:
+            self._ckpt = _Checkpointer(model_dir)
+        self._writers = None
+        if tensorboard is not None:
+            log_dir, app_name = tensorboard
+            from analytics_zoo_tpu.tensorboard import (
+                TrainSummary,
+                ValidationSummary,
+            )
+            self._writers = (
+                TrainSummary(log_dir, app_name),
+                ValidationSummary(log_dir, app_name),
+            )
+        # training state
+        self.global_step = 0
+        self.epoch = 1
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self.history: list[dict] = []
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+    def _build_train_step(self):
+        model, loss_fn = self.model, self.loss
+        opt, grad_clip = self.optimizer, self.grad_clip
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, opt_state, state, rng, batch):
+            def loss_of(p):
+                preds, new_state = model.forward(
+                    p, batch["x"], state=state, training=True, rng=rng
+                )
+                l = loss_fn.mean(batch.get("y"), preds, batch.get("w"))
+                return l, new_state
+
+            (l, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            # With the batch sharded over the `data` axis and params
+            # replicated, XLA partitions this program SPMD and inserts the
+            # gradient all-reduce (reduce-scatter + all-gather over ICI) —
+            # the role of BigDL's AllReduceParameter (Topology.scala:1119).
+            grads = _clip_grads(grads, grad_clip)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, l
+
+        return train_step
+
+    def _build_eval_step(self):
+        model, loss_fn, metrics = self.model, self.loss, self.metrics
+
+        @jax.jit
+        def eval_step(params, state, batch):
+            preds, _ = model.forward(params, batch["x"], state=state,
+                                     training=False)
+            stats = []
+            if loss_fn is not None and "y" in batch:
+                per = loss_fn(batch["y"], preds)
+                stats.append((jnp.sum(per),
+                              jnp.asarray(per.shape[0], jnp.float32)))
+            for m in metrics:
+                stats.append(m.batch_stats(batch["y"], preds))
+            return stats
+
+        return eval_step
+
+    # ------------------------------------------------------------------
+    # train (InternalDistriOptimizer.train, Topology.scala:1076-1259)
+    # ------------------------------------------------------------------
+    def train(self, train_set: FeatureSet, batch_size: int = 32,
+              nb_epoch: int | None = None,
+              end_trigger: ZooTrigger | None = None,
+              checkpoint_trigger: ZooTrigger | None = None,
+              validation_set: FeatureSet | None = None,
+              validation_trigger: ZooTrigger | None = None,
+              seed: int | None = None):
+        ctx = self.ctx
+        dp = ctx.data_parallel_size
+        if batch_size % dp != 0:
+            # The TFDataset contract (tf_dataset.py:136-143): global batch
+            # must divide evenly across model replicas.
+            raise ValueError(
+                f"batch_size ({batch_size}) must be a multiple of the "
+                f"data-parallel size ({dp})"
+            )
+        if end_trigger is None:
+            end_trigger = MaxEpoch(nb_epoch if nb_epoch is not None else 10)
+        if checkpoint_trigger is None and self._ckpt is not None:
+            checkpoint_trigger = EveryEpoch()
+        if validation_set is not None and validation_trigger is None:
+            validation_trigger = EveryEpoch()
+        seed = ctx.seed if seed is None else seed
+
+        params, state = self.model.build_params()
+        opt_state = self.optimizer.init(params)
+        repl = ctx.replicated()
+        params, opt_state, state = jax.device_put(
+            (params, opt_state, state), repl
+        )
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        step_fn = self._train_step_fn
+
+        start_epoch, start_batch = self.epoch, 0
+        # resume from checkpoint if present (Topology.scala:1220-1242)
+        resumed = self._ckpt.latest() if self._ckpt else None
+        if resumed is not None:
+            params = jax.device_put(resumed["params"], repl)
+            opt_state = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(opt_state),
+                [jnp.asarray(x) for x in resumed["opt_flat"]],
+            )
+            opt_state = jax.device_put(opt_state, repl)
+            state = jax.device_put(resumed["state"], repl)
+            self.global_step = int(resumed["global_step"])
+            start_epoch = int(resumed["epoch"])
+            start_batch = int(resumed["next_batch"])
+            seed = int(resumed["seed"])
+            logger.info("resumed from checkpoint @ step %d (epoch %d.%d)",
+                        self.global_step, start_epoch, start_batch)
+
+        retries = 0
+        while True:
+            try:
+                params, opt_state, state = self._train_loop(
+                    params, opt_state, state, step_fn, train_set,
+                    batch_size, seed, start_epoch, start_batch,
+                    end_trigger, checkpoint_trigger,
+                    validation_set, validation_trigger,
+                )
+                break
+            except (KeyboardInterrupt, ValueError, TypeError):
+                raise
+            except Exception:
+                # retry-from-checkpoint loop (Topology.scala:1171-1253)
+                retries += 1
+                if self._ckpt is None or retries > RETRY_TIMES:
+                    raise
+                logger.exception(
+                    "training failed; retry %d/%d from latest checkpoint",
+                    retries, RETRY_TIMES,
+                )
+                resumed = self._ckpt.latest()
+                if resumed is None:
+                    raise
+                params = jax.device_put(resumed["params"], repl)
+                opt_state = jax.device_put(
+                    jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(opt_state),
+                        [jnp.asarray(x) for x in resumed["opt_flat"]],
+                    ), repl)
+                state = jax.device_put(resumed["state"], repl)
+                self.global_step = int(resumed["global_step"])
+                start_epoch = int(resumed["epoch"])
+                start_batch = int(resumed["next_batch"])
+
+        self.model.params = params
+        self.model.state = state
+        return self
+
+    def _train_loop(self, params, opt_state, state, step_fn, train_set,
+                    batch_size, seed, start_epoch, start_batch,
+                    end_trigger, checkpoint_trigger, validation_set,
+                    validation_trigger):
+        ctx = self.ctx
+        tstate = TrainingState(epoch=start_epoch,
+                               iteration=self.global_step)
+        epoch = start_epoch
+        while not end_trigger(tstate):
+            epoch_t0 = time.perf_counter()
+            n_records = 0
+            batch_iter = train_set.batches(
+                batch_size, shuffle=True, seed=seed, epoch=epoch,
+                drop_last=True, start_batch=start_batch,
+            )
+            loss_dev = None
+            bi = start_batch
+            for batch in batch_iter:
+                sharded = ctx.shard_batch(batch)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(seed), self.global_step
+                )
+                params, opt_state, state, loss_dev = step_fn(
+                    params, opt_state, state, rng, sharded
+                )
+                self.global_step += 1
+                bi += 1
+                n_records += batch_size
+                tstate.iteration = self.global_step
+                tstate.epoch_finished = False
+                fired = self._on_iteration(
+                    tstate, loss_dev, params, opt_state, state,
+                    checkpoint_trigger, validation_set, validation_trigger,
+                    epoch, bi, seed, batch_size,
+                )
+                params, opt_state, state = fired
+            # epoch boundary
+            dt = time.perf_counter() - epoch_t0
+            if loss_dev is not None:
+                tstate.loss = float(loss_dev)
+            throughput = n_records / max(dt, 1e-9)
+            logger.info(
+                "epoch %d done: loss=%.4f, %.1f records/s, step=%d",
+                epoch, tstate.loss if tstate.loss is not None else float("nan"),
+                throughput, self.global_step,
+            )
+            self.history.append(
+                {"epoch": epoch, "loss": tstate.loss,
+                 "throughput": throughput}
+            )
+            if self._writers:
+                self._writers[0].add_scalar(
+                    "Throughput", throughput, self.global_step
+                )
+            tstate.epoch_finished = True
+            epoch += 1
+            tstate.epoch = epoch
+            start_batch = 0
+            params, opt_state, state = self._on_iteration(
+                tstate, loss_dev, params, opt_state, state,
+                checkpoint_trigger, validation_set, validation_trigger,
+                epoch, 0, seed, batch_size,
+            )
+        self.epoch = epoch
+        return params, opt_state, state
+
+    def _on_iteration(self, tstate, loss_dev, params, opt_state, state,
+                      checkpoint_trigger, validation_set,
+                      validation_trigger, epoch, next_batch, seed,
+                      batch_size):
+        if loss_dev is not None and (
+            self._writers or tstate.iteration % 50 == 0
+        ):
+            tstate.loss = float(loss_dev)
+            if self._writers:
+                self._writers[0].add_scalar(
+                    "Loss", tstate.loss, tstate.iteration
+                )
+        if validation_set is not None and validation_trigger is not None \
+                and validation_trigger(tstate):
+            self.model.params, self.model.state = params, state
+            results = self.evaluate(validation_set, batch_size=batch_size)
+            tstate.score = next(
+                (v for k, v in results.items() if k != "loss"),
+                -results.get("loss", 0.0),
+            )
+            logger.info("validation @ step %d: %s", tstate.iteration,
+                        results)
+            if self._writers:
+                for k, v in results.items():
+                    self._writers[1].add_scalar(k, v, tstate.iteration)
+        if checkpoint_trigger is not None and self._ckpt is not None \
+                and checkpoint_trigger(tstate):
+            opt_flat = jax.tree_util.tree_leaves(opt_state)
+            self._ckpt.save(
+                f"{tstate.iteration}",
+                dict(params=params, state=state, opt_flat=opt_flat,
+                     global_step=tstate.iteration, epoch=epoch,
+                     next_batch=next_batch, seed=seed),
+            )
+        return params, opt_state, state
+
+    # ------------------------------------------------------------------
+    # evaluate (Estimator.scala:157-176; KerasNet.evaluate)
+    # ------------------------------------------------------------------
+    def evaluate(self, val_set: FeatureSet, batch_size: int = 32) -> dict:
+        ctx = self.ctx
+        params, state = self.model.build_params()
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        accum = None
+        for batch in val_set.batches(batch_size, shuffle=False,
+                                     drop_last=False,
+                                     pad_to_batch=ctx.data_parallel_size):
+            sharded = ctx.shard_batch(batch)
+            stats = self._eval_step_fn(params, state, sharded)
+            host = [[np.asarray(s) for s in group] for group in stats]
+            if accum is None:
+                accum = host
+            else:
+                accum = [
+                    [a + b for a, b in zip(ga, gb)]
+                    for ga, gb in zip(accum, host)
+                ]
+        results = {}
+        idx = 0
+        if self.loss is not None:
+            num, den = accum[idx]
+            results["loss"] = float(num) / max(float(den), 1e-12)
+            idx += 1
+        for m in self.metrics:
+            results[m.name] = m.finalize(accum[idx])
+            idx += 1
+        return results

@@ -1,0 +1,94 @@
+"""LocalEstimator — single-device trainer without a mesh.
+
+Reference: zoo/.../pipeline/estimator/LocalEstimator.scala:39-211, a
+thread-pool trainer that bypasses Spark (`fit` with parallel forward/backward
+via ThreadPool.invokeAndWait :178-199).  The TPU analogue of "no cluster" is
+"no mesh": one jit-compiled step on the default device.  The thread-pool
+replica parallelism collapses into XLA's own intra-chip parallelism.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.feature.dataset import FeatureSet
+from analytics_zoo_tpu.pipeline.api.keras.metrics import get_metric
+from analytics_zoo_tpu.pipeline.api.keras.objectives import get_loss
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
+
+
+class LocalEstimator:
+    def __init__(self, model, criterion, optimizer, metrics=None):
+        self.model = model
+        self.loss = get_loss(criterion)
+        self.optimizer = get_optimizer(optimizer)
+        self.metrics = [get_metric(m) for m in (metrics or [])]
+
+    def fit(self, x, y, validation_data=None, batch_size=32, epochs=1,
+            seed=0):
+        model, loss_fn, opt = self.model, self.loss, self.optimizer
+        params, state = model.build_params()
+        opt_state = opt.init(params)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, rng, bx, by):
+            def loss_of(p):
+                preds, new_state = model.forward(p, bx, state=state,
+                                                 training=True, rng=rng)
+                return loss_fn.mean(by, preds), new_state
+
+            (l, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, l
+
+        fs = FeatureSet.of(x, y)
+        it = 0
+        history = []
+        for epoch in range(epochs):
+            last = None
+            for batch in fs.batches(batch_size, shuffle=True, seed=seed,
+                                    epoch=epoch):
+                rng = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+                params, opt_state, state, last = step(
+                    params, opt_state, state, rng,
+                    jnp.asarray(batch["x"]), jnp.asarray(batch["y"]),
+                )
+                it += 1
+            history.append(float(last) if last is not None else None)
+        model.params, model.state = params, state
+        self.history = history
+        return self
+
+    def evaluate(self, x, y, batch_size=32):
+        model = self.model
+        params, state = model.build_params()
+
+        @jax.jit
+        def fwd(params, state, bx):
+            return model.forward(params, bx, state=state, training=False)[0]
+
+        fs = FeatureSet.of(x, y)
+        accums = [None] * (len(self.metrics) + 1)
+        for batch in fs.batches(batch_size, shuffle=False, drop_last=False):
+            preds = fwd(params, state, jnp.asarray(batch["x"]))
+            by = jnp.asarray(batch["y"])
+            per = self.loss(by, preds)
+            stats = [(jnp.sum(per), jnp.asarray(per.shape[0], jnp.float32))]
+            stats += [m.batch_stats(by, preds) for m in self.metrics]
+            for i, s in enumerate(stats):
+                host = [np.asarray(v) for v in s]
+                accums[i] = host if accums[i] is None else [
+                    a + b for a, b in zip(accums[i], host)
+                ]
+        out = {"loss": float(accums[0][0]) / max(float(accums[0][1]), 1e-12)}
+        for m, acc in zip(self.metrics, accums[1:]):
+            out[m.name] = m.finalize(acc)
+        return out

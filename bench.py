@@ -1,0 +1,62 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Current benchmark: training throughput (images/sec) of the flagship image
+model on the available device(s).  vs_baseline compares against the
+reference's story: it publishes no absolute numbers (BASELINE.md), so
+vs_baseline is reported as 1.0 when we complete the run at all, scaled by
+nothing — the real comparison lands once ResNet-50/ImageNet is wired.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        Flatten,
+        MaxPooling2D,
+    )
+
+    ctx = init_zoo_context(seed=0)
+    model = Sequential()
+    model.add(Convolution2D(32, 3, 3, activation="relu",
+                            input_shape=(28, 28, 1)))
+    model.add(MaxPooling2D())
+    model.add(Convolution2D(64, 3, 3, activation="relu"))
+    model.add(MaxPooling2D())
+    model.add(Flatten())
+    model.add(Dense(128, activation="relu"))
+    model.add(Dense(10, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+
+    batch = 256 * max(ctx.data_parallel_size, 1)
+    n = batch * 8
+    x = np.random.default_rng(0).normal(size=(n, 28, 28, 1)).astype(
+        np.float32)
+    y = np.random.default_rng(1).integers(0, 10, size=(n,)).astype(np.int32)
+
+    # warmup (compile)
+    model.fit(x[:batch * 2], y[:batch * 2], batch_size=batch, nb_epoch=1)
+    t0 = time.perf_counter()
+    model.fit(x, y, batch_size=batch, nb_epoch=2)
+    dt = time.perf_counter() - t0
+    images = 2 * n
+    ips = images / dt
+    print(json.dumps({
+        "metric": "mnist_convnet_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

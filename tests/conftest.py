@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device CPU mesh so all psum/pjit/sharding code
+paths run without TPUs — the analogue of the reference's local[4] Spark
+testing strategy (SURVEY.md §4: pyzoo/test/zoo/pipeline/utils/test_utils.py
+sets sparkConf local[4])."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax  # noqa: E402
+
+# The axon TPU plugin in this image ignores JAX_PLATFORMS; the config knob
+# is honored.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def zoo_ctx():
+    from analytics_zoo_tpu import init_zoo_context
+
+    return init_zoo_context(seed=42)
+
+
+@pytest.fixture()
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)
