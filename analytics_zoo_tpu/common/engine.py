@@ -73,7 +73,10 @@ class ZooContext:
         return NamedSharding(self.mesh, P())
 
     def batch_sharding(self, ndim: int) -> NamedSharding:
-        """Shard the leading (batch) dim over the data axis, replicate rest."""
+        """Shard the leading (batch) dim over the data axis, replicate rest.
+        Scalars (ndim 0) are replicated."""
+        if ndim == 0:
+            return self.replicated()
         return NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
 
     def shard_batch(self, tree):

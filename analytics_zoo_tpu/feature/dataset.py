@@ -110,16 +110,22 @@ def _batch_from_arrays(xs, ys, ws, idx, pad_to=None):
         batch["y"] = take(ys)
     if ws is not None:
         batch["w"] = take(ws)
-    if pad_to is not None and len(idx) % pad_to != 0:
-        pad = pad_to - len(idx) % pad_to
+    if pad_to is not None:
+        # Padded rows are marked via n_valid so evaluation masks them out
+        # (they must not bias loss/metric denominators).
+        n_valid = len(idx)
+        if n_valid % pad_to != 0:
+            pad = pad_to - n_valid % pad_to
 
-        def pad_fn(v):
-            if isinstance(v, list):
-                return [pad_fn(a) for a in v]
-            reps = np.concatenate([v, np.repeat(v[-1:], pad, axis=0)], axis=0)
-            return reps
+            def pad_fn(v):
+                if isinstance(v, list):
+                    return [pad_fn(a) for a in v]
+                return np.concatenate(
+                    [v, np.repeat(v[-1:], pad, axis=0)], axis=0
+                )
 
-        batch = {k: pad_fn(v) for k, v in batch.items()}
+            batch = {k: pad_fn(v) for k, v in batch.items()}
+        batch["n_valid"] = np.asarray(n_valid, np.int32)
     return batch
 
 
@@ -201,6 +207,15 @@ class ShardedFeatureSet(FeatureSet):
         rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
         shard_order = (rng.permutation(len(self.paths)) if shuffle
                        else np.arange(len(self.paths)))
+        def concat(a, b_):
+            if isinstance(a, list):
+                return [concat(x1, x2) for x1, x2 in zip(a, b_)]
+            return np.concatenate([a, b_], axis=0)
+
+        def blen(batch):
+            v = batch["x"]
+            return len(v[0]) if isinstance(v, list) else len(v)
+
         b = 0
         leftover = None
         for si in shard_order:
@@ -212,16 +227,12 @@ class ShardedFeatureSet(FeatureSet):
             order = rng.permutation(n) if shuffle else np.arange(n)
             pos = 0
             if leftover is not None:
-                need = batch_size - len(leftover)
+                need = batch_size - blen(leftover)
                 idx = order[:need]
-                merged = {
-                    k: np.concatenate(
-                        [leftover[k],
-                         _batch_from_arrays(xs, ys, ws, idx)[k]], axis=0)
-                    for k in leftover
-                }
+                fresh = _batch_from_arrays(xs, ys, ws, idx)
+                merged = {k: concat(leftover[k], fresh[k]) for k in leftover}
                 pos = need
-                if len(merged["x"]) == batch_size:
+                if blen(merged) == batch_size:
                     if b >= start_batch:
                         yield merged
                     b += 1
@@ -238,11 +249,20 @@ class ShardedFeatureSet(FeatureSet):
             if pos < n:
                 leftover = _batch_from_arrays(xs, ys, ws, order[pos:])
         if leftover is not None and not drop_last:
-            yield _batch_from_arrays(
-                _as_list(leftover["x"]),
-                _as_list(leftover.get("y")),
-                _as_list(leftover.get("w")),
-                np.arange(len(leftover["x"])), pad_to_batch)
+            if pad_to_batch is not None:
+                n_valid = blen(leftover)
+                pad = (-n_valid) % pad_to_batch
+
+                def pad_fn(v):
+                    if isinstance(v, list):
+                        return [pad_fn(a) for a in v]
+                    return np.concatenate(
+                        [v, np.repeat(v[-1:], pad, axis=0)], axis=0
+                    ) if pad else v
+
+                leftover = {k: pad_fn(v) for k, v in leftover.items()}
+                leftover["n_valid"] = np.asarray(n_valid, np.int32)
+            yield leftover
 
 
 class TransformedFeatureSet(FeatureSet):

@@ -241,6 +241,11 @@ class Layer:
 
     def __init__(self, input_shape=None, name: str | None = None, **kwargs):
         cls = type(self).__name__.lower()
+        # Auto-named layers are canonically renamed when adopted by a
+        # container (position-based), so param-tree keys depend only on model
+        # structure — not on how many models were built earlier in the
+        # process.  Checkpoints therefore resume across fresh processes.
+        self._auto_named = name is None
         self.name = name or unique_name(cls)
         self.built = False
         self._weight_specs: list[WeightSpec] = []
@@ -378,6 +383,33 @@ class _ContainerBase(Layer):
 # ---------------------------------------------------------------------------
 
 
+def canonicalize_names(layers: Sequence["Layer"]) -> None:
+    """Rename auto-named layers to position-based canonical names within a
+    container (``dense_0``, ``dense_1``, ... in adoption order).  Must run
+    before params are materialized."""
+    taken = {l.name for l in layers if not l._auto_named}
+    counters: dict[str, int] = collections.defaultdict(int)
+    for layer in layers:
+        if not layer._auto_named:
+            continue
+        cls = type(layer).__name__.lower()
+        while True:
+            cand = f"{cls}_{counters[cls]}"
+            counters[cls] += 1
+            if cand not in taken:
+                break
+        layer.name = cand
+        taken.add(cand)
+        layer._auto_named = False
+    names = [l.name for l in layers]
+    if len(names) != len(set(names)):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ValueError(
+            f"duplicate layer names in one container: {dupes}; rename the "
+            "layers (layers adopted from different containers can collide)"
+        )
+
+
 def topological_nodes(outputs: Sequence[Variable]) -> list[Node]:
     """Topologically sorted nodes reaching ``outputs`` (inputs first)."""
     order: list[Node] = []
@@ -409,11 +441,12 @@ class GraphFunction:
         self.outputs = list(outputs)
         self.nodes = topological_nodes(self.outputs)
         self.layers: list[Layer] = []
-        names = set()
+        seen_layers = set()
         for node in self.nodes:
-            if node.layer.name not in names:
-                names.add(node.layer.name)
+            if id(node.layer) not in seen_layers:
+                seen_layers.add(id(node.layer))
                 self.layers.append(node.layer)
+        canonicalize_names(self.layers)
         input_ids = {id(v) for v in self.inputs}
         for node in self.nodes:
             if isinstance(node.layer, InputLayer):

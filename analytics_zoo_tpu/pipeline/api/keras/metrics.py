@@ -21,8 +21,12 @@ class Metric:
     #: number of scalar accumulators this metric produces
     n_stats = 2
 
-    def batch_stats(self, y_true, y_pred):
-        """Return a tuple of scalars to accumulate (device side)."""
+    def batch_stats(self, y_true, y_pred, mask=None):
+        """Return a tuple of scalars to accumulate (device side).
+
+        ``mask`` is an optional (batch,) 0/1 array marking real (non-padded)
+        rows; padded rows must not bias numerators or denominators.
+        """
         raise NotImplementedError
 
     def finalize(self, stats) -> float:
@@ -35,6 +39,15 @@ def _match_binary(y_true, y_pred):
     return (pred == y_true.astype(jnp.int32)).astype(jnp.float32)
 
 
+def _masked_num_den(correct, mask):
+    """Sum/count of per-element values, zeroing padded batch rows."""
+    if mask is None:
+        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+    m = mask.reshape((mask.shape[0],) + (1,) * (correct.ndim - 1))
+    m = jnp.broadcast_to(m, correct.shape)
+    return jnp.sum(correct * m), jnp.sum(m)
+
+
 class Accuracy(Metric):
     """Auto-dispatching accuracy like the reference's ``Accuracy``
     (keras/metrics/Accuracy.scala): binary if the prediction is scalar,
@@ -42,7 +55,7 @@ class Accuracy(Metric):
 
     name = "accuracy"
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         if y_pred.ndim >= 1 and y_pred.shape[-1] > 1:
             pred = jnp.argmax(y_pred, axis=-1)
             if y_true.ndim == y_pred.ndim:
@@ -55,7 +68,7 @@ class Accuracy(Metric):
             yp = y_pred[..., 0] if y_pred.ndim > 1 else y_pred
             yt = y_true[..., 0] if y_true.ndim > 1 else y_true
             correct = _match_binary(yt, yp)
-        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+        return _masked_num_den(correct, mask)
 
 
 class SparseCategoricalAccuracy(Accuracy):
@@ -72,12 +85,11 @@ class BinaryAccuracy(Metric):
     def __init__(self, threshold: float = 0.5):
         self.threshold = threshold
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         yp = y_pred.reshape(y_pred.shape[0], -1)
         yt = y_true.reshape(y_true.shape[0], -1).astype(jnp.int32)
         correct = ((yp > self.threshold).astype(jnp.int32) == yt)
-        correct = correct.astype(jnp.float32)
-        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+        return _masked_num_den(correct.astype(jnp.float32), mask)
 
 
 class Top5Accuracy(Metric):
@@ -85,7 +97,7 @@ class Top5Accuracy(Metric):
 
     name = "top5_accuracy"
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         true = y_true
         if true.ndim == y_pred.ndim:
             true = jnp.argmax(true, axis=-1) if true.shape[-1] > 1 \
@@ -93,24 +105,21 @@ class Top5Accuracy(Metric):
         true = true.astype(jnp.int32)
         top5 = jnp.argsort(y_pred, axis=-1)[..., -5:]
         correct = jnp.any(top5 == true[..., None], axis=-1)
-        correct = correct.astype(jnp.float32)
-        return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+        return _masked_num_den(correct.astype(jnp.float32), mask)
 
 
 class MAE(Metric):
     name = "mae"
 
-    def batch_stats(self, y_true, y_pred):
-        err = jnp.abs(y_pred - y_true)
-        return jnp.sum(err), jnp.asarray(err.size, jnp.float32)
+    def batch_stats(self, y_true, y_pred, mask=None):
+        return _masked_num_den(jnp.abs(y_pred - y_true), mask)
 
 
 class MSE(Metric):
     name = "mse"
 
-    def batch_stats(self, y_true, y_pred):
-        err = (y_pred - y_true) ** 2
-        return jnp.sum(err), jnp.asarray(err.size, jnp.float32)
+    def batch_stats(self, y_true, y_pred, mask=None):
+        return _masked_num_den((y_pred - y_true) ** 2, mask)
 
 
 class Loss(Metric):
@@ -123,11 +132,9 @@ class Loss(Metric):
         self.loss_fn = loss_fn
         self.name = "loss"
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
         per_sample = self.loss_fn(y_true, y_pred)
-        return jnp.sum(per_sample), jnp.asarray(
-            per_sample.shape[0], jnp.float32
-        )
+        return _masked_num_den(per_sample, mask)
 
 
 class AUC(Metric):
@@ -141,16 +148,23 @@ class AUC(Metric):
     def __init__(self, thresholds: int = 200):
         self.thresholds = np.linspace(0.0, 1.0, thresholds)
 
-    def batch_stats(self, y_true, y_pred):
+    def batch_stats(self, y_true, y_pred, mask=None):
+        b = y_pred.shape[0]
+        per_row = max(1, int(np.prod(y_pred.shape)) // max(b, 1))
         yp = y_pred.reshape(-1)
         yt = y_true.reshape(-1)
+        if mask is None:
+            w = jnp.ones_like(yp)
+        else:
+            w = jnp.repeat(mask.astype(yp.dtype), per_row)
         th = jnp.asarray(self.thresholds)[:, None]
         pred_pos = (yp[None, :] >= th)
         pos = (yt[None, :] > 0.5)
-        tp = jnp.sum(pred_pos & pos, axis=1).astype(jnp.float32)
-        fp = jnp.sum(pred_pos & ~pos, axis=1).astype(jnp.float32)
-        fn = jnp.sum(~pred_pos & pos, axis=1).astype(jnp.float32)
-        tn = jnp.sum(~pred_pos & ~pos, axis=1).astype(jnp.float32)
+        wf = w[None, :]
+        tp = jnp.sum(pred_pos * pos * wf, axis=1)
+        fp = jnp.sum(pred_pos * (1 - pos) * wf, axis=1)
+        fn = jnp.sum((1 - pred_pos) * pos * wf, axis=1)
+        tn = jnp.sum((1 - pred_pos) * (1 - pos) * wf, axis=1)
         return tp, fp, fn, tn
 
     def finalize(self, stats) -> float:

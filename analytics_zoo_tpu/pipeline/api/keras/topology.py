@@ -35,6 +35,7 @@ from analytics_zoo_tpu.pipeline.api.keras.engine import (
     Layer,
     Variable,
     _ContainerBase,
+    canonicalize_names,
 )
 
 
@@ -313,6 +314,7 @@ class Sequential(KerasNet):
         out_full = layer.compute_output_shape((None,) + tuple(in_shape or ()))
         self._output_shape = tuple(out_full[1:])
         self._layers.append(layer)
+        canonicalize_names(self._layers)
         self.params = None  # invalidate materialized params
         return self
 
@@ -335,11 +337,9 @@ class Sequential(KerasNet):
     def init_params(self, rng):
         params = {}
         for i, layer in enumerate(self._layers):
-            p = (layer.init_params(jax.random.fold_in(rng, i))
-                 if not isinstance(layer, (InputLayer,))
-                 else {})
-            if isinstance(layer, KerasNet):
-                p = layer.init_params(jax.random.fold_in(rng, i))
+            if isinstance(layer, InputLayer):
+                continue
+            p = layer.init_params(jax.random.fold_in(rng, i))
             if p:
                 params[layer.name] = p
         return params

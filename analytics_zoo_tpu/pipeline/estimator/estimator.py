@@ -199,13 +199,22 @@ class Estimator:
         def eval_step(params, state, batch):
             preds, _ = model.forward(params, batch["x"], state=state,
                                      training=False)
+            n_valid = batch.get("n_valid")
+            mask = None
+            if n_valid is not None:
+                b = preds.shape[0] if not isinstance(preds, list) \
+                    else preds[0].shape[0]
+                mask = (jnp.arange(b) < n_valid).astype(jnp.float32)
             stats = []
             if loss_fn is not None and "y" in batch:
                 per = loss_fn(batch["y"], preds)
-                stats.append((jnp.sum(per),
-                              jnp.asarray(per.shape[0], jnp.float32)))
+                if mask is not None:
+                    stats.append((jnp.sum(per * mask), jnp.sum(mask)))
+                else:
+                    stats.append((jnp.sum(per),
+                                  jnp.asarray(per.shape[0], jnp.float32)))
             for m in metrics:
-                stats.append(m.batch_stats(batch["y"], preds))
+                stats.append(m.batch_stats(batch["y"], preds, mask=mask))
             return stats
 
         return eval_step
@@ -384,8 +393,11 @@ class Estimator:
                 )
         if validation_set is not None and validation_trigger is not None \
                 and validation_trigger(tstate):
-            self.model.params, self.model.state = params, state
-            results = self.evaluate(validation_set, batch_size=batch_size)
+            # NOTE: do NOT attach the live buffers to the model here — the
+            # next train step donates them, which would leave model.params
+            # pointing at deleted arrays.
+            results = self._evaluate_with(params, state, validation_set,
+                                          batch_size=batch_size)
             tstate.score = next(
                 (v for k, v in results.items() if k != "loss"),
                 -results.get("loss", 0.0),
@@ -410,8 +422,12 @@ class Estimator:
     # evaluate (Estimator.scala:157-176; KerasNet.evaluate)
     # ------------------------------------------------------------------
     def evaluate(self, val_set: FeatureSet, batch_size: int = 32) -> dict:
-        ctx = self.ctx
         params, state = self.model.build_params()
+        return self._evaluate_with(params, state, val_set, batch_size)
+
+    def _evaluate_with(self, params, state, val_set: FeatureSet,
+                       batch_size: int = 32) -> dict:
+        ctx = self.ctx
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
         accum = None

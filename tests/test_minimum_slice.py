@@ -115,6 +115,50 @@ def test_save_load_roundtrip(zoo_ctx, tmp_path):
     np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
 
 
+def test_evaluate_padding_unbiased(zoo_ctx):
+    """Padded rows in the last eval batch must not bias loss/metrics."""
+    x, y = make_blobs(n=130)  # 130 % 64 = 2 → last batch padded to 8
+    model = Sequential()
+    model.add(Dense(4, activation="softmax", input_shape=(12,)))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    res = model.evaluate(x, y, batch_size=64)
+
+    # manual reference with numpy
+    probs = model.predict(x, batch_size=64)
+    eps = 1e-7
+    ll = -np.log(np.clip(probs[np.arange(130), y], eps, 1.0))
+    acc = float(np.mean(np.argmax(probs, -1) == y))
+    np.testing.assert_allclose(res["loss"], ll.mean(), rtol=1e-4)
+    np.testing.assert_allclose(res["accuracy"], acc, rtol=1e-6)
+
+
+def test_fit_with_validation(zoo_ctx):
+    x, y = make_blobs(n=256)
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(12,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, nb_epoch=5,
+              validation_data=(x[:100], y[:100]))
+    # model usable after training with validation enabled (no deleted
+    # donated buffers)
+    preds = model.predict(x[:10], batch_size=64)
+    assert preds.shape == (10, 4)
+
+
+def test_duplicate_layer_names_rejected(zoo_ctx):
+    a = Sequential()
+    a.add(Dense(4, input_shape=(3,)))
+    b = Sequential()
+    b.add(Dense(4, input_shape=(3,)))
+    c = Sequential()
+    c.add(a.layers[0])
+    with pytest.raises(ValueError, match="duplicate layer names"):
+        c.add(b.layers[0])
+
+
 def test_summary_runs(zoo_ctx):
     model = Sequential()
     model.add(Dense(16, input_shape=(12,)))
