@@ -1,0 +1,63 @@
+"""Attention ops — the single entry point every attention layer routes
+through, so kernel upgrades (Pallas flash attention, ring attention over the
+``seq`` mesh axis) swap in under one signature.
+
+Reference behavior being covered: the O(L²) ``multiHeadSelfAttention`` inside
+TransformerLayer.scala:137 and BERT.scala's attention with additive mask.
+The reference materializes the full (L, L) score matrix per head on CPU; here
+the default path is a blockwise-friendly jnp einsum that XLA fuses, and the
+hot path can be served by a Pallas kernel (ops/pallas) on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
+                          causal=False, scale=None):
+    """Batched multi-head attention.
+
+    Args:
+      q, k, v: (B, H, L, D) arrays.
+      mask: optional additive mask broadcastable to (B, H, Lq, Lk) — 0 for
+        keep, large-negative for drop (reference BERT attention_mask
+        convention) — or a boolean mask (True = keep).
+      dropout_p: attention-prob dropout (reference attnPDrop).
+      causal: lower-triangular masking (reference TransformerLayer
+        bidirectional=false path).
+      scale: score scale; defaults to 1/sqrt(D).
+    """
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        scores = jnp.where(causal_mask, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def split_heads(x, n_heads):
+    """(B, L, H*D) -> (B, H, L, D)."""
+    b, l, hd = x.shape
+    d = hd // n_heads
+    return x.reshape(b, l, n_heads, d).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x):
+    """(B, H, L, D) -> (B, L, H*D)."""
+    b, h, l, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, l, h * d)

@@ -1,0 +1,518 @@
+"""Autograd facade — Variable math, AutoGrad ops, CustomLoss, Lambda,
+Parameter.
+
+Reference: pipeline/api/autograd/math.scala (AutoGrad ops :32-363, Variable
+operator overloads :365-612), KerasParameter.scala:31-100 (``Parameter``
+trainable leaf), CustomLoss.scala (Variable expr → Criterion), Lambda.scala
+(Variable expr → layer).  The reference builds BigDL graph nodes and relies
+on BigDL's hand-written backward passes.
+
+TPU re-design: a ``Variable`` is a symbolic tensor over the same Node graph
+the Keras Model uses (engine.Variable); every op here appends a pure-jnp
+``LambdaOp`` node.  Differentiation is ``jax.grad`` through the traced
+graph — no per-op backward code at all, which is the whole point of building
+on a functional-AD substrate.
+
+Example (reference-style custom loss, autograd/math.scala mean/abs):
+
+    def mean_absolute_error(y_true, y_pred):
+        result = AutoGrad.mean(AutoGrad.abs(y_true - y_pred), axis=1)
+        return result
+    model.compile(optimizer=..., loss=CustomLoss(mean_absolute_error,
+                                                 [3], [3]))
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    Layer,
+    Node,
+    Variable,
+)
+from analytics_zoo_tpu.pipeline.api.keras.objectives import LossFunction
+
+
+class LambdaOp(Layer):
+    """A pure-jnp op node in the symbolic graph."""
+
+    def __init__(self, fn: Callable, out_shape_fn: Callable, op_name="op",
+                 name=None):
+        super().__init__(name=name)
+        self.fn = fn
+        self.out_shape_fn = out_shape_fn
+        self.built = True
+        self._build_shape = None
+
+    def ensure_built(self, input_shape):
+        self._build_shape = input_shape
+        return input_shape
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if isinstance(inputs, (list, tuple)):
+            return self.fn(*inputs)
+        return self.fn(inputs)
+
+    def compute_output_shape(self, input_shape):
+        return self.out_shape_fn(input_shape)
+
+    def param_count(self):
+        return 0
+
+
+def _apply_op(fn, shape_fn, op_name, *variables):
+    """Apply an op: symbolically (Variable inputs → LambdaOp graph node) or
+    eagerly (array inputs → call fn directly).  Eager dispatch lets the same
+    AutoGrad functions run inside CustomLoss bodies, where arguments are jax
+    tracers, matching how the reference's AutoGrad ops are used both in
+    Lambda graphs and custom losses."""
+    if any(isinstance(v, Variable) for v in variables):
+        op = LambdaOp(fn, shape_fn, op_name=op_name)
+        return op(list(variables) if len(variables) > 1 else variables[0])
+    return fn(*variables)
+
+
+def _full_shape(v) -> tuple:
+    return v.shape if isinstance(v, Variable) else tuple(np.shape(v))
+
+
+def _broadcast_shapes(a, b):
+    """Numpy-style broadcast of symbolic shapes (None = unknown/batch)."""
+    out = []
+    ra, rb = list(a)[::-1], list(b)[::-1]
+    for i in range(max(len(ra), len(rb))):
+        da = ra[i] if i < len(ra) else 1
+        db = rb[i] if i < len(rb) else 1
+        if da is None or db is None:
+            out.append(None)
+        elif da == 1:
+            out.append(db)
+        elif db == 1 or da == db:
+            out.append(da)
+        else:
+            raise ValueError(f"cannot broadcast {a} and {b}")
+    return tuple(out[::-1])
+
+
+def _binop(name, fn):
+    def op(self, other):
+        if isinstance(other, Variable):
+            shape = _broadcast_shapes(self.shape, other.shape)
+            return _apply_op(fn, lambda s: shape, name, self, other)
+        const = other
+
+        def unary(x):
+            return fn(x, const)
+
+        return _apply_op(unary, lambda s: s, name, self)
+
+    return op
+
+
+def _rbinop(name, fn):
+    def op(self, other):
+        const = other
+
+        def unary(x):
+            return fn(const, x)
+
+        return _apply_op(unary, lambda s: s, name, self)
+
+    return op
+
+
+# -- install operators on the shared symbolic Variable class ---------------
+Variable.__add__ = _binop("add", lambda a, b: a + b)
+Variable.__radd__ = _rbinop("radd", lambda a, b: a + b)
+Variable.__sub__ = _binop("sub", lambda a, b: a - b)
+Variable.__rsub__ = _rbinop("rsub", lambda a, b: a - b)
+Variable.__mul__ = _binop("mul", lambda a, b: a * b)
+Variable.__rmul__ = _rbinop("rmul", lambda a, b: a * b)
+Variable.__truediv__ = _binop("div", lambda a, b: a / b)
+Variable.__rtruediv__ = _rbinop("rdiv", lambda a, b: a / b)
+Variable.__pow__ = _binop("pow", lambda a, b: a ** b)
+Variable.__neg__ = lambda self: _apply_op(
+    lambda x: -x, lambda s: s, "neg", self
+)
+
+
+def _slice_shape(shape, dim, start, length):
+    s = list(shape)
+    s[dim] = length
+    return tuple(s)
+
+
+def _variable_slice(self, dim, start_index, length):
+    """Reference Variable.slice (autograd/math.scala)."""
+
+    def fn(x):
+        idx = [slice(None)] * x.ndim
+        idx[dim] = slice(start_index, start_index + length)
+        return x[tuple(idx)]
+
+    return _apply_op(fn, lambda s: _slice_shape(s, dim, start_index, length),
+                     "slice", self)
+
+
+def _variable_index_select(self, dim, index):
+    """Reference Variable.indexSelect: select one index along dim (dim may
+    be negative; batch dim = 0)."""
+
+    def fn(x):
+        return jnp.take(x, index, axis=dim)
+
+    def shape_fn(s):
+        s = list(s)
+        d = dim if dim >= 0 else len(s) + dim
+        del s[d]
+        return tuple(s)
+
+    return _apply_op(fn, shape_fn, "index_select", self)
+
+
+def _variable_squeeze(self, dim):
+    def fn(x):
+        return jnp.squeeze(x, axis=dim)
+
+    def shape_fn(s):
+        s = list(s)
+        d = dim if dim >= 0 else len(s) + dim
+        del s[d]
+        return tuple(s)
+
+    return _apply_op(fn, shape_fn, "squeeze", self)
+
+
+Variable.slice = _variable_slice
+Variable.index_select = _variable_index_select
+Variable.squeeze = _variable_squeeze
+
+
+class AutoGrad:
+    """Namespace of autograd math ops (reference ``AutoGrad`` object,
+    autograd/math.scala:32-363)."""
+
+    @staticmethod
+    def abs(x: Variable) -> Variable:
+        return _apply_op(jnp.abs, lambda s: s, "abs", x)
+
+    @staticmethod
+    def sum(x: Variable, axis=0, keepdims=False) -> Variable:
+        return AutoGrad._reduce(jnp.sum, x, axis, keepdims)
+
+    @staticmethod
+    def mean(x: Variable, axis=0, keepdims=False) -> Variable:
+        return AutoGrad._reduce(jnp.mean, x, axis, keepdims)
+
+    @staticmethod
+    def _reduce(fn, x, axis, keepdims):
+        def run(v):
+            return fn(v, axis=axis, keepdims=keepdims)
+
+        def shape_fn(s):
+            s = list(s)
+            d = axis if axis >= 0 else len(s) + axis
+            if keepdims:
+                s[d] = 1
+            else:
+                del s[d]
+            return tuple(s)
+
+        return _apply_op(run, shape_fn, "reduce", x)
+
+    @staticmethod
+    def clip(x: Variable, min, max) -> Variable:
+        return _apply_op(lambda v: jnp.clip(v, min, max), lambda s: s,
+                         "clip", x)
+
+    @staticmethod
+    def square(x: Variable) -> Variable:
+        return _apply_op(jnp.square, lambda s: s, "square", x)
+
+    @staticmethod
+    def sqrt(x: Variable) -> Variable:
+        return _apply_op(jnp.sqrt, lambda s: s, "sqrt", x)
+
+    @staticmethod
+    def exp(x: Variable) -> Variable:
+        return _apply_op(jnp.exp, lambda s: s, "exp", x)
+
+    @staticmethod
+    def log(x: Variable) -> Variable:
+        return _apply_op(jnp.log, lambda s: s, "log", x)
+
+    @staticmethod
+    def pow(x: Variable, a: float) -> Variable:
+        return _apply_op(lambda v: v ** a, lambda s: s, "pow", x)
+
+    @staticmethod
+    def epsilon() -> float:
+        return 1e-7
+
+    @staticmethod
+    def maximum(x, y):
+        if isinstance(y, Variable):
+            return _apply_op(jnp.maximum,
+                             lambda s: s, "maximum", x, y)
+        return _apply_op(lambda v: jnp.maximum(v, y), lambda s: s,
+                         "maximum", x)
+
+    @staticmethod
+    def erf(x: Variable) -> Variable:
+        return _apply_op(jax.scipy.special.erf, lambda s: s, "erf", x)
+
+    @staticmethod
+    def softsign(x: Variable) -> Variable:
+        return _apply_op(jax.nn.soft_sign, lambda s: s, "softsign", x)
+
+    @staticmethod
+    def softplus(x: Variable) -> Variable:
+        return _apply_op(jax.nn.softplus, lambda s: s, "softplus", x)
+
+    @staticmethod
+    def l2_normalize(x: Variable, axis=-1) -> Variable:
+        def fn(v):
+            return v / jnp.clip(
+                jnp.linalg.norm(v, axis=axis, keepdims=True), 1e-12
+            )
+
+        return _apply_op(fn, lambda s: s, "l2_normalize", x)
+
+    @staticmethod
+    def mm(x: Variable, y: Variable, axes=None) -> Variable:
+        """Batched matrix multiply contracting ``axes=[ax_of_x, ax_of_y]``
+        (reference AutoGrad.mm, autograd/math.scala).  Default contracts
+        x's last axis with y's second-to-last (plain matmul)."""
+
+        def fn(a, b):
+            if axes is None:
+                return jnp.matmul(a, b)
+            aa = jnp.moveaxis(a, axes[0], -1)
+            bb = jnp.moveaxis(b, axes[1], -1)
+            if aa.ndim == 3 and bb.ndim == 3:
+                return jnp.einsum("bid,bjd->bij", aa, bb)
+            if aa.ndim == 2 and bb.ndim == 2:
+                return jnp.einsum("id,jd->ij", aa, bb)
+            raise ValueError(
+                f"mm supports 2-3D inputs with axes; got {a.shape}, "
+                f"{b.shape}"
+            )
+
+        def shape_fn(shapes):
+            sa, sb = [list(s) for s in shapes]
+            if axes is None:
+                return tuple(sa[:-1]) + (sb[-1],)
+            ax = axes[0] % len(sa)
+            ay = axes[1] % len(sb)
+            da = [d for i, d in enumerate(sa) if i != ax]
+            db = [d for i, d in enumerate(sb) if i != ay]
+            if len(sa) == 3:
+                return (sa[0], da[1], db[1])
+            return (da[0], db[0])
+
+        return _apply_op(fn, shape_fn, "mm", x, y)
+
+    @staticmethod
+    def batch_dot(x: Variable, y: Variable, axes=(2, 2),
+                  normalize=False) -> Variable:
+        """Reference AutoGrad.batchDot: per-sample contraction over ``axes``
+        for 3-D inputs (B, I, D)·(B, J, D) → (B, I, J); with
+        ``normalize=True`` rows are l2-normalized first (cosine)."""
+        ax, ay = axes
+
+        def fn(a, b):
+            if a.ndim != 3 or b.ndim != 3:
+                raise ValueError(
+                    f"batch_dot expects 3-D inputs, got {a.shape}, {b.shape}"
+                )
+            aa, bb = a, b
+            if normalize:
+                aa = aa / jnp.clip(
+                    jnp.linalg.norm(aa, axis=ax, keepdims=True), 1e-12)
+                bb = bb / jnp.clip(
+                    jnp.linalg.norm(bb, axis=ay, keepdims=True), 1e-12)
+            aa = jnp.moveaxis(aa, ax, -1)
+            bb = jnp.moveaxis(bb, ay, -1)
+            return jnp.einsum("bid,bjd->bij", aa, bb)
+
+        def shape_fn(shapes):
+            sa, sb = [list(s) for s in shapes]
+            d_a = [d for i, d in enumerate(sa) if i not in (0, ax % len(sa))]
+            d_b = [d for i, d in enumerate(sb) if i not in (0, ay % len(sb))]
+            return tuple([sa[0]] + d_a + d_b)
+
+        return _apply_op(fn, shape_fn, "batch_dot", x, y)
+
+    @staticmethod
+    def contiguous(x: Variable) -> Variable:
+        return x
+
+    @staticmethod
+    def expand_dims(x: Variable, axis) -> Variable:
+        def shape_fn(s):
+            s = list(s)
+            d = axis if axis >= 0 else len(s) + 1 + axis
+            s.insert(d, 1)
+            return tuple(s)
+
+        return _apply_op(lambda v: jnp.expand_dims(v, axis), shape_fn,
+                         "expand_dims", x)
+
+    @staticmethod
+    def stack(inputs: Sequence[Variable], axis=1) -> Variable:
+        def fn(*xs):
+            return jnp.stack(xs, axis=axis)
+
+        def shape_fn(shapes):
+            s = list(shapes[0])
+            s.insert(axis if axis >= 0 else len(s) + 1 + axis, len(inputs))
+            return tuple(s)
+
+        return _apply_op(fn, shape_fn, "stack", *inputs)
+
+
+# convenience module-level aliases (reference exposes both forms)
+mean = AutoGrad.mean
+abs = AutoGrad.abs  # noqa: A001 - mirrors reference API name
+sum = AutoGrad.sum  # noqa: A001
+clip = AutoGrad.clip
+square = AutoGrad.square
+sqrt = AutoGrad.sqrt
+exp = AutoGrad.exp
+log = AutoGrad.log
+maximum = AutoGrad.maximum
+l2_normalize = AutoGrad.l2_normalize
+mm = AutoGrad.mm
+batch_dot = AutoGrad.batch_dot
+erf = AutoGrad.erf
+epsilon = AutoGrad.epsilon
+expand_dims = AutoGrad.expand_dims
+stack = AutoGrad.stack
+
+
+class Parameter(Layer):
+    """Trainable leaf tensor (reference KerasParameter.scala:31-100):
+    a Variable whose value is learned.  Call it with no inputs in a graph by
+    using it as a symbolic source: ``w = Parameter((3, 4))(); y = x + w``."""
+
+    def __init__(self, shape, init_weight=None, init="glorot_uniform",
+                 trainable=True, name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.shape = tuple(int(s) for s in shape)
+        self.init = init
+        self.init_weight = init_weight
+        self.trainable = trainable
+
+    def build(self, input_shape):
+        if self.init_weight is not None:
+            from analytics_zoo_tpu.pipeline.api.keras.layers.embedding \
+                import _Pretrained
+
+            w = np.asarray(self.init_weight)
+            if tuple(w.shape) != self.shape:
+                raise ValueError(
+                    f"Parameter init_weight shape {w.shape} != declared "
+                    f"shape {self.shape}"
+                )
+            self.add_weight("value", self.shape, _Pretrained(w),
+                            trainable=self.trainable)
+        else:
+            self.add_weight("value", self.shape, self.init,
+                            trainable=self.trainable)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if "value" in params:
+            return params["value"]
+        return state["value"], state
+
+    @property
+    def stateful(self):
+        return not self.trainable
+
+    def __call__(self, x=None):
+        """Symbolic: yields a Variable carrying the parameter value.
+        Needs an anchor input only for graph reachability; pass any graph
+        Variable or none (the node has no inbound edges)."""
+        if x is not None:
+            return super().__call__(x)
+        self.ensure_built(None)
+        var = Variable(None, 0, (None,) + self.shape, name=self.name)
+        node = Node(self, [], [var])
+        var.node = node
+        return var
+
+    def compute_output_shape(self, input_shape):
+        return (None,) + self.shape
+
+
+class Lambda(Layer):
+    """Wrap a python function over Variables into a layer (reference
+    Lambda.scala / pyzoo autograd.Lambda)."""
+
+    def __init__(self, function: Callable, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.function = function
+        self.built = True
+
+    def ensure_built(self, input_shape):
+        self._build_shape = input_shape
+        return input_shape
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if isinstance(inputs, (list, tuple)):
+            return self.function(*inputs)
+        return self.function(inputs)
+
+    def compute_output_shape(self, input_shape):
+        # evaluate the function on dummies to infer the shape
+        shapes = input_shape if isinstance(input_shape, list) \
+            else [input_shape]
+        dummies = [jnp.zeros([1 if d is None else d for d in s])
+                   for s in shapes]
+        out = jax.eval_shape(
+            lambda *xs: self.function(*xs)
+            if len(dummies) > 1 else self.function(xs[0]), *dummies
+        )
+        shape = tuple(out.shape)
+        return (None,) + shape[1:]
+
+
+class CustomLoss(LossFunction):
+    """Build a loss from a python function over (y_true, y_pred) Variables
+    or plain jnp arrays (reference CustomLoss.scala; pyzoo
+    autograd.CustomLoss).
+
+    The reference requires explicit sizeAverage handling and builds a BigDL
+    criterion graph; here the function runs under jax tracing directly.
+    ``loss_fn(y_true, y_pred)`` may return per-sample or scalar values.
+    """
+
+    def __init__(self, loss_fn: Callable, y_pred_shape=None,
+                 y_true_shape=None):
+        self.user_fn = loss_fn
+        super().__init__(self._run, "custom_loss")
+
+    def _run(self, y_true, y_pred):
+        out = self.user_fn(y_true, y_pred)
+        if isinstance(out, Variable):
+            raise TypeError(
+                "CustomLoss function must use jnp ops on its array "
+                "arguments (it is traced by jax), not symbolic Variables"
+            )
+        out = jnp.asarray(out)
+        if out.ndim == 0:
+            return out[None]
+        if out.ndim > 1:
+            return out.reshape(out.shape[0], -1).mean(axis=-1)
+        return out
+
+    def forward(self, y_true, y_pred):
+        """Evaluate the loss eagerly (reference CustomLoss.forward)."""
+        return float(jnp.mean(self._run(jnp.asarray(y_true),
+                                        jnp.asarray(y_pred))))

@@ -44,8 +44,36 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
     ZeroPadding2D,
     ZeroPadding3D,
 )
+from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (  # noqa: F401
+    Embedding,
+)
 from analytics_zoo_tpu.pipeline.api.keras.layers.merge import (  # noqa: F401
     Merge,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (  # noqa: F401
+    BatchNormalization,
+    LayerNormalization,
+    WithinChannelLRN2D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.advanced import (  # noqa: F401
+    ELU,
+    LeakyReLU,
+    ParametricSoftPlus,
+    PReLU,
+    SReLU,
+    ThresholdedReLU,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (  # noqa: F401
+    GRU,
+    LSTM,
+    Bidirectional,
+    ConvLSTM2D,
+    SimpleRNN,
+    TimeDistributed,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention import (  # noqa: F401
+    BERT,
+    TransformerLayer,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (  # noqa: F401
     AveragePooling1D,

@@ -1,0 +1,90 @@
+"""Advanced activation layers — reference
+pipeline/api/keras/layers/{LeakyReLU,ELU,PReLU,SReLU,ThresholdedReLU,
+ParametricSoftPlus}.scala.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.where(inputs >= 0, inputs, self.alpha * inputs)
+
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.where(inputs >= 0, inputs,
+                         self.alpha * jnp.expm1(inputs))
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, theta=1.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.where(inputs > self.theta, inputs, 0.0)
+
+
+class PReLU(Layer):
+    """Per-channel learnable leak (reference PReLU.scala)."""
+
+    def build(self, input_shape):
+        self.add_weight("alpha", (int(input_shape[-1]),), 0.25)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        a = params["alpha"]
+        return jnp.where(inputs >= 0, inputs, a * inputs)
+
+
+class ParametricSoftPlus(Layer):
+    """alpha * softplus(beta * x) with learnable alpha/beta (reference
+    ParametricSoftPlus.scala)."""
+
+    def __init__(self, alpha_init=0.2, beta_init=5.0, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.alpha_init = float(alpha_init)
+        self.beta_init = float(beta_init)
+
+    def build(self, input_shape):
+        ch = int(input_shape[-1])
+        self.add_weight("alpha", (ch,), self.alpha_init)
+        self.add_weight("beta", (ch,), self.beta_init)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return params["alpha"] * jax.nn.softplus(params["beta"] * inputs)
+
+
+class SReLU(Layer):
+    """S-shaped ReLU with four learnable per-channel params (reference
+    SReLU.scala)."""
+
+    def build(self, input_shape):
+        ch = int(input_shape[-1])
+        self.add_weight("t_left", (ch,), "zero")
+        self.add_weight("a_left", (ch,), "glorot_uniform")
+        self.add_weight("t_right", (ch,), "glorot_uniform")
+        self.add_weight("a_right", (ch,), "one")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y_left = tl + al * (inputs - tl)
+        y_right = tr + ar * (inputs - tr)
+        return jnp.where(
+            inputs < tl, y_left, jnp.where(inputs > tr, y_right, inputs)
+        )

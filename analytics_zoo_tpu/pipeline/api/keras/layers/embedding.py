@@ -1,0 +1,73 @@
+"""Embedding layers.
+
+Reference: pipeline/api/keras/layers/Embedding.scala (LookupTable wrapper,
+optional pretrained weights + trainable flag), SparseEmbedding.scala,
+WordEmbedding (pretrained GloVe loader in the text pipeline).
+
+TPU notes: embedding lookup is ``jnp.take`` — XLA lowers it to a dynamic
+gather that stays on-device; the embedding matrix can be sharded over the
+``model`` axis for very large vocabularies (hook left in the parallel pkg).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, get_initializer
+
+
+class Embedding(Layer):
+    """(batch, seq)[int] -> (batch, seq, output_dim).
+
+    Reference Embedding.scala: ``Embedding(inputDim, outputDim, init,
+    weights, trainable)``; zero_based indices.
+    """
+
+    def __init__(self, input_dim, output_dim, init="uniform", weights=None,
+                 trainable=True, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.init = init
+        self.pretrained = weights
+        self.trainable = trainable
+        self._config = dict(input_dim=input_dim, output_dim=output_dim)
+
+    def build(self, input_shape):
+        if self.pretrained is not None:
+            w = np.asarray(self.pretrained)
+            assert w.shape == (self.input_dim, self.output_dim), (
+                f"pretrained weights shape {w.shape} != "
+                f"{(self.input_dim, self.output_dim)}"
+            )
+            init = _Pretrained(w)
+        else:
+            init = self.init
+        self.add_weight("embeddings", (self.input_dim, self.output_dim),
+                        init, trainable=self.trainable)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        table = params.get("embeddings")
+        if table is None:  # non-trainable → lives in state
+            table = state["embeddings"]
+            out = jnp.take(table, inputs.astype(jnp.int32), axis=0)
+            return out, state
+        return jnp.take(table, inputs.astype(jnp.int32), axis=0)
+
+    @property
+    def stateful(self):
+        return not self.trainable
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class _Pretrained:
+    """Picklable initializer that returns fixed pretrained weights."""
+
+    def __init__(self, w):
+        self.w = np.asarray(w)
+
+    def __call__(self, rng, shape, dtype):
+        return jnp.asarray(self.w, dtype)

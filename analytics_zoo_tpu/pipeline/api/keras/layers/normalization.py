@@ -1,0 +1,125 @@
+"""Normalization layers.
+
+Reference: pipeline/api/keras/layers/BatchNormalization.scala (BigDL
+SpatialBatchNormalization wrapper), LayerNorm inside TransformerLayer.scala
+(reference has no standalone LayerNormalization layer; exposed here because
+the transformer stack needs it as a first-class piece).
+
+TPU notes: with the batch sharded over the ``data`` mesh axis, the batch-stat
+reductions below become *global* cross-replica means — XLA inserts the psum —
+so this is synchronized BatchNorm across the whole mesh by construction.  The
+reference could only do per-worker BN (its sync happened at gradient
+aggregation only); sync-BN is what the resnet example's
+``EngineRef.getCoreNumber`` replication approximated.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+class BatchNormalization(Layer):
+    """Channels-last batch norm over all non-channel axes.
+
+    Reference BatchNormalization.scala (momentum/epsilon defaults match:
+    momentum=0.99, epsilon=1e-3).
+    """
+
+    def __init__(self, epsilon=1e-3, momentum=0.99, beta_init="zero",
+                 gamma_init="one", scale=True, center=True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.scale = scale
+        self.center = center
+        self.beta_init = beta_init
+        self.gamma_init = gamma_init
+        self._config = dict(epsilon=epsilon, momentum=momentum)
+
+    def build(self, input_shape):
+        ch = int(input_shape[-1])
+        if self.scale:
+            self.add_weight("gamma", (ch,), self.gamma_init)
+        if self.center:
+            self.add_weight("beta", (ch,), self.beta_init)
+        self.add_state("moving_mean", (ch,), "zero")
+        self.add_state("moving_var", (ch,), "one")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        axes = tuple(range(inputs.ndim - 1))
+        state = state or self.init_state()
+        if training:
+            # Sharded batch ⇒ these are global-mesh reductions (sync BN).
+            mean = jnp.mean(inputs, axis=axes)
+            var = jnp.var(inputs, axis=axes)
+            m = self.momentum
+            new_state = {
+                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+                "moving_var": m * state["moving_var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["moving_mean"], state["moving_var"]
+            new_state = state
+        y = (inputs - mean) * jnp.reciprocal(
+            jnp.sqrt(var + self.epsilon)
+        )
+        if self.scale:
+            y = y * params["gamma"]
+        if self.center:
+            y = y + params["beta"]
+        return y, new_state
+
+    @property
+    def stateful(self):
+        return True
+
+
+class LayerNormalization(Layer):
+    """Layer norm over the last axis (reference: the internal ``LayerNorm``
+    used by TransformerLayer.scala / BERT.scala ``gelu``+LN blocks)."""
+
+    def __init__(self, epsilon=1e-5, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.epsilon = float(epsilon)
+
+    def build(self, input_shape):
+        d = int(input_shape[-1])
+        self.add_weight("gamma", (d,), "one")
+        self.add_weight("beta", (d,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        mean = jnp.mean(inputs, axis=-1, keepdims=True)
+        var = jnp.var(inputs, axis=-1, keepdims=True)
+        y = (inputs - mean) * jax_rsqrt(var + self.epsilon)
+        return y * params["gamma"] + params["beta"]
+
+
+def jax_rsqrt(x):
+    return jnp.reciprocal(jnp.sqrt(x))
+
+
+class WithinChannelLRN2D(Layer):
+    """Local response normalization within channels (reference
+    WithinChannelLRN2D.scala), NHWC."""
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.size = int(size)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        from jax import lax
+
+        sq = inputs * inputs
+        window = (1, self.size, self.size, 1)
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add, window, (1, 1, 1, 1), "SAME"
+        )
+        norm = (1.0 + self.alpha * summed / (self.size * self.size)) \
+            ** self.beta
+        return inputs / norm

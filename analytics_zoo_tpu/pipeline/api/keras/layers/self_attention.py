@@ -1,0 +1,252 @@
+"""Transformer layers — TransformerLayer (GPT-style decoder stack) and BERT.
+
+Reference: pipeline/api/keras/layers/TransformerLayer.scala:56 (embedding +
+position embedding + n_block blocks; ``multiHeadSelfAttention`` :137 builds
+the full O(L²) attention via Conv1D projections) and BERT.scala:66 (adds
+token-type embeddings and an additive attention mask; pooler on [CLS]).
+
+TPU re-design: projections are single fused (D, 3D) matmuls on the MXU;
+attention routes through :func:`analytics_zoo_tpu.ops.attention.
+dot_product_attention` so the Pallas flash kernel / ring-attention (seq-axis
+sharded) variants swap in without touching this layer.  Long-context support
+(absent in the reference, SURVEY.md §5) is a mesh-axis concern handled in
+``analytics_zoo_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.attention import (
+    dot_product_attention,
+    merge_heads,
+    split_heads,
+)
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    Layer,
+    get_initializer,
+)
+
+
+def _dense_init(rng, shape, std):
+    return std * jax.random.normal(rng, shape)
+
+
+class _TransformerCore(Layer):
+    """Shared block stack for TransformerLayer and BERT."""
+
+    def __init__(self, n_block, n_head, hidden_size, intermediate_size=None,
+                 hidden_drop=0.1, attn_drop=0.1, initializer_range=0.02,
+                 bidirectional=False, activation="gelu", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.n_block = int(n_block)
+        self.n_head = int(n_head)
+        self.hidden_size = int(hidden_size)
+        self.intermediate_size = int(intermediate_size or 4 * hidden_size)
+        self.hidden_drop = float(hidden_drop)
+        self.attn_drop = float(attn_drop)
+        self.initializer_range = float(initializer_range)
+        self.bidirectional = bool(bidirectional)
+        from analytics_zoo_tpu.ops.activations import get_activation
+
+        self.act = get_activation(activation)
+
+    # -- param construction (nested; overrides the flat-spec default) ------
+    def _block_params(self, rng):
+        d, m = self.hidden_size, self.intermediate_size
+        std = self.initializer_range
+        ks = jax.random.split(rng, 6)
+        return {
+            "qkv_kernel": _dense_init(ks[0], (d, 3 * d), std),
+            "qkv_bias": jnp.zeros((3 * d,)),
+            "proj_kernel": _dense_init(ks[1], (d, d), std),
+            "proj_bias": jnp.zeros((d,)),
+            "ln1_gamma": jnp.ones((d,)), "ln1_beta": jnp.zeros((d,)),
+            "fc_kernel": _dense_init(ks[2], (d, m), std),
+            "fc_bias": jnp.zeros((m,)),
+            "out_kernel": _dense_init(ks[3], (m, d), std),
+            "out_bias": jnp.zeros((d,)),
+            "ln2_gamma": jnp.ones((d,)), "ln2_beta": jnp.zeros((d,)),
+        }
+
+    @staticmethod
+    def _ln(x, gamma, beta, eps=1e-5):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps)) * gamma \
+            + beta
+
+    def _drop(self, x, p, training, rng, salt):
+        if not training or p <= 0.0 or rng is None:
+            return x
+        key = jax.random.fold_in(rng, salt)
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+
+    def _run_blocks(self, blocks, h, mask, training, rng):
+        for bi, bp in enumerate(blocks):
+            brng = jax.random.fold_in(rng, bi) if rng is not None else None
+            qkv = h @ bp["qkv_kernel"] + bp["qkv_bias"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = split_heads(q, self.n_head)
+            k = split_heads(k, self.n_head)
+            v = split_heads(v, self.n_head)
+            a = dot_product_attention(
+                q, k, v, mask=mask,
+                dropout_p=self.attn_drop if training else 0.0,
+                rng=(jax.random.fold_in(brng, 3)
+                     if brng is not None else None),
+                causal=not self.bidirectional,
+            )
+            a = merge_heads(a) @ bp["proj_kernel"] + bp["proj_bias"]
+            a = self._drop(a, self.hidden_drop, training, brng, 1)
+            h = self._ln(h + a, bp["ln1_gamma"], bp["ln1_beta"])
+            f = self.act(h @ bp["fc_kernel"] + bp["fc_bias"])
+            f = f @ bp["out_kernel"] + bp["out_bias"]
+            f = self._drop(f, self.hidden_drop, training, brng, 2)
+            h = self._ln(h + f, bp["ln2_gamma"], bp["ln2_beta"])
+        return h
+
+
+class TransformerLayer(_TransformerCore):
+    """GPT-style stack (reference TransformerLayer.scala:56).
+
+    Inputs: ``[tokens, positions]`` int arrays of shape (B, L)
+    (matching the reference's two-input contract), output (B, L, D).
+    """
+
+    def __init__(self, vocab, seq_len, n_block=12, n_head=12,
+                 hidden_size=768, embedding_drop=0.1, **kwargs):
+        super().__init__(n_block=n_block, n_head=n_head,
+                         hidden_size=hidden_size, **kwargs)
+        self.vocab = int(vocab)
+        self.seq_len = int(seq_len)
+        self.embedding_drop = float(embedding_drop)
+
+    @classmethod
+    def init_with_default_params(cls, vocab, seq_len, n_block=12, n_head=12,
+                                 hidden_size=768, **kwargs):
+        """Reference companion-object constructor."""
+        return cls(vocab, seq_len, n_block, n_head, hidden_size, **kwargs)
+
+    def build(self, input_shape):
+        pass  # params are nested; built in init_params
+
+    def init_params(self, rng):
+        std = self.initializer_range
+        ks = jax.random.split(rng, 2 + self.n_block)
+        return {
+            "tok_embed": _dense_init(ks[0], (self.vocab, self.hidden_size),
+                                     std),
+            "pos_embed": _dense_init(ks[1],
+                                     (self.seq_len, self.hidden_size), std),
+            "blocks": [self._block_params(ks[2 + i])
+                       for i in range(self.n_block)],
+        }
+
+    def param_count(self):
+        d, m, v = self.hidden_size, self.intermediate_size, self.vocab
+        per_block = 3 * d * d + 3 * d + d * d + d + 2 * d * m + m + d + 4 * d
+        return v * d + self.seq_len * d + self.n_block * per_block
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if isinstance(inputs, (list, tuple)):
+            tokens, positions = inputs[0], inputs[1]
+        else:
+            tokens = inputs
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        h = jnp.take(params["tok_embed"], tokens.astype(jnp.int32), axis=0)
+        h = h + jnp.take(params["pos_embed"], positions.astype(jnp.int32),
+                         axis=0)
+        h = self._drop(h, self.embedding_drop, training, rng, 0)
+        return self._run_blocks(params["blocks"], h, None, training, rng)
+
+    def compute_output_shape(self, input_shape):
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
+        return tuple(input_shape) + (self.hidden_size,)
+
+
+class BERT(_TransformerCore):
+    """BERT encoder (reference BERT.scala:66).
+
+    Inputs: ``[token_ids, token_type_ids, position_ids, attention_mask]``
+    (the reference's four-input contract); outputs ``[sequence_output,
+    pooled_output]``.
+    """
+
+    def __init__(self, vocab=40990, hidden_size=768, n_block=12, n_head=12,
+                 seq_len=512, intermediate_size=3072, hidden_p_drop=0.1,
+                 attn_p_drop=0.1, type_vocab=2, **kwargs):
+        super().__init__(n_block=n_block, n_head=n_head,
+                         hidden_size=hidden_size,
+                         intermediate_size=intermediate_size,
+                         hidden_drop=hidden_p_drop, attn_drop=attn_p_drop,
+                         bidirectional=True, **kwargs)
+        self.vocab = int(vocab)
+        self.seq_len = int(seq_len)
+        self.type_vocab = int(type_vocab)
+
+    def build(self, input_shape):
+        pass
+
+    def init_params(self, rng):
+        std = self.initializer_range
+        d = self.hidden_size
+        ks = jax.random.split(rng, 4 + self.n_block)
+        return {
+            "tok_embed": _dense_init(ks[0], (self.vocab, d), std),
+            "pos_embed": _dense_init(ks[1], (self.seq_len, d), std),
+            "type_embed": _dense_init(ks[2], (self.type_vocab, d), std),
+            "embed_ln_gamma": jnp.ones((d,)),
+            "embed_ln_beta": jnp.zeros((d,)),
+            "pooler_kernel": _dense_init(ks[3], (d, d), std),
+            "pooler_bias": jnp.zeros((d,)),
+            "blocks": [self._block_params(ks[4 + i])
+                       for i in range(self.n_block)],
+        }
+
+    def param_count(self):
+        d, m = self.hidden_size, self.intermediate_size
+        per_block = 3 * d * d + 3 * d + d * d + d + 2 * d * m + m + d + 4 * d
+        return ((self.vocab + self.seq_len + self.type_vocab) * d + 2 * d
+                + d * d + d + self.n_block * per_block)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        tokens, token_types, positions, attn_mask = (
+            list(inputs) + [None] * (4 - len(inputs))
+            if isinstance(inputs, (list, tuple)) else [inputs, None, None,
+                                                       None]
+        )
+        b, l = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(l), (b, l))
+        h = jnp.take(params["tok_embed"], tokens.astype(jnp.int32), axis=0)
+        h = h + jnp.take(params["pos_embed"], positions.astype(jnp.int32),
+                         axis=0)
+        if token_types is not None:
+            h = h + jnp.take(params["type_embed"],
+                             token_types.astype(jnp.int32), axis=0)
+        h = self._ln(h, params["embed_ln_gamma"], params["embed_ln_beta"])
+        h = self._drop(h, self.hidden_drop, training, rng, 0)
+        mask = None
+        if attn_mask is not None:
+            # additive mask: (B, L) 1/0 -> (B, 1, 1, L) 0/-1e9
+            # (reference BERT.scala attention-mask preprocessing)
+            mask = (1.0 - attn_mask[:, None, None, :].astype(h.dtype)) \
+                * jnp.finfo(h.dtype).min
+        seq = self._run_blocks(params["blocks"], h, mask, training, rng)
+        pooled = jnp.tanh(
+            seq[:, 0] @ params["pooler_kernel"] + params["pooler_bias"]
+        )
+        return [seq, pooled]
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape[0] if isinstance(input_shape, list) \
+            else input_shape
+        b, l = shape[0], shape[1]
+        return [(b, l, self.hidden_size), (b, self.hidden_size)]
