@@ -1,0 +1,21 @@
+"""Built-in model zoo (reference zoo/src/.../models/)."""
+
+from analytics_zoo_tpu.models.anomalydetection import (  # noqa: F401
+    AnomalyDetector,
+)
+from analytics_zoo_tpu.models.common import Ranker, ZooModel  # noqa: F401
+from analytics_zoo_tpu.models.lenet import build_lenet  # noqa: F401
+from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
+    ColumnFeatureInfo,
+    NeuralCF,
+    Recommender,
+    SessionRecommender,
+    WideAndDeep,
+    to_wide_deep_features,
+)
+from analytics_zoo_tpu.models.resnet import ResNet  # noqa: F401
+from analytics_zoo_tpu.models.seq2seq import Seq2seq  # noqa: F401
+from analytics_zoo_tpu.models.textclassification import (  # noqa: F401
+    TextClassifier,
+)
+from analytics_zoo_tpu.models.textmatching import KNRM  # noqa: F401

@@ -1,0 +1,71 @@
+"""Anomaly detection — reference
+models/anomalydetection/AnomalyDetector.scala:40-72 (stacked-LSTM regressor)
+plus its unroll/threshold utilities (Utils in the same package).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    LSTM,
+    Dense,
+    Dropout,
+)
+
+
+class AnomalyDetector(ZooModel):
+    """Stacked LSTMs → linear head predicting the next value
+    (reference AnomalyDetector.scala:40-72: featureShape, hiddenLayers,
+    dropouts)."""
+
+    def __init__(self, feature_shape, hidden_layers=(8, 32, 15),
+                 dropouts=(0.2, 0.2, 0.2)):
+        self.feature_shape = tuple(feature_shape)
+        self.hidden_layers = tuple(hidden_layers)
+        self.dropouts = tuple(dropouts)
+        assert len(self.hidden_layers) == len(self.dropouts)
+        super().__init__()
+
+    def build_model(self):
+        model = Sequential(name="anomaly_detector")
+        first = True
+        for i, (width, drop) in enumerate(
+                zip(self.hidden_layers, self.dropouts)):
+            last = i == len(self.hidden_layers) - 1
+            kwargs = dict(input_shape=self.feature_shape) if first else {}
+            model.add(LSTM(width, return_sequences=not last,
+                           name=f"lstm_{i}", **kwargs))
+            model.add(Dropout(drop))
+            first = False
+        model.add(Dense(1, name="head"))
+        return model
+
+    # -- utilities (reference models/anomalydetection/Utils) ---------------
+    @staticmethod
+    def unroll(data, unroll_length: int):
+        """Sliding windows: (N, F) series → x:(M, unroll, F), y:(M,) next
+        first-feature value (reference Utils.unroll)."""
+        data = np.asarray(data, dtype=np.float32)
+        if data.ndim == 1:
+            data = data[:, None]
+        n = len(data) - unroll_length
+        x = np.stack([data[i:i + unroll_length] for i in range(n)])
+        y = data[unroll_length:, 0]
+        return x, y
+
+    @staticmethod
+    def detect_anomalies(y_true, y_pred, anomaly_size: int = 5):
+        """Top-``anomaly_size`` largest |error| points flagged as anomalies
+        (reference AnomalyDetector.detectAnomalies)."""
+        y_true = np.asarray(y_true).reshape(-1)
+        y_pred = np.asarray(y_pred).reshape(-1)
+        err = np.abs(y_true - y_pred)
+        threshold = np.sort(err)[-min(anomaly_size, len(err))]
+        flags = err >= threshold
+        return [
+            (float(t), float(p), bool(a))
+            for t, p, a in zip(y_true, y_pred, flags)
+        ]

@@ -1,0 +1,138 @@
+"""Model-zoo common base — reference models/common/ZooModel.scala:38-134
+(save/load + predict plumbing) and common/Ranker.scala:33-109 (recallTopK /
+NDCG evaluation for ranking models).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+
+class ZooModel:
+    """Base for zoo models: wraps a built KerasNet and forwards the
+    compile/fit/evaluate/predict/save surface (reference ZooModel.scala:38).
+
+    Subclasses implement ``build_model() -> KerasNet`` and may add
+    domain-specific helpers (e.g. ``recommend_for_user``).
+    """
+
+    def __init__(self):
+        self.model: KerasNet = self.build_model()
+
+    def build_model(self) -> KerasNet:
+        raise NotImplementedError
+
+    # -- forwarded surface -------------------------------------------------
+    def compile(self, *args, **kwargs):
+        self.model.compile(*args, **kwargs)
+        return self
+
+    def fit(self, *args, **kwargs):
+        self.model.fit(*args, **kwargs)
+        return self
+
+    def evaluate(self, *args, **kwargs):
+        return self.model.evaluate(*args, **kwargs)
+
+    def predict(self, *args, **kwargs):
+        return self.model.predict(*args, **kwargs)
+
+    def predict_classes(self, *args, **kwargs):
+        return self.model.predict_classes(*args, **kwargs)
+
+    def set_tensorboard(self, *args, **kwargs):
+        self.model.set_tensorboard(*args, **kwargs)
+
+    def set_checkpoint(self, *args, **kwargs):
+        self.model.set_checkpoint(*args, **kwargs)
+
+    def summary(self):
+        return self.model.summary()
+
+    @property
+    def params(self):
+        return self.model.params
+
+    def save_model(self, path, over_write=True):
+        """Reference ZooModel.saveModel."""
+        import pickle
+
+        self.model.save(path, over_write=over_write)
+        # append the wrapper class + config so load restores the subclass
+        with open(path + ".zoo_meta", "wb") as f:
+            cfg = dict(self.__dict__)
+            cfg.pop("model", None)
+            pickle.dump({"cls": type(self), "cfg": cfg}, f)
+
+    @staticmethod
+    def load_model(path):
+        """Reference ZooModel.loadModel (models/common/ZooModel.scala)."""
+        import os
+        import pickle
+
+        net = KerasNet.load(path)
+        meta = path + ".zoo_meta"
+        if os.path.exists(meta):
+            with open(meta, "rb") as f:
+                blob = pickle.load(f)
+            obj = blob["cls"].__new__(blob["cls"])
+            obj.__dict__.update(blob["cfg"])
+            obj.model = net
+            return obj
+        return net
+
+
+class Ranker:
+    """Ranking evaluation mixin — reference common/Ranker.scala:33-109:
+    ``evaluateNDCG`` and ``evaluateMAP`` over grouped (query, candidates)
+    relation lists."""
+
+    @staticmethod
+    def ndcg(y_true_groups, y_score_groups, k: int = 10) -> float:
+        """Mean NDCG@k over groups (reference Ranker.evaluateNDCG)."""
+        scores = []
+        for rel, pred in zip(y_true_groups, y_score_groups):
+            rel = np.asarray(rel, dtype=np.float64)
+            pred = np.asarray(pred, dtype=np.float64)
+            order = np.argsort(-pred)[:k]
+            gains = (2.0 ** rel[order] - 1.0) / np.log2(
+                np.arange(2, len(order) + 2)
+            )
+            ideal_order = np.argsort(-rel)[:k]
+            ideal = (2.0 ** rel[ideal_order] - 1.0) / np.log2(
+                np.arange(2, len(ideal_order) + 2)
+            )
+            denom = ideal.sum()
+            scores.append(gains.sum() / denom if denom > 0 else 0.0)
+        return float(np.mean(scores)) if scores else 0.0
+
+    @staticmethod
+    def recall_top_k(y_true_groups, y_score_groups, k: int = 10) -> float:
+        """Fraction of relevant items recalled in the top-k
+        (reference Ranker recallTopK semantics)."""
+        scores = []
+        for rel, pred in zip(y_true_groups, y_score_groups):
+            rel = np.asarray(rel) > 0
+            if rel.sum() == 0:
+                continue
+            order = np.argsort(-np.asarray(pred))[:k]
+            scores.append(rel[order].sum() / rel.sum())
+        return float(np.mean(scores)) if scores else 0.0
+
+    @staticmethod
+    def mean_average_precision(y_true_groups, y_score_groups,
+                               threshold: float = 0.0) -> float:
+        """Reference Ranker.evaluateMAP."""
+        aps = []
+        for rel, pred in zip(y_true_groups, y_score_groups):
+            rel = np.asarray(rel) > threshold
+            order = np.argsort(-np.asarray(pred))
+            rel_sorted = rel[order]
+            if rel_sorted.sum() == 0:
+                continue
+            precision = np.cumsum(rel_sorted) / np.arange(
+                1, len(rel_sorted) + 1)
+            aps.append((precision * rel_sorted).sum() / rel_sorted.sum())
+        return float(np.mean(aps)) if aps else 0.0

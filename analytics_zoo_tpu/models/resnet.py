@@ -1,0 +1,136 @@
+"""ResNet — the headline image-classification model (BASELINE.md target:
+ResNet-50 ImageNet images/sec/chip).
+
+Reference: the SSD/ImageClassifier zoo ships ResNet-50 definitions and the
+training example examples/resnet/TrainImageNet.scala:36-120 (SGD with linear
+warmup + 0.1 decay at epochs 30/60/80, momentum 0.9, weight decay 1e-4,
+label-smoothing option).  That example trains NCHW on MKL; this build is
+NHWC bottleneck ResNet built on the graph Model API so the whole network
+lowers to one XLA program of MXU convolutions.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation,
+    BatchNormalization,
+    Convolution2D,
+    Dense,
+    GlobalAveragePooling2D,
+    MaxPooling2D,
+    Merge,
+)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+    SGD,
+    warmup_epoch_decay,
+)
+
+_STAGES = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+
+def _conv_bn(x, filters, k, stride=1, name=None, activation=True):
+    pad = "same"
+    y = Convolution2D(filters, k, k, subsample=(stride, stride),
+                      border_mode=pad, bias=False, init="he_normal",
+                      name=None if name is None else f"{name}_conv")(x)
+    y = BatchNormalization(
+        name=None if name is None else f"{name}_bn")(y)
+    if activation:
+        y = Activation("relu")(y)
+    return y
+
+
+def _bottleneck(x, filters, stride, project, name):
+    y = _conv_bn(x, filters, 1, stride, name=f"{name}_a")
+    y = _conv_bn(y, filters, 3, 1, name=f"{name}_b")
+    y = _conv_bn(y, 4 * filters, 1, 1, name=f"{name}_c", activation=False)
+    if project:
+        shortcut = _conv_bn(x, 4 * filters, 1, stride,
+                            name=f"{name}_proj", activation=False)
+    else:
+        shortcut = x
+    out = Merge(mode="sum", name=f"{name}_add")([y, shortcut])
+    return Activation("relu")(out)
+
+
+def _basic(x, filters, stride, project, name):
+    y = _conv_bn(x, filters, 3, stride, name=f"{name}_a")
+    y = _conv_bn(y, filters, 3, 1, name=f"{name}_b", activation=False)
+    if project:
+        shortcut = _conv_bn(x, filters, 1, stride, name=f"{name}_proj",
+                            activation=False)
+    else:
+        shortcut = x
+    out = Merge(mode="sum", name=f"{name}_add")([y, shortcut])
+    return Activation("relu")(out)
+
+
+class ResNet:
+    """Factory namespace (reference zoo models expose companion-object
+    factories)."""
+
+    @staticmethod
+    def image_net(depth: int = 50, classes: int = 1000,
+                  input_shape=(224, 224, 3)) -> Model:
+        """ImageNet-scale ResNet (reference
+        examples/resnet/TrainImageNet.scala model config)."""
+        kind, stages = _STAGES[depth]
+        block = _bottleneck if kind == "bottleneck" else _basic
+        inp = Input(shape=input_shape, name="input")
+        x = _conv_bn(inp, 64, 7, stride=2, name="stem")
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same")(x)
+        filters = 64
+        for si, blocks in enumerate(stages):
+            for bi in range(blocks):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                # bottleneck stage 0 needs a 64→256 projection; basic-block
+                # stage 0 keeps the identity shortcut (standard ResNet-18/34)
+                project = (bi == 0 and (si > 0 or kind == "bottleneck"))
+                x = block(x, filters, stride, project,
+                          name=f"res{si + 2}{chr(97 + bi)}")
+            filters *= 2
+        x = GlobalAveragePooling2D()(x)
+        out = Dense(classes, activation="softmax", name="fc")(x)
+        return Model(inp, out, name=f"resnet{depth}")
+
+    @staticmethod
+    def cifar(depth: int = 20, classes: int = 10) -> Model:
+        """CIFAR ResNet (6n+2 layout; reference LocalEstimator ResNet
+        example trains this shape on thread pools)."""
+        n = (depth - 2) // 6
+        inp = Input(shape=(32, 32, 3), name="input")
+        x = _conv_bn(inp, 16, 3, 1, name="stem")
+        filters = 16
+        for si in range(3):
+            for bi in range(n):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x = _basic(x, filters, stride, project=(bi == 0 and si > 0),
+                           name=f"res{si + 2}{chr(97 + bi)}")
+            filters *= 2
+        x = GlobalAveragePooling2D()(x)
+        out = Dense(classes, activation="softmax", name="fc")(x)
+        return Model(inp, out, name=f"resnet{depth}_cifar")
+
+    @staticmethod
+    def imagenet_optimizer(base_lr=0.1, batch_size=256, steps_per_epoch=5004,
+                           warmup_epochs=5, momentum=0.9,
+                           weight_decay=1e-4) -> SGD:
+        """The TrainImageNet.scala recipe: linear warmup then 0.1 decay at
+        epochs 30/60/80 (TrainImageNet.scala:36-120), momentum 0.9, decoupled
+        weight decay."""
+        sched = warmup_epoch_decay(
+            warmup_steps=warmup_epochs * steps_per_epoch,
+            steps_per_epoch=steps_per_epoch,
+            boundaries_epochs=(30, 60, 80),
+            decay=0.1,
+        )
+        return SGD(lr=base_lr * batch_size / 256.0, momentum=momentum,
+                   weight_decay=weight_decay, schedule=sched)

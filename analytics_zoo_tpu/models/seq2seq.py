@@ -1,0 +1,183 @@
+"""Seq2seq — RNN encoder/decoder with Bridge state adapters.
+
+Reference: models/seq2seq/{RNNEncoder.scala:44, RNNDecoder.scala:45,
+Bridge.scala:38, Seq2seq.scala}: stacked-RNN encoder, a Bridge mapping final
+encoder states into decoder initial states, teacher-forced decoder for
+training and a greedy ``infer`` loop for generation.
+
+TPU re-design: teacher-forced training runs both stacks as fused lax.scans
+in one jitted program; inference unrolls with ``lax.scan`` over the decoder
+steps (static max length), so generation is also a single XLA program rather
+than a per-step host loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _lstm_step(params, h, c, x, ):
+    z = x @ params["kernel"] + h @ params["recurrent_kernel"] \
+        + params["bias"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+def _init_lstm(rng, in_dim, units):
+    k1, k2 = jax.random.split(rng)
+    glorot = jax.nn.initializers.glorot_uniform()
+    return {
+        "kernel": glorot(k1, (in_dim, 4 * units)),
+        "recurrent_kernel": jax.nn.initializers.orthogonal()(
+            k2, (units, 4 * units)),
+        "bias": jnp.zeros((4 * units,)),
+    }
+
+
+class Seq2seq(Layer):
+    """Encoder-decoder LSTM stack with embedding + Bridge
+    (reference Seq2seq.scala factory: RNNEncoder(rnns) + Bridge +
+    RNNDecoder(rnns) + generator head).
+
+    Inputs: ``[encoder_tokens (B, Le), decoder_tokens (B, Ld)]`` (teacher
+    forcing); output: (B, Ld, vocab) softmax.
+    """
+
+    def __init__(self, vocab_size, embed_dim=64, hidden_sizes=(128,),
+                 bridge="pass", name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.hidden_sizes = tuple(hidden_sizes)
+        assert bridge in ("pass", "dense")
+        self.bridge = bridge
+
+    def build(self, input_shape):
+        pass
+
+    def init_params(self, rng):
+        ks = jax.random.split(rng, 4 + 2 * len(self.hidden_sizes))
+        uniform = jax.nn.initializers.uniform(0.05)
+        params = {
+            "embed": uniform(ks[0], (self.vocab_size, self.embed_dim)),
+            "enc": [], "dec": [],
+            "head_kernel": jax.nn.initializers.glorot_uniform()(
+                ks[1], (self.hidden_sizes[-1], self.vocab_size)),
+            "head_bias": jnp.zeros((self.vocab_size,)),
+        }
+        in_dim = self.embed_dim
+        for li, width in enumerate(self.hidden_sizes):
+            params["enc"].append(_init_lstm(ks[2 + 2 * li], in_dim, width))
+            params["dec"].append(
+                _init_lstm(ks[3 + 2 * li], in_dim, width))
+            in_dim = width
+        if self.bridge == "dense":
+            params["bridge"] = [
+                {
+                    "kernel": jax.nn.initializers.glorot_uniform()(
+                        jax.random.fold_in(ks[-1], li), (2 * w, 2 * w)),
+                    "bias": jnp.zeros((2 * w,)),
+                }
+                for li, w in enumerate(self.hidden_sizes)
+            ]
+        return params
+
+    # -- encoder -----------------------------------------------------------
+    def _encode(self, params, tokens):
+        x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+        b = tokens.shape[0]
+        states = []
+        seq = jnp.swapaxes(x, 0, 1)
+        for lp, width in zip(params["enc"], self.hidden_sizes):
+            h0 = jnp.zeros((b, width))
+            c0 = jnp.zeros((b, width))
+
+            def body(carry, x_t, lp=lp):
+                h, c = carry
+                h, c = _lstm_step(lp, h, c, x_t)
+                return (h, c), h
+
+            (h, c), outs = lax.scan(body, (h0, c0), seq)
+            states.append((h, c))
+            seq = outs
+        return states
+
+    def _bridge(self, params, states):
+        """Bridge: adapt encoder final states → decoder init states
+        (reference Bridge.scala:38; 'pass' = passCurrState, 'dense' = dense
+        transform of [h;c])."""
+        if self.bridge == "pass":
+            return states
+        out = []
+        for bp, (h, c) in zip(params["bridge"], states):
+            hc = jnp.concatenate([h, c], axis=-1)
+            hc = jnp.tanh(hc @ bp["kernel"] + bp["bias"])
+            w = h.shape[-1]
+            out.append((hc[:, :w], hc[:, w:]))
+        return out
+
+    # -- decoder -----------------------------------------------------------
+    def _decode_teacher(self, params, states, tokens):
+        x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+        seq = jnp.swapaxes(x, 0, 1)
+        for lp, (h0, c0) in zip(params["dec"], states):
+            def body(carry, x_t, lp=lp):
+                h, c = carry
+                h, c = _lstm_step(lp, h, c, x_t)
+                return (h, c), h
+
+            _, outs = lax.scan(body, (h0, c0), seq)
+            seq = outs
+        out = jnp.swapaxes(seq, 0, 1)
+        logits = out @ params["head_kernel"] + params["head_bias"]
+        return jax.nn.softmax(logits, axis=-1)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        enc_tokens, dec_tokens = inputs
+        states = self._bridge(params, self._encode(params, enc_tokens))
+        return self._decode_teacher(params, states, dec_tokens)
+
+    def compute_output_shape(self, input_shape):
+        enc, dec = input_shape
+        return (dec[0], dec[1], self.vocab_size)
+
+    def infer(self, params, enc_tokens, start_sign: int, max_len: int = 20,
+              stop_sign: int | None = None):
+        """Greedy generation (reference Seq2seq.infer): one jitted scan of
+        ``max_len`` steps; stop_sign positions are masked post-hoc."""
+        states = self._bridge(params, self._encode(
+            params, jnp.asarray(enc_tokens)))
+        b = np.shape(enc_tokens)[0]
+
+        def step(carry, _):
+            tok, layer_states = carry
+            x = jnp.take(params["embed"], tok, axis=0)
+            new_states = []
+            for lp, (h, c) in zip(params["dec"], layer_states):
+                h, c = _lstm_step(lp, h, c, x)
+                new_states.append((h, c))
+                x = h
+            logits = x @ params["head_kernel"] + params["head_bias"]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, new_states), nxt
+
+        start = jnp.full((b,), start_sign, jnp.int32)
+        _, toks = lax.scan(step, (start, states), None, length=max_len)
+        toks = np.asarray(jnp.swapaxes(toks, 0, 1))
+        if stop_sign is not None:
+            for row in toks:
+                stops = np.where(row == stop_sign)[0]
+                if len(stops):
+                    row[stops[0] + 1:] = stop_sign
+        return toks

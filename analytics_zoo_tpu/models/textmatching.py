@@ -1,0 +1,95 @@
+"""Text matching — KNRM kernel-pooling ranking model.
+
+Reference: models/textmatching/KNRM.scala:60-106: query/doc embeddings →
+cosine translation matrix → RBF kernel pooling over ``kernelNum`` kernels
+(mu from 1.0 down in 0.1 steps, sigma 0.1 / exactMatch 0.001) → log-sum →
+dense sigmoid score.  Pairs with the RankHinge loss and Ranker NDCG/MAP
+evaluation (common/Ranker.scala).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common import Ranker, ZooModel
+from analytics_zoo_tpu.pipeline.api.autograd import LambdaOp, batch_dot
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Embedding
+
+
+class KNRM(ZooModel, Ranker):
+    def __init__(self, text1_length, text2_length, vocab_size=20000,
+                 embed_size=300, embed_weights=None, train_embed=True,
+                 kernel_num=21, sigma=0.1, exact_sigma=0.001,
+                 target_mode="ranking"):
+        self.text1_length = int(text1_length)
+        self.text2_length = int(text2_length)
+        self.vocab_size = int(vocab_size)
+        self.embed_size = int(embed_size)
+        self.embed_weights = embed_weights
+        self.train_embed = train_embed
+        if int(kernel_num) < 2:
+            raise ValueError("kernel_num must be >= 2 (kernel mus span "
+                             "[1.0, -1.0] in 2/(kernel_num-1) steps)")
+        self.kernel_num = int(kernel_num)
+        self.sigma = float(sigma)
+        self.exact_sigma = float(exact_sigma)
+        self.target_mode = target_mode
+        super().__init__()
+
+    def build_model(self):
+        q = Input(shape=(self.text1_length,), name="query")
+        d = Input(shape=(self.text2_length,), name="doc")
+        embed = Embedding(self.vocab_size, self.embed_size,
+                          weights=self.embed_weights,
+                          trainable=self.train_embed, name="embedding")
+        qe = embed(q)
+        de = embed(d)
+        # cosine translation matrix (B, Lq, Ld)
+        mm = batch_dot(qe, de, axes=(2, 2), normalize=True)
+
+        kernel_num, sigma, exact_sigma = (
+            self.kernel_num, self.sigma, self.exact_sigma
+        )
+
+        def kernel_pool(sim):
+            feats = []
+            for i in range(kernel_num):
+                mu = 1.0 - i * (2.0 / (kernel_num - 1))
+                s = exact_sigma if mu > 1.0 - 1e-6 else sigma
+                k = jnp.exp(-((sim - mu) ** 2) / (2.0 * s * s))
+                # sum over doc terms, log, sum over query terms
+                kq = jnp.log(
+                    jnp.clip(jnp.sum(k, axis=2), 1e-10)
+                ) * 0.01
+                feats.append(jnp.sum(kq, axis=1))
+            return jnp.stack(feats, axis=1)
+
+        pooled = LambdaOp(
+            kernel_pool, lambda s: (s[0], kernel_num), op_name="kernel_pool"
+        )(mm)
+        if self.target_mode == "ranking":
+            out = Dense(1, name="score")(pooled)
+        else:
+            out = Dense(1, activation="sigmoid", name="score")(pooled)
+        return Model([q, d], out, name="knrm")
+
+    def evaluate_ndcg(self, grouped_qd, grouped_labels, k=10,
+                      batch_size=1024):
+        """Reference Ranker.evaluateNDCG over relation lists."""
+        scores = [
+            np.asarray(self.predict([np.asarray(g[0]), np.asarray(g[1])],
+                                    batch_size=batch_size)).reshape(-1)
+            for g in grouped_qd
+        ]
+        return self.ndcg(grouped_labels, scores, k)
+
+    def evaluate_map(self, grouped_qd, grouped_labels, batch_size=1024):
+        scores = [
+            np.asarray(self.predict([np.asarray(g[0]), np.asarray(g[1])],
+                                    batch_size=batch_size)).reshape(-1)
+            for g in grouped_qd
+        ]
+        return self.mean_average_precision(grouped_labels, scores)
