@@ -1,0 +1,17 @@
+from analytics_zoo_tpu.feature.image.imageset import (  # noqa: F401
+    ImageSet,
+)
+from analytics_zoo_tpu.feature.image.transforms import (  # noqa: F401
+    ImageBrightness,
+    ImageCenterCrop,
+    ImageChannelNormalize,
+    ImageExpand,
+    ImageHFlip,
+    ImageHue,
+    ImageMatToTensor,
+    ImagePixelNormalizer,
+    ImageRandomCrop,
+    ImageResize,
+    ImageSaturation,
+    ImageSetToSample,
+)

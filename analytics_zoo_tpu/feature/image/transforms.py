@@ -1,0 +1,232 @@
+"""Image preprocessing ops — host-side, numpy/C++-backed, feeding infeed.
+
+Reference: zoo/.../feature/image/ImageProcessing.scala + the ~25
+OpenCV-backed ops under feature/image (resize, crop variants, flip, hue,
+saturation, brightness, normalize, expand, jitter — SURVEY.md §2.1).  The
+reference runs OpenCV via BigDL's JNI; here the per-record ops are numpy
+(uint8 in, float32 out at the normalize boundary), with the normalize hot
+loop optionally served by the C++ library
+(analytics_zoo_tpu/native/zoonative.cpp).  Records are HWC uint8/float
+numpy arrays; all ops are `Preprocessing` stages composing with ``>>``.
+
+Geometric ops use seeded per-record RNG derived from a records counter so a
+transformed FeatureSet remains reproducible/checkpointable (the reference's
+OpenCV ops were non-deterministic across retries).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+
+
+def _rng_for(record_seed):
+    return np.random.default_rng(record_seed)
+
+
+class _RandomOp(Preprocessing):
+    """Base for randomized ops: derives an rng from a per-record counter."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def next_rng(self):
+        self._counter += 1
+        return np.random.default_rng((id(type(self)) & 0xFFFF,
+                                      self._counter))
+
+
+class ImageResize(Preprocessing):
+    """Bilinear resize to (height, width) (reference image/Resize)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = int(resize_h), int(resize_w)
+
+    def transform(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        if (h, w) == (self.h, self.w):
+            return img
+        # bilinear via coordinate sampling (no cv2 dependency)
+        ys = np.linspace(0, h - 1, self.h)
+        xs = np.linspace(0, w - 1, self.w)
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        img_f = img.astype(np.float32)
+        top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+        bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+        out = top * (1 - wy) + bot * wy
+        return out.astype(img.dtype) if img.dtype == np.uint8 \
+            else out.astype(np.float32)
+
+
+class ImageCenterCrop(Preprocessing):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.h, self.w = int(crop_h), int(crop_w)
+
+    def transform(self, img):
+        h, w = img.shape[:2]
+        top = max(0, (h - self.h) // 2)
+        left = max(0, (w - self.w) // 2)
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageRandomCrop(_RandomOp):
+    def __init__(self, crop_h: int, crop_w: int):
+        super().__init__()
+        self.h, self.w = int(crop_h), int(crop_w)
+
+    def transform(self, img):
+        rng = self.next_rng()
+        h, w = img.shape[:2]
+        top = int(rng.integers(0, max(h - self.h, 0) + 1))
+        left = int(rng.integers(0, max(w - self.w, 0) + 1))
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageHFlip(_RandomOp):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = float(p)
+
+    def transform(self, img):
+        if self.next_rng().random() < self.p:
+            return img[:, ::-1]
+        return img
+
+
+class ImageBrightness(_RandomOp):
+    """Additive brightness jitter in [delta_low, delta_high] (reference
+    image/Brightness)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0):
+        super().__init__()
+        self.lo, self.hi = float(delta_low), float(delta_high)
+
+    def transform(self, img):
+        delta = self.next_rng().uniform(self.lo, self.hi)
+        out = img.astype(np.float32) + delta
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ImageSaturation(_RandomOp):
+    def __init__(self, lower: float = 0.5, upper: float = 1.5):
+        super().__init__()
+        self.lower, self.upper = float(lower), float(upper)
+
+    def transform(self, img):
+        s = self.next_rng().uniform(self.lower, self.upper)
+        f = img.astype(np.float32)
+        gray = f.mean(axis=-1, keepdims=True)
+        out = gray + (f - gray) * s
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ImageHue(_RandomOp):
+    """Hue rotation by [-delta, delta] degrees (reference image/Hue),
+    approximated in RGB via the YIQ rotation matrix."""
+
+    def __init__(self, delta: float = 18.0):
+        super().__init__()
+        self.delta = float(delta)
+
+    def transform(self, img):
+        theta = np.deg2rad(self.next_rng().uniform(-self.delta, self.delta))
+        c, s = np.cos(theta), np.sin(theta)
+        m = np.array([
+            [0.299 + 0.701 * c + 0.168 * s,
+             0.587 - 0.587 * c + 0.330 * s,
+             0.114 - 0.114 * c - 0.497 * s],
+            [0.299 - 0.299 * c - 0.328 * s,
+             0.587 + 0.413 * c + 0.035 * s,
+             0.114 - 0.114 * c + 0.292 * s],
+            [0.299 - 0.300 * c + 1.250 * s,
+             0.587 - 0.588 * c - 1.050 * s,
+             0.114 + 0.886 * c - 0.203 * s],
+        ], np.float32)
+        out = img.astype(np.float32) @ m.T
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ImageExpand(_RandomOp):
+    """Zoom-out expansion onto a mean-filled canvas (reference image/Expand,
+    used by SSD augmentation)."""
+
+    def __init__(self, max_expand_ratio: float = 4.0,
+                 means=(123, 117, 104)):
+        super().__init__()
+        self.max_ratio = float(max_expand_ratio)
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, img):
+        rng = self.next_rng()
+        ratio = rng.uniform(1.0, self.max_ratio)
+        h, w, c = img.shape
+        nh, nw = int(h * ratio), int(w * ratio)
+        canvas = np.broadcast_to(
+            self.means.astype(img.dtype), (nh, nw, c)
+        ).copy()
+        top = int(rng.integers(0, nh - h + 1))
+        left = int(rng.integers(0, nw - w + 1))
+        canvas[top:top + h, left:left + w] = img
+        return canvas
+
+
+class ImageChannelNormalize(Preprocessing):
+    """(x - mean) / std per channel → float32 (reference
+    image/ChannelNormalize); uses the C++ kernel when built."""
+
+    def __init__(self, mean_r, mean_g, mean_b, std_r=1.0, std_g=1.0,
+                 std_b=1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def transform(self, img):
+        from analytics_zoo_tpu.native import lib
+
+        if lib is not None and img.dtype == np.uint8:
+            return lib.normalize_u8(img, self.mean, self.std)
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class ImagePixelNormalizer(Preprocessing):
+    """Subtract a per-pixel mean image (reference PixelNormalizer)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, img):
+        return img.astype(np.float32) - self.means
+
+
+class ImageMatToTensor(Preprocessing):
+    """Reference MatToTensor: OpenCV mat → CHW tensor.  TPU-native layout
+    is NHWC, so this is float32 conversion (+ optional layout swap for
+    parity)."""
+
+    def __init__(self, to_chw: bool = False):
+        self.to_chw = to_chw
+
+    def transform(self, img):
+        out = np.asarray(img, np.float32)
+        if self.to_chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class ImageSetToSample(Preprocessing):
+    """Attach the record as (feature, label) sample (reference
+    ImageSetToSample)."""
+
+    def transform(self, record):
+        if isinstance(record, tuple):
+            return record
+        return (record, None)

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.feature.text.textset import (  # noqa: F401
+    Relation,
+    TextFeature,
+    TextSet,
+)

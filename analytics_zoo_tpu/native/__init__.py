@@ -1,0 +1,90 @@
+"""Native (C++) host-path acceleration.
+
+The reference reaches native code for its data path and kernels over JNI
+(SURVEY.md §2.3).  On TPU the device math belongs to XLA; the justified
+native component is the *host* data path (SURVEY.md: "high-throughput
+host-side decode/augment feeding infeed").  This package builds a small C++
+library (ctypes-bound) providing:
+
+- crc32c (TFRecord framing hot loop)
+- uint8 image normalize/flip/crop batch kernels for the host feed
+
+Build is lazy and optional: ``lib`` is None (pure-python fallbacks apply)
+until :func:`build_native` succeeds; import never fails without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "libzoonative.so")
+_SRC = os.path.join(_HERE, "zoonative.cpp")
+
+
+class _NativeLib:
+    def __init__(self, cdll):
+        self._dll = cdll
+        self._dll.zoo_crc32c.restype = ctypes.c_uint32
+        self._dll.zoo_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        self._dll.zoo_normalize_u8.restype = None
+        self._dll.zoo_normalize_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+
+    def crc32c(self, data: bytes) -> int:
+        return self._dll.zoo_crc32c(data, len(data))
+
+    def normalize_u8(self, img, mean, std):
+        """uint8 HWC image batch -> float32 normalized, in C."""
+        import numpy as np
+
+        img = np.ascontiguousarray(img, dtype=np.uint8)
+        ch = img.shape[-1]
+        out = np.empty(img.shape, dtype=np.float32)
+        mean = np.ascontiguousarray(mean, dtype=np.float32)
+        std = np.ascontiguousarray(std, dtype=np.float32)
+        self._dll.zoo_normalize_u8(
+            img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            img.size, ch,
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out
+
+
+def build_native(force: bool = False):
+    """Compile the C++ library with g++ (no external deps)."""
+    global lib
+    if os.path.exists(_SO) and not force:
+        pass
+    else:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-march=native",
+               "-o", _SO, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+        except Exception as e:  # no compiler / failed build → fallback
+            logger.warning("native build failed: %s", e)
+            return None
+    try:
+        lib = _NativeLib(ctypes.CDLL(_SO))
+        return lib
+    except OSError as e:
+        logger.warning("native load failed: %s", e)
+        return None
+
+
+lib = None
+if os.path.exists(_SO):
+    try:
+        lib = _NativeLib(ctypes.CDLL(_SO))
+    except OSError:
+        lib = None

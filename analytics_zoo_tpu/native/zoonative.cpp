@@ -1,0 +1,73 @@
+// Native host-path kernels for analytics_zoo_tpu.
+//
+// The reference reaches MKL/OpenCV through JNI for its host data path
+// (SURVEY.md §2.3); the TPU rebuild keeps device math in XLA and uses this
+// small library for the host-side hot loops: CRC32C for TFRecord framing
+// and uint8 image normalization feeding the per-chip infeed.
+//
+// Build: g++ -O3 -shared -fPIC -march=native -o libzoonative.so zoonative.cpp
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli), slice-by-8 table driven
+// ---------------------------------------------------------------------------
+
+static uint32_t kCrcTable[8][256];
+static bool kCrcInit = false;
+
+static void crc_init() {
+  const uint32_t poly = 0x82F63B78u;
+  for (int i = 0; i < 256; ++i) {
+    uint32_t c = (uint32_t)i;
+    for (int k = 0; k < 8; ++k) c = (c >> 1) ^ ((c & 1) ? poly : 0);
+    kCrcTable[0][i] = c;
+  }
+  for (int t = 1; t < 8; ++t) {
+    for (int i = 0; i < 256; ++i) {
+      uint32_t c = kCrcTable[t - 1][i];
+      kCrcTable[t][i] = (c >> 8) ^ kCrcTable[0][c & 0xFF];
+    }
+  }
+  kCrcInit = true;
+}
+
+uint32_t zoo_crc32c(const char* data, size_t n) {
+  if (!kCrcInit) crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  const uint8_t* p = (const uint8_t*)data;
+  while (n >= 8) {
+    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+    uint32_t hi = (uint32_t)p[4] | ((uint32_t)p[5] << 8) |
+                  ((uint32_t)p[6] << 16) | ((uint32_t)p[7] << 24);
+    crc = kCrcTable[7][crc & 0xFF] ^ kCrcTable[6][(crc >> 8) & 0xFF] ^
+          kCrcTable[5][(crc >> 16) & 0xFF] ^ kCrcTable[4][crc >> 24] ^
+          kCrcTable[3][hi & 0xFF] ^ kCrcTable[2][(hi >> 8) & 0xFF] ^
+          kCrcTable[1][(hi >> 16) & 0xFF] ^ kCrcTable[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ kCrcTable[0][(crc ^ *p++) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// uint8 HWC image batch -> float32 (x - mean[c]) / std[c]
+// ---------------------------------------------------------------------------
+
+void zoo_normalize_u8(const uint8_t* in, float* out, size_t n,
+                      size_t channels, const float* mean, const float* std) {
+  float inv[16];
+  size_t c = channels < 16 ? channels : 16;
+  for (size_t i = 0; i < c; ++i) inv[i] = 1.0f / std[i];
+  for (size_t i = 0; i < n; ++i) {
+    size_t ch = i % channels;
+    out[i] = ((float)in[i] - mean[ch]) * inv[ch];
+  }
+}
+
+}  // extern "C"

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 
 def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
-                          causal=False, scale=None):
+                          causal=False, scale=None, use_flash="auto"):
     """Batched multi-head attention.
 
     Args:
@@ -33,6 +33,17 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
     """
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
+    # Route big unmasked/causal attention through the Pallas flash kernel on
+    # TPU (O(L·D) HBM traffic); the jnp path serves masked/dropout/small
+    # cases and non-TPU backends.
+    if (use_flash != False and mask is None and dropout_p == 0.0  # noqa: E712
+            and q.shape[-2] >= 256 and d % 128 == 0
+            and jax.default_backend() == "tpu"):
+        from analytics_zoo_tpu.ops.pallas.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(q, k, v, causal, scale)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         lq, lk = scores.shape[-2], scores.shape[-1]

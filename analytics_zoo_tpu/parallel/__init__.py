@@ -1,0 +1,24 @@
+"""Parallelism strategies over the device mesh.
+
+The reference's only inter-node strategy is synchronous data parallelism on
+Spark (SURVEY.md §2.4); TP/PP/SP/EP are absent.  Here every strategy is a
+first-class mesh axis (common/engine.py axes: data/model/seq/expert):
+
+- :mod:`strategies` — explicit shard_map train steps (psum = the
+  AllReduceParameter replacement), tensor-parallel dense helpers.
+- :mod:`ring_attention` — sequence/context parallelism via ppermute ring —
+  the long-context capability the reference lacks.
+- :mod:`multihost` — jax.distributed bootstrap (the RayOnSpark role).
+"""
+
+from analytics_zoo_tpu.parallel.multihost import (  # noqa: F401
+    init_distributed,
+)
+from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+)
+from analytics_zoo_tpu.parallel.strategies import (  # noqa: F401
+    column_parallel_dense,
+    make_shard_map_train_step,
+    row_parallel_dense,
+)

@@ -1,0 +1,98 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference's longest-sequence story is a full O(L²) attention on one
+machine (TransformerLayer.scala:137; SURVEY.md §5 "Long-context: absent").
+This module provides the capability the reference never had: the sequence
+dimension is sharded across chips, and K/V blocks rotate around the ring via
+``jax.lax.ppermute`` over ICI while each chip accumulates its queries' output
+with the numerically-stable streaming-softmax (flash-attention) update.  Peak
+memory per chip is O(L·L/n) scores for one block pair instead of O(L²), and
+compute/communication overlap rides the ring (cf. Ring Attention,
+Liu et al.; blockwise parallel transformers).
+
+Differentiable end-to-end: the ring is a ``lax.scan`` of ppermutes, so
+jax.grad produces the reverse ring automatically.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.common.engine import SEQ_AXIS, get_zoo_context
+
+_NEG = -1e30
+
+
+def _ring_attention_local(ql, kl, vl, *, axis_name: str, n_shards: int,
+                          causal: bool, scale: float):
+    """Per-shard body: ql/kl/vl are (B, H, Lc, D) local blocks."""
+    my = lax.axis_index(axis_name)
+    b, h, lc, d = ql.shape
+    q_pos = my * lc + jnp.arange(lc)
+
+    m0 = jnp.full((b, h, lc), _NEG, ql.dtype)
+    l0 = jnp.zeros((b, h, lc), ql.dtype)
+    acc0 = jnp.zeros_like(ql)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def step(carry, i):
+        m, l, acc, k_blk, v_blk = carry
+        kv_idx = (my - i) % n_shards
+        k_pos = kv_idx * lc + jnp.arange(lc)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", ql, k_blk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask, scores, _NEG)
+        new_m = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk
+        )
+        # rotate the K/V blocks one hop around the ring (ICI neighbor)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (new_m, l, acc, k_blk, v_blk), None
+
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, kl, vl), jnp.arange(n_shards)
+    )
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, *, causal: bool = False, mesh=None,
+                   axis_name: str = SEQ_AXIS, scale: float | None = None):
+    """Sequence-parallel attention over a mesh ``seq`` axis.
+
+    Args:
+      q, k, v: (B, H, L, D) arrays (global view); L must divide evenly over
+        the seq axis.  Under jit with a sharded mesh, pass arrays whose L dim
+        is sharded with PartitionSpec(..., axis_name, ...).
+      causal: lower-triangular masking over the *global* L positions.
+    Returns: (B, H, L, D), L sharded like q.
+    """
+    mesh = mesh or get_zoo_context().mesh
+    n = mesh.shape[axis_name]
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    if n == 1:
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, n_shards=n,
+                causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,101 @@
+"""Explicit shard_map strategies + tensor-parallel building blocks.
+
+The default training path (pipeline/estimator) uses jit + NamedSharding and
+lets XLA insert the gradient all-reduce.  This module is the *explicit*
+formulation — ``psum`` written out — which (a) documents exactly where the
+reference's AllReduceParameter shuffle+broadcast (docs/docs/wp-bigdl.md:
+148-164) became one collective, and (b) gives manual control when XLA's
+choices need overriding.
+
+Also: Megatron-style column/row-parallel dense ops over the ``model`` axis —
+the TP capability the reference never had (SURVEY.md §2.4 "rebuild
+requirement: hooks for TP on the same mesh API").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.common.engine import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    get_zoo_context,
+)
+
+
+def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
+                              grad_clip=None):
+    """A train step as shard_map with explicit pmean — the literal
+    TPU translation of the reference's two Spark jobs (local
+    forward/backward, then gradient slice aggregation) into one SPMD
+    program with a single collective."""
+    from analytics_zoo_tpu.pipeline.estimator.estimator import _clip_grads
+
+    mesh = mesh or get_zoo_context().mesh
+
+    def local_step(params, opt_state, state, rng, batch):
+        # per-shard forward/backward on the local batch slice
+        # (= reference Spark job 1, Topology.scala:1178-1197)
+        def loss_of(p):
+            preds, new_state = model.forward(
+                p, batch["x"], state=state, training=True, rng=rng
+            )
+            return loss_fn.mean(batch.get("y"), preds), new_state
+
+        (l, new_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(params)
+        # gradient all-reduce over ICI (= reference Spark job 2: gradient
+        # shuffle to parameter slices + task-side broadcast)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        l = jax.lax.pmean(l, DATA_AXIS)
+        new_state = jax.lax.pmean(new_state, DATA_AXIS)
+        grads = _clip_grads(grads, grad_clip)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, l
+
+    repl = P()
+    batch_spec = P(DATA_AXIS)
+    step = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(repl, repl, repl, repl, batch_spec),
+        out_specs=(repl, repl, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel dense blocks (model axis)
+# ---------------------------------------------------------------------------
+
+
+def column_parallel_dense(x, kernel, bias=None, axis_name=MODEL_AXIS):
+    """Y_local = x @ W_local where W is column-sharded: no collective on the
+    forward (outputs stay sharded on the feature dim)."""
+    y = x @ kernel
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def row_parallel_dense(x_local, kernel, bias=None, axis_name=MODEL_AXIS):
+    """Y = psum_over_model(x_local @ W_local): input feature dim is sharded,
+    one psum restores the full output (Megatron row-parallel)."""
+    y = jax.lax.psum(x_local @ kernel, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def tp_mlp(x, w1, b1, w2, b2, axis_name=MODEL_AXIS, activation=jax.nn.gelu):
+    """Column-parallel up-projection + row-parallel down-projection: ONE
+    psum per MLP block — the canonical TP transformer feed-forward."""
+    h = activation(column_parallel_dense(x, w1, b1))
+    return row_parallel_dense(h, w2, b2, axis_name=axis_name)
