@@ -60,6 +60,8 @@ class ImageSet:
             )
             label_map = {c: i for i, c in enumerate(classes)}
             for c in classes:
+                if max_images and len(images) >= max_images:
+                    break
                 for f in sorted(os.listdir(os.path.join(path, c))):
                     if f.lower().endswith(_IMG_EXT):
                         p = os.path.join(path, c, f)

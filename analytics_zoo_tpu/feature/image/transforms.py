@@ -16,6 +16,8 @@ OpenCV ops were non-deterministic across retries).
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
 
 from analytics_zoo_tpu.feature.common import Preprocessing
@@ -25,16 +27,29 @@ def _rng_for(record_seed):
     return np.random.default_rng(record_seed)
 
 
+_random_op_instances = 0
+
+
 class _RandomOp(Preprocessing):
-    """Base for randomized ops: derives an rng from a per-record counter."""
+    """Base for randomized ops: derives an rng from a per-record counter.
+
+    The seed mixes a stable hash of the class name with a process-wide
+    instance index, so streams are (a) reproducible across process restarts
+    (checkpoint resume replays the same augmentations) and (b) independent
+    between instances of the same op class.
+    """
 
     def __init__(self):
+        global _random_op_instances
+        _random_op_instances += 1
+        self._instance = _random_op_instances
+        self._class_seed = zlib.crc32(type(self).__name__.encode())
         self._counter = 0
 
     def next_rng(self):
         self._counter += 1
-        return np.random.default_rng((id(type(self)) & 0xFFFF,
-                                      self._counter))
+        return np.random.default_rng(
+            (self._class_seed, self._instance, self._counter))
 
 
 class ImageResize(Preprocessing):

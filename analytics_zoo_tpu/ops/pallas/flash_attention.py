@@ -7,11 +7,15 @@ numerically-stable online-softmax accumulation, so HBM traffic is O(L·D)
 per head instead of O(L²), and the score block lives only in VMEM where the
 MXU consumes it.
 
+Semantics: causal masking is *end-aligned* for lq != lk (query i sees keys
+0..(lk-lq)+i), matching the jnp path in ops/attention.py — the decode-style
+convention where q is the tail of the key sequence.
+
 Gradient support: ``flash_attention`` is wrapped in jax.custom_vjp; the
-backward pass recomputes attention blockwise with jnp (rematerialisation —
-the standard flash backward strategy) so training works everywhere while the
-forward runs the Pallas kernel on TPU.  On CPU (tests) the forward falls
-back to the jnp path automatically.
+backward recomputes attention **blockwise** with a lax.scan over key blocks
+(O(Lq·block_k) live memory, the standard flash rematerialisation strategy),
+so long-context training never materializes the (L, L) matrix.  On CPU
+(tests) the forward falls back to the jnp path automatically.
 """
 
 from __future__ import annotations
@@ -41,6 +45,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
 
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    offset = lk - lq  # end-aligned causal diagonal
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     n_k = pl.cdiv(lk, block_k)
@@ -65,15 +70,17 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            # mask padded key rows (lk % block_k != 0) and, if causal, the
+            # end-aligned upper triangle
+            live = k_pos < lk
             if causal:
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (1, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, _NEG)
+                live = live & (q_pos + offset >= k_pos)
+            s = jnp.where(live, s, _NEG)
             new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m - new_m)
-            p = jnp.exp(s - new_m)
-            if causal:
-                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            p = jnp.where(live, jnp.exp(s - new_m), 0.0)
             l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc = acc * alpha + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
@@ -82,11 +89,11 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
             return new_m, l, acc
 
         if causal:
-            # skip key blocks entirely after this query block
+            # skip key blocks entirely after this query block's diagonal
             n_live = jax.lax.div(
-                (qi + 1) * block_q + block_k - 1, block_k
+                (qi + 1) * block_q + offset + block_k - 1, block_k
             )
-            n_live = jnp.minimum(n_live, n_k)
+            n_live = jnp.clip(n_live, 0, n_k)
         else:
             n_live = n_k
         m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
@@ -132,18 +139,77 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
     out = flash_attention(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
+
+
+def _block_mask(q_pos, k_pos, lk, offset, causal):
+    live = k_pos[None, :] < lk
+    if causal:
+        live = live & (q_pos[:, None] + offset >= k_pos[None, :])
+    return live  # (lq, block_k)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    scale_v = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    """Blockwise flash backward: lax.scan over key blocks, recomputing each
+    (lq, block_k) score tile from q/k (rematerialisation).  Live memory is
+    O(lq·block_k + lk·d); the (lq, lk) matrix is never materialized."""
+    q, k, v, out = res
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    scale_v = 1.0 / math.sqrt(d) if scale is None else scale
+    offset = lk - lq
+    bk = min(block_k, lk)
+    n_k = -(-lk // bk)
+    pad = n_k * bk - lk
 
-    def ref(q, k, v):
-        return _attention_reference(q, k, v, causal, scale_v)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))).astype(jnp.float32)
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0))).astype(jnp.float32)
+    # (n_k, b, h, bk, d) so scan iterates key blocks
+    kb_s = jnp.moveaxis(kp.reshape(b, h, n_k, bk, d), 2, 0)
+    vb_s = jnp.moveaxis(vp.reshape(b, h, n_k, bk, d), 2, 0)
+    kpos_s = jnp.arange(n_k * bk, dtype=jnp.int32).reshape(n_k, bk)
+    q_pos = jnp.arange(lq, dtype=jnp.int32)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    # pass 1: streaming softmax stats (m, l) per query row
+    def stats_step(carry, xs):
+        m, l = carry
+        kb, kpos = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale_v
+        live = _block_mask(q_pos, kpos, lk, offset, causal)
+        s = jnp.where(live, s, _NEG)
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - new_m) + jnp.sum(
+            jnp.where(live, jnp.exp(s - new_m[..., None]), 0.0), axis=-1)
+        return (new_m, l), None
+
+    m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    (m, l), _ = jax.lax.scan(stats_step, (m0, l0), (kb_s, kpos_s))
+    l_safe = jnp.maximum(l, 1e-20)
+    # D_i = sum_j P_ij (dO_i · V_j) = dO_i · O_i  (flash-bwd identity)
+    D = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, h, lq)
+
+    # pass 2: accumulate dQ; emit per-block dK/dV
+    def grad_step(dq, xs):
+        kb, vb, kpos = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale_v
+        live = _block_mask(q_pos, kpos, lk, offset, causal)
+        p = jnp.where(live, jnp.exp(s - m[..., None]), 0.0) / l_safe[
+            ..., None]
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vb)
+        ds = p * (dp - D[..., None]) * scale_v
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
+        dkb = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dvb = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        return dq, (dkb, dvb)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_s, dv_s) = jax.lax.scan(grad_step, dq0, (kb_s, vb_s, kpos_s))
+    dk = jnp.moveaxis(dk_s, 0, 2).reshape(b, h, n_k * bk, d)[:, :, :lk]
+    dv = jnp.moveaxis(dv_s, 0, 2).reshape(b, h, n_k * bk, d)[:, :, :lk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_fwd, _bwd)

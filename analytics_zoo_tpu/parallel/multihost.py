@@ -18,17 +18,30 @@ import jax
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
+_initialized = False
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None):
     """Initialise multi-host JAX (idempotent).
 
-    On Cloud TPU VMs all three args are auto-detected from the metadata
-    server; elsewhere pass them explicitly (reference analogue:
-    RayContext.init's head/worker bootstrap).
+    Must be the first JAX call in the process — ``jax.distributed.initialize``
+    refuses to run after the backend exists, so this function deliberately
+    touches no other jax API before it.  On Cloud TPU VMs all three args are
+    auto-detected from the metadata server; elsewhere pass them explicitly
+    (reference analogue: RayContext.init's head/worker bootstrap,
+    raycontext.py:192-393).
+
+    With explicit args, failures propagate (a mis-bootstrapped pod must not
+    silently train as N independent hosts).  With no args, failed
+    auto-detection is treated as single-host and logged at WARNING.
     """
-    if jax.process_count() > 1:
-        return  # already initialised
+    global _initialized
+    if _initialized or jax.distributed.is_initialized():
+        return  # ours or an external launcher's init — both fine
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -38,12 +51,17 @@ def init_distributed(coordinator_address: str | None = None,
         kwargs["process_id"] = process_id
     try:
         jax.distributed.initialize(**kwargs)
+        _initialized = True
         logger.info("jax.distributed initialised: process %d/%d, %d local "
                     "devices", jax.process_index(), jax.process_count(),
                     jax.local_device_count())
     except Exception as e:
-        # single-host dev boxes: fine to run undistributed
-        logger.info("jax.distributed not initialised (%s); single host", e)
+        if explicit:
+            raise
+        logger.warning(
+            "jax.distributed auto-init failed (%s); running single-host. "
+            "On a pod, call init_distributed(...) with explicit args before "
+            "any other JAX usage.", e)
 
 
 def process_local_batch_slice(global_batch_size: int) -> slice:

@@ -40,6 +40,22 @@ class LossFunction:
         return jnp.mean(per_sample)
 
 
+def _align(y_true, y_pred):
+    """Reshape y_true to y_pred's shape when they hold the same number of
+    elements.  Guards the classic silent-broadcast bug: (B,) targets vs
+    (B, 1) predictions would otherwise broadcast to (B, B) inside an
+    elementwise loss."""
+    ts, ps = jnp.shape(y_true), jnp.shape(y_pred)
+    if ts == ps:
+        return y_true
+    import math
+    if math.prod(ts) == math.prod(ps):
+        return jnp.reshape(y_true, ps)
+    raise ValueError(
+        f"loss target shape {ts} is incompatible with prediction shape {ps}"
+    )
+
+
 def _reduce_rest(x):
     """Mean over all non-batch axes -> (batch,)."""
     if x.ndim <= 1:
@@ -54,19 +70,23 @@ def _sum_rest(x):
 
 
 def mean_squared_error(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     return _reduce_rest((y_pred - y_true) ** 2)
 
 
 def mean_absolute_error(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     return _reduce_rest(jnp.abs(y_pred - y_true))
 
 
 def mean_absolute_percentage_error(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS))
     return 100.0 * _reduce_rest(diff)
 
 
 def mean_squared_logarithmic_error(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     a = jnp.log(jnp.clip(y_pred, _EPS) + 1.0)
     b = jnp.log(jnp.clip(y_true, _EPS) + 1.0)
     return _reduce_rest((a - b) ** 2)
@@ -74,6 +94,7 @@ def mean_squared_logarithmic_error(y_true, y_pred):
 
 def binary_crossentropy(y_true, y_pred):
     """Expects probabilities in (0,1) (reference BinaryCrossEntropy.scala)."""
+    y_true = _align(y_true, y_pred)
     y_pred = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
     return _reduce_rest(
         -(y_true * jnp.log(y_pred) + (1.0 - y_true) * jnp.log1p(-y_pred))
@@ -129,6 +150,7 @@ def kullback_leibler_divergence(y_true, y_pred):
 
 
 def poisson(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     return _reduce_rest(y_pred - y_true * jnp.log(y_pred + _EPS))
 
 
@@ -141,10 +163,12 @@ def cosine_proximity(y_true, y_pred):
 
 
 def hinge(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     return _reduce_rest(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
 
 def squared_hinge(y_true, y_pred):
+    y_true = _align(y_true, y_pred)
     return _reduce_rest(jnp.maximum(1.0 - y_true * y_pred, 0.0) ** 2)
 
 

@@ -1,0 +1,29 @@
+"""Inference stack — TPU-native re-design of the reference's
+``pipeline/inference`` (InferenceModel.scala:81-657, InferenceModelFactory,
+OpenVinoInferenceSupportive) and the Java POJO surface
+(AbstractInferenceModel.java).
+
+The reference pools mutable model copies in a LinkedBlockingQueue
+(InferenceModel.scala:31-73) because BigDL modules are stateful and
+single-threaded.  A jitted JAX function is pure and reentrant, so the pool
+here bounds *host-side concurrency* with a semaphore while one compiled XLA
+executable serves all callers; the OpenVINO conversion/int8-calibration role
+(OpenVinoInferenceSupportive.scala:33-61) maps to ahead-of-time lowering with
+a persistent XLA compile cache plus weight-only int8 quantization.
+"""
+
+from analytics_zoo_tpu.pipeline.inference.inference_model import (
+    AbstractInferenceModel,
+    InferenceModel,
+)
+from analytics_zoo_tpu.pipeline.inference.quantize import (
+    dequantize_params,
+    quantize_params,
+)
+
+__all__ = [
+    "InferenceModel",
+    "AbstractInferenceModel",
+    "quantize_params",
+    "dequantize_params",
+]

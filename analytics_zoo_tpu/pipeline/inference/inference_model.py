@@ -1,0 +1,224 @@
+"""Pooled, batched inference runner.
+
+Reference: ``InferenceModel`` (pipeline/inference/InferenceModel.scala:81-657)
+— the ``doLoad*`` family loads a model into a pool of ``supportedConcurrentNum``
+copies held in a LinkedBlockingQueue (:31-73); ``doPredict`` (:623-657) takes a
+copy from the queue, runs it, and offers it back.  The Java POJO surface is
+AbstractInferenceModel.java.
+
+TPU-native re-design: one jit-compiled XLA executable is pure and reentrant,
+so there are no model copies — ``concurrent_num`` instead bounds in-flight
+predict calls with a semaphore (device queue depth), and a per-input-shape
+**AOT compile cache** plays the role of OpenVINO's offline model conversion
+(OpenVinoInferenceSupportive.scala): shapes are bucketed to powers of two so
+a bounded set of executables serves arbitrary batch sizes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.inference.quantize import (
+    dequantize_params,
+    quantize_params,
+)
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n (capped), so recompiles are O(log max)."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+class InferenceModel:
+    """Load-once, predict-many inference engine.
+
+    ``concurrent_num`` mirrors the reference pool size
+    (InferenceModel.scala:31-73).  ``predict`` accepts a single ndarray or a
+    list (multi-input models) and handles batching/padding internally.
+    """
+
+    def __init__(self, concurrent_num: int = 4, max_batch: int = 1024):
+        self.concurrent_num = int(concurrent_num)
+        self.max_batch = int(max_batch)
+        self._sem = threading.Semaphore(self.concurrent_num)
+        self._net = None
+        self._params = None
+        self._state = None
+        self._compiled = {}       # shape-key -> compiled executable
+        self._lock = threading.Lock()
+        self._quantized = False
+
+    # ------------------------------------------------------------------
+    # doLoad* family (InferenceModel.scala:81-657)
+    # ------------------------------------------------------------------
+    def load(self, path: str) -> "InferenceModel":
+        """Load a saved KerasNet / ZooModel (reference ``doLoadBigDL``)."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+        obj = ZooModel.load_model(path)
+        net = obj.model if isinstance(obj, ZooModel) else obj
+        if not isinstance(net, KerasNet):
+            raise ValueError(f"{path} does not contain a KerasNet")
+        return self.from_keras_net(net)
+
+    def from_keras_net(self, net) -> "InferenceModel":
+        """Wrap an in-memory model (reference ``doLoad`` from bytes)."""
+        net.build_params()
+        self._net = net
+        self._params = net.params
+        self._state = net.state
+        self._compiled = {}
+        self._quantized = False
+        return self
+
+    def load_torch(self, module, input_shape) -> "InferenceModel":
+        """Run a (CPU) torch module behind the same predict surface
+        (reference ``doLoadPyTorch`` → TorchNet.scala:39-156).  The module is
+        executed on host — the escape hatch for models not yet ported; jax
+        models should use :meth:`load`/:meth:`from_keras_net`."""
+        import torch
+
+        module.eval()
+        self._torch = (module, torch)
+        self._net = None
+        self._compiled = {}
+        return self
+
+    def optimize(self, precision: str = "int8") -> "InferenceModel":
+        """Offline optimization pass (the OpenVINO-conversion role,
+        InferenceModel.scala doLoadOpenVINO* + int8 calibration).
+
+        ``int8``: weight-only per-channel quantization (HBM traffic ~4x
+        lower); ``bf16``: cast weights to bfloat16 (MXU-native).
+        """
+        if self._net is None:
+            raise RuntimeError("load a model first")
+        if precision == "int8":
+            self._params = quantize_params(self._net.params)
+            self._quantized = True
+        elif precision == "bf16":
+            import jax.numpy as jnp
+
+            self._params = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                self._net.params,
+            )
+            self._quantized = False
+        else:
+            raise ValueError(f"unknown precision {precision!r}")
+        self._compiled = {}
+        return self
+
+    @staticmethod
+    def enable_persistent_compile_cache(cache_dir: str) -> None:
+        """Persistent XLA compile cache on disk — the moral equivalent of
+        OpenVINO's saved IR: second process start skips compilation."""
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    # ------------------------------------------------------------------
+    # compile cache
+    # ------------------------------------------------------------------
+    def _forward_fn(self):
+        net, quantized = self._net, self._quantized
+
+        def fwd(params, state, xs):
+            if quantized:
+                params = dequantize_params(params)
+            x = xs[0] if len(xs) == 1 else list(xs)
+            out, _ = net.forward(params, x, state=state, training=False)
+            return out
+
+        return fwd
+
+    def _get_compiled(self, xs: Sequence[np.ndarray]):
+        key = tuple((a.shape, str(a.dtype)) for a in xs)
+        exe = self._compiled.get(key)
+        if exe is None:
+            with self._lock:
+                exe = self._compiled.get(key)
+                if exe is None:
+                    # AOT: lower + compile now, store the executable
+                    exe = (
+                        jax.jit(self._forward_fn())
+                        .lower(self._params, self._state, list(xs))
+                        .compile()
+                    )
+                    self._compiled[key] = exe
+        return exe
+
+    def warmup(self, input_shapes, dtype=np.float32,
+               batch_sizes=(1,)) -> None:
+        """Pre-compile executables for the given shapes (offline-conversion
+        step; avoids first-request latency)."""
+        shapes = input_shapes
+        if shapes and not isinstance(shapes[0], (list, tuple)):
+            shapes = [shapes]
+        for b in batch_sizes:
+            xs = [np.zeros((int(b),) + tuple(s), dtype) for s in shapes]
+            self._get_compiled(xs)
+
+    # ------------------------------------------------------------------
+    # doPredict (InferenceModel.scala:623-657)
+    # ------------------------------------------------------------------
+    def predict(self, inputs, batch_size: int | None = None) -> np.ndarray:
+        """Batched inference.  Pads each micro-batch to a power-of-two bucket
+        (static shapes for XLA), bounded by the concurrency semaphore."""
+        if getattr(self, "_torch", None) is not None and self._net is None:
+            module, torch = self._torch
+            with torch.no_grad():
+                out = module(torch.as_tensor(np.asarray(inputs)))
+            return out.numpy()
+        if self._net is None:
+            raise RuntimeError("no model loaded")
+
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        xs = [np.asarray(a) for a in xs]
+        n = xs[0].shape[0]
+        step = min(batch_size or n, self.max_batch)
+        outs = []
+        for lo in range(0, n, step):
+            chunk = [a[lo:lo + step] for a in xs]
+            m = chunk[0].shape[0]
+            b = _bucket(m, self.max_batch)
+            if b != m:
+                chunk = [
+                    np.concatenate(
+                        [a, np.zeros((b - m,) + a.shape[1:], a.dtype)]
+                    )
+                    for a in chunk
+                ]
+            exe = self._get_compiled(chunk)
+            with self._sem:
+                out = exe(self._params, self._state, chunk)
+                # materialize inside the semaphore so concurrent_num truly
+                # bounds in-flight device work (dispatch is async)
+                if isinstance(out, (list, tuple)):
+                    out = [np.asarray(o)[:m] for o in out]
+                else:
+                    out = np.asarray(out)[:m]
+            outs.append(out)
+        if isinstance(outs[0], list):
+            return [np.concatenate([o[i] for o in outs])
+                    for i in range(len(outs[0]))]
+        return np.concatenate(outs, axis=0)
+
+    # camelCase aliases matching the reference Java/Scala POJO surface
+    doPredict = predict
+    doLoad = load
+
+
+class AbstractInferenceModel(InferenceModel):
+    """Java-POJO-style subclassable surface
+    (reference AbstractInferenceModel.java)."""

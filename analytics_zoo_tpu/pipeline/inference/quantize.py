@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization for inference.
+
+Reference role: OpenVINO int8 calibration
+(InferenceModel.scala ``doLoadOpenVINOInt8`` family;
+OpenVinoInferenceSupportive.scala:33-61) with the whitepaper claim of 4x
+model-size reduction at <=0.1% accuracy drop (docs/docs/wp-bigdl.md:192).
+
+TPU-native design: per-output-channel symmetric int8 quantization of the
+*parameter pytree*; activations stay bf16/f32.  Dequantization happens
+on-device right before the matmul/conv, which XLA fuses into the consumer, so
+HBM traffic for weights drops ~4x — the same bandwidth win the reference gets
+from VNNI int8 — while the MXU still sees bf16 operands.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantizedTensor:
+    """int8 values + per-channel float scale; a pytree leaf pair."""
+
+    def __init__(self, values, scale, axis: int):
+        self.values = values          # int8, original shape
+        self.scale = scale            # f32, broadcastable to values
+        self.axis = axis
+
+    def dequantize(self, dtype=jnp.float32):
+        return self.values.astype(dtype) * self.scale.astype(dtype)
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    def tree_flatten(self):
+        return (self.values, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        return cls(children[0], children[1], axis)
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    QuantizedTensor.tree_flatten,
+    QuantizedTensor.tree_unflatten,
+)
+
+
+def _quantize_array(a, axis: int) -> QuantizedTensor:
+    a = jnp.asarray(a)
+    reduce_axes = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+    amax = jnp.max(jnp.abs(a), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(a / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale, axis % a.ndim)
+
+
+def quantize_params(params, min_size: int = 1024):
+    """Quantize every large (>= min_size elements, ndim >= 2) weight to int8.
+
+    Channel axis = last dim (dense kernels (in, out) and conv kernels
+    (..., in, out) both store output channels last in this framework).
+    Small tensors (biases, norms) stay in full precision — matching the
+    reference's calibration behavior of only quantizing conv/FC weights.
+    """
+    def q(leaf):
+        arr = jnp.asarray(leaf)
+        if arr.ndim >= 2 and arr.size >= min_size and jnp.issubdtype(
+                arr.dtype, jnp.floating):
+            return _quantize_array(arr, axis=-1)
+        return arr
+
+    return jax.tree_util.tree_map(q, params)
+
+
+def dequantize_params(params, dtype=jnp.float32):
+    """Materialize a float pytree from a quantized one (device-side; XLA
+    fuses the dequant multiply into each weight's consumer)."""
+    def dq(leaf):
+        if isinstance(leaf, QuantizedTensor):
+            return leaf.dequantize(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        dq, params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+    )
+
+
+def quantization_error(params, qparams) -> float:
+    """Max relative L2 error across quantized leaves (calibration check)."""
+    errs = []
+    flat, _ = jax.tree_util.tree_flatten(params)
+    qflat, _ = jax.tree_util.tree_flatten(
+        qparams, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+    )
+    for a, qa in zip(flat, qflat):
+        if isinstance(qa, QuantizedTensor):
+            a = np.asarray(a)
+            d = np.asarray(qa.dequantize())
+            denom = np.linalg.norm(a)
+            if denom > 0:
+                errs.append(float(np.linalg.norm(a - d) / denom))
+    return max(errs) if errs else 0.0

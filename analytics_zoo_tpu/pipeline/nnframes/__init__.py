@@ -1,0 +1,26 @@
+"""nnframes — DataFrame-native ML pipeline API.
+
+TPU re-design of the reference's Spark ML integration
+(zoo/.../pipeline/nnframes/NNEstimator.scala, NNClassifier.scala,
+NNImageReader.scala; python pyzoo/zoo/pipeline/nnframes/nn_classifier.py).
+pandas DataFrames stand in for Spark DataFrames: the Estimator/Transformer
+contract, column-based feature/label wiring, and preprocessing composition
+are preserved while training funnels into the same jitted SPMD train step as
+the Keras API.
+"""
+
+from analytics_zoo_tpu.pipeline.nnframes.nn_estimator import (
+    NNClassifier,
+    NNClassifierModel,
+    NNEstimator,
+    NNModel,
+)
+from analytics_zoo_tpu.pipeline.nnframes.nn_image_reader import NNImageReader
+
+__all__ = [
+    "NNEstimator",
+    "NNModel",
+    "NNClassifier",
+    "NNClassifierModel",
+    "NNImageReader",
+]

@@ -1,0 +1,210 @@
+"""NNEstimator / NNModel / NNClassifier — DataFrame Estimator/Transformer.
+
+Reference: nnframes/NNEstimator.scala — ``internalFit`` (:414-479) converts
+``df.rdd`` to (feature, label) samples through ``samplePreprocessing``
+(:382-412 ``getDataSet``), trains via InternalDistriOptimizer, and wraps the
+trained net in an ``NNModel`` whose ``transform`` broadcasts the model and
+appends a prediction column (:635-806).  ``NNClassifier`` /
+``NNClassifierModel`` (NNClassifier.scala) specialize to classification.
+Python twins: pyzoo nn_classifier.py:135 (NNEstimator), :453 (NNModel),
+:513 (NNClassifier), :559 (NNClassifierModel).
+
+Here the DataFrame is pandas, samples become a FeatureSet, and training runs
+the jitted psum train step; ``transform`` runs the pooled batched jax
+forward and appends the column.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+
+
+def _col_to_array(col, preprocessing: Preprocessing | None = None):
+    vals = list(col)
+    if preprocessing is not None:
+        vals = [preprocessing(v) for v in vals]
+    arrs = [np.asarray(v, dtype=np.float32) for v in vals]
+    return np.stack(arrs) if arrs and arrs[0].ndim > 0 else np.asarray(
+        arrs, dtype=np.float32)
+
+
+class _Params:
+    """Chainable set/get param surface (Spark ML Params style, as the
+    reference's ``setFeaturesCol``/``setBatchSize``/... builders)."""
+
+    def __init__(self):
+        self._features_col = "features"
+        self._label_col = "label"
+        self._prediction_col = "prediction"
+        self._batch_size = 32
+        self._max_epoch = 10
+
+    def set_features_col(self, name):
+        self._features_col = name
+        return self
+
+    def set_label_col(self, name):
+        self._label_col = name
+        return self
+
+    def set_prediction_col(self, name):
+        self._prediction_col = name
+        return self
+
+    def set_batch_size(self, v):
+        self._batch_size = int(v)
+        return self
+
+    # camelCase aliases for parity with the py reference surface
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+    setPredictionCol = set_prediction_col
+    setBatchSize = set_batch_size
+
+
+class NNEstimator(_Params):
+    """Trains a model on a DataFrame (reference NNEstimator.scala:198).
+
+    Args:
+      model: a KerasNet (Sequential/Model) or ZooModel.
+      criterion: loss identifier or LossFunction (reference ``criterion``).
+      sample_preprocessing: Preprocessing applied to each feature cell
+        before stacking (reference ``samplePreprocessing``).
+    """
+
+    def __init__(self, model, criterion="mse",
+                 sample_preprocessing: Preprocessing | None = None):
+        super().__init__()
+        from analytics_zoo_tpu.models.common import ZooModel
+
+        self.model = model.model if isinstance(model, ZooModel) else model
+        self.criterion = criterion
+        self.sample_preprocessing = sample_preprocessing
+        self._optim_method = "adam"
+        self._validation = None        # (df, trigger) — trigger unused yet
+        self._checkpoint_path = None
+        self._tensorboard = None
+        self._grad_clip = None
+
+    def set_optim_method(self, optimizer):
+        self._optim_method = optimizer
+        return self
+
+    def set_max_epoch(self, v):
+        self._max_epoch = int(v)
+        return self
+
+    def set_validation(self, df, batch_size=None):
+        """Reference ``setValidation`` (NNEstimator.scala:443-468)."""
+        self._validation = df
+        return self
+
+    def set_checkpoint(self, path):
+        self._checkpoint_path = path
+        return self
+
+    def set_tensorboard(self, log_dir, app_name):
+        self._tensorboard = (log_dir, app_name)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self._grad_clip = ("l2norm", float(clip_norm))
+        return self
+
+    setOptimMethod = set_optim_method
+    setMaxEpoch = set_max_epoch
+    setValidation = set_validation
+    setCheckpoint = set_checkpoint
+
+    def _df_to_xy(self, df):
+        x = _col_to_array(df[self._features_col], self.sample_preprocessing)
+        y = None
+        if self._label_col in df.columns:
+            # label cells keep their own shape: scalar rows -> (B,), vector
+            # rows -> (B, d).  No squeezing — an (B, 1) regression target vs
+            # (B,) would silently broadcast to (B, B) inside mse.
+            y = _col_to_array(df[self._label_col])
+        return x, y
+
+    def fit(self, df) -> "NNModel":
+        """Reference ``internalFit`` NNEstimator.scala:414-479."""
+        x, y = self._df_to_xy(df)
+        self.model.compile(optimizer=self._optim_method,
+                           loss=self.criterion)
+        if self._tensorboard:
+            self.model.set_tensorboard(*self._tensorboard)
+        if self._checkpoint_path:
+            self.model.set_checkpoint(self._checkpoint_path)
+        if self._grad_clip and self._grad_clip[0] == "l2norm":
+            self.model.set_gradient_clipping_by_l2_norm(self._grad_clip[1])
+        val = None
+        if self._validation is not None:
+            val = self._df_to_xy(self._validation)
+        self.model.fit(x, y, batch_size=self._batch_size,
+                       nb_epoch=self._max_epoch, validation_data=val)
+        return self._wrap_model()
+
+    def _wrap_model(self) -> "NNModel":
+        """Reference ``wrapBigDLModel`` NNEstimator.scala:484-491 (clones
+        the preprocessing into the transformer)."""
+        m = NNModel(self.model, self.sample_preprocessing)
+        m.set_features_col(self._features_col)
+        m.set_prediction_col(self._prediction_col)
+        m.set_batch_size(self._batch_size)
+        return m
+
+
+class NNModel(_Params):
+    """Transformer: appends model predictions as a DataFrame column
+    (reference NNModel.transform, NNEstimator.scala:635-806)."""
+
+    def __init__(self, model, feature_preprocessing=None):
+        super().__init__()
+        from analytics_zoo_tpu.models.common import ZooModel
+
+        self.model = model.model if isinstance(model, ZooModel) else model
+        self.feature_preprocessing = feature_preprocessing
+
+    def _predict_array(self, df) -> np.ndarray:
+        x = _col_to_array(df[self._features_col],
+                          self.feature_preprocessing)
+        return self.model.predict(x, batch_size=self._batch_size)
+
+    def transform(self, df):
+        out = self._predict_array(df)
+        df = df.copy()
+        df[self._prediction_col] = [np.asarray(row) for row in out]
+        return df
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar (reference NNClassifier.scala; py
+    nn_classifier.py:513): sparse-categorical criterion by default, model
+    wrapped as NNClassifierModel emitting class labels."""
+
+    def __init__(self, model, criterion="sparse_categorical_crossentropy",
+                 sample_preprocessing=None):
+        super().__init__(model, criterion, sample_preprocessing)
+
+    def _wrap_model(self):
+        m = NNClassifierModel(self.model, self.sample_preprocessing)
+        m.set_features_col(self._features_col)
+        m.set_prediction_col(self._prediction_col)
+        m.set_batch_size(self._batch_size)
+        return m
+
+
+class NNClassifierModel(NNModel):
+    """Reference NNClassifierModel (nn_classifier.py:559): prediction column
+    holds the argmax class index (float, matching Spark ML convention)."""
+
+    def transform(self, df):
+        probs = self._predict_array(df)
+        df = df.copy()
+        df[self._prediction_col] = np.argmax(probs, axis=-1).astype(
+            np.float64)
+        return df

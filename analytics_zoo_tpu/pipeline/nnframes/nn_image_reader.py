@@ -1,0 +1,44 @@
+"""NNImageReader — image folders into DataFrames.
+
+Reference: nnframes/NNImageReader.scala reads images into a Spark DataFrame
+with an image-schema column.  Here: a pandas DataFrame with ``image``
+(HWC uint8 ndarray), ``origin`` (path), ``height``/``width``/``n_channels``
+columns, so nnframes estimators consume the same shape of table.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class NNImageReader:
+    @staticmethod
+    def read_images(path: str, min_partitions: int = 1,
+                    resize_h: int = -1, resize_w: int = -1):
+        """Reference ``NNImageReader.readImages``; resizeH/resizeW args keep
+        the reference signature (-1 = keep native size)."""
+        import pandas as pd
+
+        from analytics_zoo_tpu.feature.image.imageset import ImageSet
+        from analytics_zoo_tpu.feature.image.transforms import ImageResize
+
+        iset = ImageSet.read(path, with_label=False)
+        images = iset.images
+        if resize_h > 0 and resize_w > 0:
+            rs = ImageResize(resize_h, resize_w)
+            images = [rs(im) for im in images]
+        rows = []
+        for img, p in zip(images, iset.paths or [None] * len(images)):
+            img = np.asarray(img)
+            rows.append({
+                "image": img,
+                "origin": p if p is None else os.path.abspath(p),
+                "height": img.shape[0],
+                "width": img.shape[1],
+                "n_channels": img.shape[2] if img.ndim == 3 else 1,
+            })
+        return pd.DataFrame(rows)
+
+    readImages = read_images

@@ -1,0 +1,102 @@
+"""nnframes suite — mirrors the reference's pyzoo/test/zoo/pipeline/nnframes
+tests: fit on a DataFrame, transform appends a prediction column, classifier
+round-trip, image reader."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.topology import Sequential
+from analytics_zoo_tpu.pipeline.nnframes import (
+    NNClassifier,
+    NNClassifierModel,
+    NNEstimator,
+    NNImageReader,
+    NNModel,
+)
+
+
+def _blob_df(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 3
+    y = rng.integers(0, classes, size=(n,))
+    x = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+    return pd.DataFrame({
+        "features": [row for row in x],
+        "label": y.astype(np.float32),
+    })
+
+
+class TestNNEstimator:
+    def setup_method(self, _):
+        init_zoo_context(seed=0)
+
+    def test_fit_regression_and_transform(self):
+        df = pd.DataFrame({
+            "features": [np.array([v, v], np.float32)
+                         for v in np.linspace(0, 1, 64)],
+            "label": [np.array([2 * v], np.float32)
+                      for v in np.linspace(0, 1, 64)],
+        })
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        net = Sequential().add(Dense(1, input_shape=(2,)))
+        est = (NNEstimator(net, "mse").set_optim_method(Adam(lr=0.05))
+               .set_batch_size(16).set_max_epoch(40))
+        model = est.fit(df)
+        assert isinstance(model, NNModel)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        pred = np.stack(out["prediction"].to_list())
+        want = np.stack(df["label"].to_list())
+        assert np.mean((pred - want) ** 2) < 0.05
+
+    def test_classifier_accuracy(self):
+        df = _blob_df()
+        net = Sequential()
+        net.add(Dense(16, input_shape=(8,), activation="relu"))
+        net.add(Dense(3, activation="softmax"))
+        clf = NNClassifier(net).set_batch_size(32).set_max_epoch(20)
+        model = clf.fit(df)
+        assert isinstance(model, NNClassifierModel)
+        out = model.transform(df)
+        acc = (out["prediction"].to_numpy()
+               == df["label"].to_numpy()).mean()
+        assert acc > 0.9
+
+    def test_param_builders_chain(self):
+        net = Sequential().add(Dense(1, input_shape=(2,)))
+        est = (NNEstimator(net, "mse")
+               .setFeaturesCol("f").setLabelCol("l")
+               .setPredictionCol("p").setBatchSize(8).setMaxEpoch(2))
+        df = pd.DataFrame({
+            "f": [np.zeros(2, np.float32)] * 8,
+            "l": [np.zeros(1, np.float32)] * 8,
+        })
+        model = est.fit(df)
+        out = model.transform(df)
+        assert "p" in out.columns
+
+
+class TestNNImageReader:
+    def test_read_images(self, tmp_path):
+        from PIL import Image
+        for i in range(3):
+            Image.fromarray(
+                np.full((10, 12, 3), i * 40, np.uint8)
+            ).save(tmp_path / f"img{i}.png")
+        df = NNImageReader.read_images(str(tmp_path))
+        assert len(df) == 3
+        assert set(["image", "origin", "height", "width",
+                    "n_channels"]) <= set(df.columns)
+        assert df.iloc[0]["image"].shape == (10, 12, 3)
+
+    def test_read_images_resize(self, tmp_path):
+        from PIL import Image
+        Image.fromarray(np.zeros((20, 20, 3), np.uint8)).save(
+            tmp_path / "a.png")
+        df = NNImageReader.read_images(str(tmp_path), resize_h=8,
+                                       resize_w=6)
+        assert df.iloc[0]["image"].shape == (8, 6, 3)
