@@ -1,0 +1,20 @@
+"""3D (medical) image transforms — reference zoo/.../feature/image3d
+(AffineTransform3D, Crop3D variants, Rotate3D)."""
+
+from analytics_zoo_tpu.feature.image3d.transforms import (
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    RandomCrop3D,
+    Rotate3D,
+    rotation_matrix_3d,
+)
+
+__all__ = [
+    "AffineTransform3D",
+    "Crop3D",
+    "CenterCrop3D",
+    "RandomCrop3D",
+    "Rotate3D",
+    "rotation_matrix_3d",
+]

@@ -1,0 +1,2 @@
+"""Image model zoo: classification + object detection (reference
+zoo/.../models/image)."""

@@ -1,0 +1,44 @@
+"""Object detection stack (reference zoo/.../models/image/objectdetection):
+SSD graphs, MultiBox loss, NMS postprocess, VOC mAP evaluation, the
+ObjectDetector zoo model, and box visualization."""
+
+from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
+    PascalVocEvaluator,
+    average_precision,
+    mean_average_precision,
+)
+from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
+    MultiBoxLoss,
+    decode_boxes,
+    encode_boxes,
+    iou_matrix,
+    match_priors,
+)
+from analytics_zoo_tpu.models.image.objectdetection.object_detector import (
+    PASCAL_CLASSES,
+    ObjectDetector,
+    pad_ground_truth,
+)
+from analytics_zoo_tpu.models.image.objectdetection.postprocess import (
+    detect,
+    nms_numpy,
+    visualize,
+)
+from analytics_zoo_tpu.models.image.objectdetection.priors import (
+    PriorSpec,
+    SSD300_SPECS,
+    generate_priors,
+)
+from analytics_zoo_tpu.models.image.objectdetection.ssd import (
+    ssd_tiny,
+    ssd_vgg300,
+)
+
+__all__ = [
+    "ObjectDetector", "PASCAL_CLASSES", "pad_ground_truth",
+    "MultiBoxLoss", "match_priors", "encode_boxes", "decode_boxes",
+    "iou_matrix", "detect", "nms_numpy", "visualize",
+    "average_precision", "mean_average_precision", "PascalVocEvaluator",
+    "PriorSpec", "SSD300_SPECS", "generate_priors",
+    "ssd_vgg300", "ssd_tiny",
+]

@@ -1,0 +1,163 @@
+"""MultiBox loss — matching, offset encoding, hard-negative mining.
+
+Reference: objectdetection/common/loss/MultiBoxLoss.scala (smooth-L1 loc
+loss on matched priors + softmax conf loss with 3:1 hard-negative mining).
+
+TPU re-design: everything is static-shape jnp inside the jitted train step.
+Ground truth arrives padded to ``max_boxes`` per image (label -1 = padding) —
+the padding/bucketing answer to jit's static-shape regime called out in
+SURVEY.md §7 hard-part 3.  Matching is vectorized IoU + argmax (no mutable
+bipartite loop as in the reference): each prior takes its best gt, and each
+gt's single best prior is force-matched through a one-hot override so every
+gt owns >= 1 prior.  Hard-negative mining uses the rank-of-rank sort trick —
+a fixed-shape replacement for the reference's per-image mutable heap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.objectives import LossFunction
+
+
+def iou_matrix(a_corner, b_corner):
+    """Pairwise IoU: a (..., Na, 4), b (..., Nb, 4) corner boxes ->
+    (..., Na, Nb)."""
+    lo = jnp.maximum(a_corner[..., :, None, 0:2], b_corner[..., None, :, 0:2])
+    hi = jnp.minimum(a_corner[..., :, None, 2:4], b_corner[..., None, :, 2:4])
+    inter = jnp.prod(jnp.clip(hi - lo, 0.0), axis=-1)
+    area_a = jnp.prod(a_corner[..., 2:4] - a_corner[..., 0:2], axis=-1)
+    area_b = jnp.prod(b_corner[..., 2:4] - b_corner[..., 0:2], axis=-1)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def encode_boxes(matched_corner, priors_center, variances=(0.1, 0.2)):
+    """gt corner boxes -> regression targets w.r.t. priors (SSD encoding)."""
+    wh = matched_corner[..., 2:4] - matched_corner[..., 0:2]
+    c = matched_corner[..., 0:2] + 0.5 * wh
+    d_c = (c - priors_center[..., 0:2]) / (
+        priors_center[..., 2:4] * variances[0])
+    d_wh = jnp.log(jnp.clip(wh / priors_center[..., 2:4], 1e-8)) / \
+        variances[1]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc, priors_center, variances=(0.1, 0.2)):
+    """Regression outputs -> corner boxes (inverse of encode_boxes)."""
+    c = priors_center[..., 0:2] + loc[..., 0:2] * variances[0] * \
+        priors_center[..., 2:4]
+    wh = priors_center[..., 2:4] * jnp.exp(loc[..., 2:4] * variances[1])
+    lo = c - 0.5 * wh
+    hi = c + 0.5 * wh
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def match_priors(gt_corner, gt_labels, priors_corner, iou_threshold=0.5):
+    """Per-image matching.
+
+    Args:
+      gt_corner: (max_boxes, 4) padded gt corner boxes.
+      gt_labels: (max_boxes,) class ids in [0, C); -1 marks padding.
+      priors_corner: (P, 4).
+
+    Returns:
+      (conf_target (P,) int32 with 0 = background and label+1 otherwise,
+       matched_corner (P, 4) the gt box each prior regresses to).
+    """
+    valid = gt_labels >= 0
+    iou = iou_matrix(priors_corner, gt_corner)          # (P, M)
+    iou = jnp.where(valid[None, :], iou, -1.0)
+
+    best_gt = jnp.argmax(iou, axis=1)                   # (P,)
+    best_gt_iou = jnp.max(iou, axis=1)
+
+    # force-match: each gt's best prior adopts that gt with iou 2.0
+    best_prior = jnp.argmax(iou, axis=0)                # (M,)
+    m = gt_corner.shape[0]
+    force = jnp.zeros_like(iou).at[
+        best_prior, jnp.arange(m)].set(jnp.where(valid, 2.0, -1.0))
+    iou = jnp.maximum(iou, force)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_gt_iou = jnp.max(iou, axis=1)
+
+    matched_corner = gt_corner[best_gt]                 # (P, 4)
+    matched_label = gt_labels[best_gt]                  # (P,)
+    positive = best_gt_iou >= iou_threshold
+    conf_target = jnp.where(positive, matched_label + 1, 0).astype(jnp.int32)
+    return conf_target, matched_corner
+
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+class MultiBoxLoss(LossFunction):
+    """SSD loss over concatenated (loc, conf-logits) model output.
+
+    ``y_pred``: (B, P, 4 + C+1) — 4 loc offsets then C+1 class logits
+    (class 0 = background).  ``y_true``: (B, max_boxes, 5) rows of
+    (xmin, ymin, xmax, ymax, label) with label -1 padding.
+
+    Reference MultiBoxLoss.scala: loc smooth-L1 over positives + conf
+    cross-entropy over positives and the top-(neg_pos_ratio x n_pos)
+    hardest negatives, normalized by n_pos.
+    """
+
+    def __init__(self, priors: np.ndarray, n_classes: int,
+                 iou_threshold=0.5, neg_pos_ratio=3.0,
+                 variances=(0.1, 0.2), loc_weight=1.0):
+        self.priors_center = jnp.asarray(priors)
+        from analytics_zoo_tpu.models.image.objectdetection.priors import (
+            center_to_corner,
+        )
+
+        self.priors_corner = jnp.asarray(center_to_corner(priors))
+        self.n_classes = n_classes
+        self.iou_threshold = iou_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.variances = variances
+        self.loc_weight = loc_weight
+        super().__init__(self._fn, "multibox")
+
+    def _fn(self, y_true, y_pred):
+        loc = y_pred[..., :4]                            # (B, P, 4)
+        logits = y_pred[..., 4:]                         # (B, P, C+1)
+        gt_boxes = y_true[..., :4]
+        gt_labels = y_true[..., 4].astype(jnp.int32)
+
+        conf_t, matched = jax.vmap(
+            lambda b, l: match_priors(b, l, self.priors_corner,
+                                      self.iou_threshold)
+        )(gt_boxes, gt_labels)
+
+        pos = conf_t > 0                                 # (B, P)
+        n_pos = jnp.sum(pos, axis=1)                     # (B,)
+
+        loc_t = encode_boxes(matched, self.priors_center, self.variances)
+        loc_loss = jnp.sum(
+            jnp.where(pos[..., None], _smooth_l1(loc - loc_t), 0.0),
+            axis=(1, 2),
+        )
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, conf_t[..., None], axis=-1)[..., 0]
+
+        # hard negative mining: per image rank negatives by ce descending;
+        # keep rank < neg_pos_ratio * n_pos (rank-of-rank trick keeps shapes
+        # static under jit)
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce, axis=1)
+        rank = jnp.argsort(order, axis=1)
+        n_neg = jnp.minimum(
+            (self.neg_pos_ratio * n_pos).astype(jnp.int32),
+            jnp.sum(~pos, axis=1),
+        )
+        neg = rank < n_neg[:, None]
+        conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0), axis=1)
+
+        denom = jnp.maximum(n_pos.astype(jnp.float32), 1.0)
+        return (self.loc_weight * loc_loss + conf_loss) / denom

@@ -36,6 +36,12 @@ def _attention_reference(q, k, v, causal, scale):
         mask = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
         scores = jnp.where(mask, scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
+    if causal:
+        # keyless rows (lq > lk end-aligned) output zero, matching the
+        # streaming kernel's acc/max(l, eps) and the blockwise backward —
+        # not softmax's uniform distribution over fully-masked rows
+        any_key = jnp.any(mask, axis=-1)
+        probs = jnp.where(any_key[..., None], probs, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 

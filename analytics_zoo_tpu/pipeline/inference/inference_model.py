@@ -161,12 +161,13 @@ class InferenceModel:
     def warmup(self, input_shapes, dtype=np.float32,
                batch_sizes=(1,)) -> None:
         """Pre-compile executables for the given shapes (offline-conversion
-        step; avoids first-request latency)."""
+        step; avoids first-request latency).  Batch sizes are rounded up to
+        the power-of-two buckets predict actually requests."""
         shapes = input_shapes
         if shapes and not isinstance(shapes[0], (list, tuple)):
             shapes = [shapes]
-        for b in batch_sizes:
-            xs = [np.zeros((int(b),) + tuple(s), dtype) for s in shapes]
+        for b in {_bucket(int(b), self.max_batch) for b in batch_sizes}:
+            xs = [np.zeros((b,) + tuple(s), dtype) for s in shapes]
             self._get_compiled(xs)
 
     # ------------------------------------------------------------------
@@ -177,15 +178,40 @@ class InferenceModel:
         (static shapes for XLA), bounded by the concurrency semaphore."""
         if getattr(self, "_torch", None) is not None and self._net is None:
             module, torch = self._torch
-            with torch.no_grad():
-                out = module(torch.as_tensor(np.asarray(inputs)))
-            return out.numpy()
+            xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            xs = [np.asarray(a) for a in xs]
+            n = xs[0].shape[0]
+            step = min(batch_size or max(n, 1), self.max_batch)
+            outs = []
+            for lo in range(0, n, step):
+                args = [torch.as_tensor(a[lo:lo + step]) for a in xs]
+                with self._sem, torch.no_grad():
+                    outs.append(module(*args).numpy())
+            if not outs:
+                with torch.no_grad():
+                    probe = module(*[torch.as_tensor(a[:1]) for a in
+                                     [np.zeros((1,) + x.shape[1:], x.dtype)
+                                      for x in xs]])
+                return np.zeros((0,) + tuple(probe.shape[1:]),
+                                probe.numpy().dtype)
+            return np.concatenate(outs, axis=0)
         if self._net is None:
             raise RuntimeError("no model loaded")
 
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(a) for a in xs]
         n = xs[0].shape[0]
+        if n == 0:
+            # run a padded singleton through the bucket-1 executable just to
+            # learn the output shape, then return it empty
+            dummy = [np.zeros((1,) + a.shape[1:], a.dtype) for a in xs]
+            exe = self._get_compiled(dummy)
+            out = exe(self._params, self._state, dummy)
+            if isinstance(out, (list, tuple)):
+                return [np.zeros((0,) + tuple(np.asarray(o).shape[1:]),
+                                 np.asarray(o).dtype) for o in out]
+            return np.zeros((0,) + tuple(np.asarray(out).shape[1:]),
+                            np.asarray(out).dtype)
         step = min(batch_size or n, self.max_batch)
         outs = []
         for lo in range(0, n, step):

@@ -1,0 +1,84 @@
+"""3D transform tests (reference image3d specs)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    RandomCrop3D,
+    Rotate3D,
+    rotation_matrix_3d,
+)
+
+
+def _vol(shape=(8, 10, 12), seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestCrop:
+    def test_crop_shape_and_content(self):
+        v = _vol()
+        out = Crop3D((1, 2, 3), (4, 5, 6))(v)
+        assert out.shape == (4, 5, 6)
+        np.testing.assert_array_equal(out, v[1:5, 2:7, 3:9])
+
+    def test_crop_out_of_bounds_raises(self):
+        with pytest.raises(ValueError):
+            Crop3D((6, 0, 0), (4, 4, 4))(_vol())
+
+    def test_center_crop(self):
+        out = CenterCrop3D((4, 4, 4))(_vol())
+        np.testing.assert_array_equal(out, _vol()[2:6, 3:7, 4:8])
+
+    def test_random_crop_in_bounds_and_reproducible(self):
+        op1 = RandomCrop3D((4, 4, 4))
+        out = op1(_vol())
+        assert out.shape == (4, 4, 4)
+
+    def test_channel_volume(self):
+        v = _vol((8, 8, 8)).reshape(8, 8, 8)[..., None].repeat(2, -1)
+        assert Crop3D((0, 0, 0), (4, 4, 4))(v).shape == (4, 4, 4, 2)
+
+
+class TestRotate:
+    def test_identity_rotation(self):
+        v = _vol()
+        out = Rotate3D(0, 0, 0)(v)
+        np.testing.assert_allclose(out, v, atol=1e-5)
+
+    def test_full_turn_approximates_identity(self):
+        v = _vol((9, 9, 9))
+        out = Rotate3D(roll=np.pi / 2)(v)
+        back = Rotate3D(roll=-np.pi / 2)(out)
+        # interior voxels survive two resamples
+        np.testing.assert_allclose(back[2:-2, 2:-2, 2:-2],
+                                   v[2:-2, 2:-2, 2:-2], atol=1e-4)
+
+    def test_rotation_matrix_orthonormal(self):
+        m = rotation_matrix_3d(0.3, -0.2, 0.9)
+        np.testing.assert_allclose(m @ m.T, np.eye(3), atol=1e-10)
+
+    def test_quarter_roll_permutes_axes(self):
+        """roll=90° about the depth axis maps (h, w) -> (w, -h)."""
+        v = np.zeros((5, 5, 5), np.float32)
+        v[2, 1, 2] = 1.0  # one voxel off-center along h
+        out = Rotate3D(roll=np.pi / 2)(v)
+        assert out[2].argmax() != v[2].argmax() or not np.allclose(out, v)
+        assert out.sum() == pytest.approx(1.0, abs=1e-4)
+
+
+class TestAffine:
+    def test_translation_shifts_content(self):
+        v = np.zeros((6, 6, 6), np.float32)
+        v[2, 2, 2] = 1.0
+        out = AffineTransform3D(np.eye(3), translation=(1, 0, 0))(v)
+        assert out[1, 2, 2] == pytest.approx(1.0, abs=1e-6)
+
+    def test_scale_matrix(self):
+        v = _vol((8, 8, 8))
+        out = AffineTransform3D(np.eye(3) * 2.0)(v)  # zoom in 2x
+        assert out.shape == v.shape
+        # center voxel unchanged by center-anchored scaling
+        assert out[3, 3, 3] != 0

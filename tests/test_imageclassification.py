@@ -1,0 +1,75 @@
+"""ImageClassifier zoo tests (reference imageclassification specs)."""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.feature.image.imageset import ImageSet
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassificationConfig,
+    ImageClassifier,
+    ImagenetConfig,
+    LabelOutput,
+)
+
+
+class TestConfig:
+    def test_imagenet_config_chain(self):
+        cfg = ImagenetConfig(224)
+        pre = cfg.preprocessing()
+        img = np.random.default_rng(0).integers(
+            0, 255, size=(300, 400, 3)).astype(np.uint8)
+        out = pre(img)
+        assert out.shape == (224, 224, 3)
+        assert out.dtype == np.float32
+
+    def test_grayscale_config(self):
+        cfg = ImageClassificationConfig(resize=28, crop=28, mean=(0,),
+                                        std=(255.0,))
+        out = cfg.preprocessing()(np.full((32, 32, 1), 255, np.uint8))
+        assert out.shape == (28, 28, 1)
+        np.testing.assert_allclose(out, 1.0)
+
+
+class TestLabelOutput:
+    def test_topk_with_names(self):
+        probs = np.array([[0.1, 0.7, 0.2]])
+        out = LabelOutput({0: "cat", 1: "dog", 2: "fish"}, top_k=2)(probs)
+        assert out[0][0] == ("dog", 0.7)
+        assert out[0][1] == ("fish", 0.2)
+
+
+class TestImageClassifier:
+    def setup_method(self, _):
+        init_zoo_context(seed=0)
+
+    def test_wrap_custom_model_predict_image_set(self):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Dense,
+            Flatten,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras.topology import Sequential
+
+        net = Sequential()
+        net.add(Flatten(input_shape=(8, 8, 3)))
+        net.add(Dense(4, activation="softmax"))
+        clf = ImageClassifier(
+            model=net,
+            config=ImageClassificationConfig(resize=8, crop=8,
+                                             label_map={i: f"c{i}"
+                                                        for i in range(4)}))
+        imgs = ImageSet.from_arrays(
+            np.random.default_rng(1).integers(
+                0, 255, size=(6, 16, 16, 3)).astype(np.uint8))
+        out = clf.predict_image_set(imgs, top_k=2)
+        assert len(out) == 6
+        assert len(out[0]) == 2
+        name, p = out[0][0]
+        assert name.startswith("c") and 0 <= p <= 1
+
+    def test_resnet18_builds(self):
+        clf = ImageClassifier("resnet-18", classes=10)
+        clf.model.build_params()
+        x = np.zeros((2, 224, 224, 3), np.float32)
+        out, _ = clf.model.forward(clf.model.params, x,
+                                   state=clf.model.state)
+        assert out.shape == (2, 10)

@@ -1,0 +1,177 @@
+"""Object detection suite — mirrors the reference's objectdetection specs
+(MultiBoxLoss, NMS, MeanAveragePrecision, SSDGraph shape tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.models.image.objectdetection import (
+    MultiBoxLoss,
+    ObjectDetector,
+    PriorSpec,
+    SSD300_SPECS,
+    average_precision,
+    decode_boxes,
+    encode_boxes,
+    generate_priors,
+    match_priors,
+    mean_average_precision,
+    nms_numpy,
+    pad_ground_truth,
+    ssd_tiny,
+)
+from analytics_zoo_tpu.models.image.objectdetection.priors import (
+    center_to_corner,
+)
+
+
+class TestPriors:
+    def test_ssd300_count_is_8732(self):
+        priors = generate_priors(SSD300_SPECS)
+        assert priors.shape == (8732, 4)
+
+    def test_priors_normalized(self):
+        priors = generate_priors(SSD300_SPECS)
+        assert priors.min() >= 0.0 and priors.max() <= 1.0
+
+    def test_boxes_per_loc(self):
+        assert [s.boxes_per_loc for s in SSD300_SPECS] == [4, 6, 6, 6, 4, 4]
+
+
+class TestEncodeDecode:
+    def test_roundtrip(self):
+        priors = jnp.asarray(generate_priors([PriorSpec(4, 0.2, 0.4,
+                                                        (2.0,))]))
+        gt = jnp.asarray([[0.1, 0.1, 0.4, 0.5]] * priors.shape[0],
+                         jnp.float32)
+        enc = encode_boxes(gt, priors)
+        dec = decode_boxes(enc, priors)
+        np.testing.assert_allclose(dec, gt, rtol=1e-4, atol=1e-5)
+
+
+class TestMatching:
+    def test_every_gt_gets_a_prior(self):
+        priors_c = jnp.asarray(generate_priors([PriorSpec(4, 0.2, 0.4,
+                                                          (2.0,))]))
+        priors_corner = jnp.asarray(center_to_corner(np.asarray(priors_c)))
+        gt = jnp.asarray([[0.0, 0.0, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9],
+                          [0, 0, 0, 0]], jnp.float32)
+        labels = jnp.asarray([0, 2, -1], jnp.int32)
+        conf_t, matched = match_priors(gt, labels, priors_corner)
+        # both real gts own at least one prior (force-match), padding none
+        assert int(jnp.sum(conf_t == 1)) >= 1
+        assert int(jnp.sum(conf_t == 3)) >= 1
+
+    def test_padding_ignored(self):
+        priors_c = jnp.asarray(generate_priors([PriorSpec(2, 0.3, 0.5,
+                                                          (2.0,))]))
+        priors_corner = jnp.asarray(center_to_corner(np.asarray(priors_c)))
+        gt = jnp.zeros((4, 4), jnp.float32)
+        labels = jnp.full((4,), -1, jnp.int32)
+        conf_t, _ = match_priors(gt, labels, priors_corner)
+        assert int(jnp.sum(conf_t)) == 0  # all background
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 1, 1], [0.02, 0, 1, 1], [2, 2, 3, 3]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = nms_numpy(boxes, scores, iou_threshold=0.5)
+        assert list(keep) == [0, 2]
+
+    def test_keeps_all_disjoint(self):
+        boxes = np.array([[0, 0, 1, 1], [2, 2, 3, 3], [5, 5, 6, 6]],
+                         np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        keep = nms_numpy(boxes, scores, iou_threshold=0.5)
+        assert sorted(keep) == [0, 1, 2]
+
+
+class TestMAP:
+    def test_perfect_detection_ap_1(self):
+        gt = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]), classes=np.array([0]))]
+        det = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]),
+                    scores=np.array([0.9]), classes=np.array([0]))]
+        assert average_precision(det, gt, 0) == pytest.approx(1.0)
+
+    def test_miss_halves_recall(self):
+        gt = [dict(boxes=np.array([[0, 0, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]]),
+                   classes=np.array([0, 0]))]
+        det = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]),
+                    scores=np.array([0.9]), classes=np.array([0]))]
+        ap = average_precision(det, gt, 0)
+        assert ap == pytest.approx(0.5)
+
+    def test_false_positive_lowers_precision(self):
+        gt = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]), classes=np.array([0]))]
+        det = [dict(
+            boxes=np.array([[0.7, 0.7, 0.9, 0.9], [0, 0, 0.5, 0.5]]),
+            scores=np.array([0.95, 0.9]), classes=np.array([0, 0]))]
+        ap = average_precision(det, gt, 0)
+        assert 0.4 < ap < 0.6  # fp ranked first: precision 1/2 at recall 1
+
+    def test_map_averages_classes(self):
+        gt = [dict(boxes=np.array([[0, 0, 0.5, 0.5], [0.5, 0.5, 1, 1]]),
+                   classes=np.array([0, 1]))]
+        det = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]),
+                    scores=np.array([0.9]), classes=np.array([0]))]
+        m = mean_average_precision(det, gt, 2)
+        assert m == pytest.approx(0.5)
+
+
+class TestSSDTrainingE2E:
+    def setup_method(self, _):
+        init_zoo_context(seed=0)
+
+    def _toy_dataset(self, n=64, size=64, seed=0):
+        """One bright square per image; class = quadrant-ish color id."""
+        rng = np.random.default_rng(seed)
+        images = np.zeros((n, size, size, 3), np.float32)
+        boxes, labels = [], []
+        for i in range(n):
+            cls = int(rng.integers(0, 2))
+            s = int(rng.integers(14, 22))
+            x0 = int(rng.integers(0, size - s))
+            y0 = int(rng.integers(0, size - s))
+            images[i, y0:y0 + s, x0:x0 + s, cls] = 1.0
+            boxes.append([[x0 / size, y0 / size, (x0 + s) / size,
+                           (y0 + s) / size]])
+            labels.append([cls])
+        return images, boxes, labels
+
+    def test_tiny_ssd_shapes(self):
+        net, priors = ssd_tiny(n_classes=2)
+        n_priors = priors.shape[0]
+        assert n_priors == 8 * 8 * 4 + 4 * 4 * 4
+        net.build_params()
+        x = np.zeros((2, 64, 64, 3), np.float32)
+        out, _ = net.forward(net.params, x, state=net.state)
+        assert out.shape == (2, n_priors, 4 + 3)
+
+    def test_multibox_loss_decreases_and_detects(self):
+        det = ObjectDetector("ssd-tiny", class_names=("red", "green"))
+        images, boxes, labels = self._toy_dataset()
+        y = pad_ground_truth(boxes, labels, max_boxes=4)
+        loss_fn = det.loss()
+        det.model.build_params()
+        out0, _ = det.model.forward(det.model.params, images[:8],
+                                    state=det.model.state)
+        l0 = float(jnp.mean(loss_fn(jnp.asarray(y[:8]), out0)))
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        det.compile(Adam(lr=1e-3))
+        det.fit_detection(images, boxes, labels, batch_size=16, nb_epoch=30,
+                          max_boxes=4)
+        out1, _ = det.model.forward(det.model.params, images[:8],
+                                    state=det.model.state)
+        l1 = float(jnp.mean(loss_fn(jnp.asarray(y[:8]), out1)))
+        assert l1 < l0 * 0.5, (l0, l1)
+
+        dets = det.predict_image_set(images[:8], conf_threshold=0.3)
+        gts = [dict(boxes=np.asarray(boxes[i], np.float32),
+                    classes=np.asarray(labels[i]))
+               for i in range(8)]
+        m = mean_average_precision(dets, gts, 2, iou_threshold=0.3)
+        assert m > 0.25, m
