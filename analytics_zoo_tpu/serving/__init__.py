@@ -1,0 +1,23 @@
+"""Cluster Serving: always-on streaming inference (reference serving/
+ClusterServing.scala:44-230 + pyzoo/zoo/serving/client.py).
+
+The reference wires Redis streams -> Spark Structured Streaming -> a
+broadcast InferenceModel -> Redis result hashes.  The TPU-native design
+collapses the Spark layer: a single host process (per TPU VM) pulls
+micro-batches from a stream broker, runs them through the pooled, bucketed
+:class:`~analytics_zoo_tpu.pipeline.inference.InferenceModel` (one jitted
+XLA executable per bucket), and writes results back.  The broker is
+pluggable: in-memory (tests/embedded), file-spool (multi-process, no
+external service), or Redis when the ``redis`` package is importable —
+same stream/hash data model in all three.
+"""
+
+from .broker import FileBroker, InMemoryBroker, RedisBroker, connect_broker
+from .client import InputQueue, OutputQueue
+from .server import ClusterServing, ClusterServingHelper
+
+__all__ = [
+    "InMemoryBroker", "FileBroker", "RedisBroker", "connect_broker",
+    "InputQueue", "OutputQueue",
+    "ClusterServing", "ClusterServingHelper",
+]

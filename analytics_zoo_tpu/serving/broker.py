@@ -1,0 +1,297 @@
+"""Stream brokers for Cluster Serving.
+
+Data model mirrors the reference's Redis usage (serving/ClusterServing.scala:
+103-139, serving/utils/RedisUtils.scala): an append-only *stream* of
+(uri, payload) records, and per-uri *result hashes*.  Three transports:
+
+- :class:`InMemoryBroker` — threading-based, for embedded serving + tests.
+- :class:`FileBroker` — a spool directory; atomic-rename appends make it
+  safe across processes on one host (the TPU-VM case) with no external
+  service.
+- :class:`RedisBroker` — the reference transport, gated on ``import redis``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+
+class Broker:
+    """Minimal stream + hash API (subset of Redis streams)."""
+
+    def xadd(self, stream: str, fields: dict) -> str:
+        raise NotImplementedError
+
+    def xread(self, stream: str, count: int, last_id: str = "0",
+              block_ms: int = 0) -> list:
+        """Return up to ``count`` records ``(id, fields)`` with id >
+        last_id; optionally block up to ``block_ms``."""
+        raise NotImplementedError
+
+    def xlen(self, stream: str) -> int:
+        raise NotImplementedError
+
+    def xtrim(self, stream: str, maxlen: int) -> None:
+        """Drop oldest records beyond ``maxlen`` (backpressure cut,
+        ClusterServing.scala:128-134)."""
+        raise NotImplementedError
+
+    def ack(self, stream: str, upto_id: str) -> None:
+        """Delete consumed records with id <= upto_id (the server acks each
+        micro-batch so streams do not grow without bound)."""
+        raise NotImplementedError
+
+    def hset(self, key: str, mapping: dict) -> None:
+        raise NotImplementedError
+
+    def hgetall(self, key: str) -> dict:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def memory_ratio(self) -> float:
+        """used_memory / maxmemory in [0,1]; brokers that cannot tell
+        return 0.0 (no backpressure)."""
+        return 0.0
+
+    def close(self) -> None:
+        pass
+
+
+def _new_id() -> str:
+    # time-ordered unique id (redis-style "<ms>-<seq>" flavour)
+    return "%020d-%s" % (time.time_ns(), uuid.uuid4().hex[:8])
+
+
+class InMemoryBroker(Broker):
+    def __init__(self, max_records: int = 1_000_000):
+        self._streams: dict[str, list] = {}
+        self._hashes: dict[str, dict] = {}
+        self._cv = threading.Condition()
+        self._max_records = max_records
+
+    def xadd(self, stream, fields):
+        rid = _new_id()
+        with self._cv:
+            self._streams.setdefault(stream, []).append((rid, dict(fields)))
+            self._cv.notify_all()
+        return rid
+
+    def xread(self, stream, count, last_id="0", block_ms=0):
+        deadline = time.monotonic() + block_ms / 1000.0
+        with self._cv:
+            while True:
+                recs = [r for r in self._streams.get(stream, [])
+                        if r[0] > last_id][:count]
+                if recs or block_ms <= 0:
+                    return recs
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cv.wait(remaining)
+
+    def xlen(self, stream):
+        with self._cv:
+            return len(self._streams.get(stream, []))
+
+    def xtrim(self, stream, maxlen):
+        with self._cv:
+            s = self._streams.get(stream, [])
+            if len(s) > maxlen:
+                del s[:len(s) - maxlen]
+
+    def ack(self, stream, upto_id):
+        with self._cv:
+            s = self._streams.get(stream, [])
+            i = 0
+            while i < len(s) and s[i][0] <= upto_id:
+                i += 1
+            del s[:i]
+
+    def hset(self, key, mapping):
+        with self._cv:
+            self._hashes.setdefault(key, {}).update(mapping)
+            self._cv.notify_all()
+
+    def hgetall(self, key):
+        with self._cv:
+            return dict(self._hashes.get(key, {}))
+
+    def delete(self, key):
+        with self._cv:
+            self._hashes.pop(key, None)
+
+    def memory_ratio(self):
+        n = sum(len(s) for s in self._streams.values())
+        return min(1.0, n / self._max_records)
+
+
+class FileBroker(Broker):
+    """Spool-directory broker.
+
+    Streams live under ``<root>/stream-<name>/<id>.json``; appends write a
+    temp file then ``os.rename`` (atomic on POSIX), so multiple client
+    processes and one server process interoperate without locks.  Result
+    hashes are single json files under ``<root>/hash/``.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "hash"), exist_ok=True)
+
+    def _sdir(self, stream):
+        d = os.path.join(self.root, "stream-" + stream)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _hpath(self, key):
+        return os.path.join(self.root, "hash", key.replace("/", "_") + ".json")
+
+    def xadd(self, stream, fields):
+        rid = _new_id()
+        d = self._sdir(stream)
+        tmp = os.path.join(d, ".tmp-" + rid)
+        with open(tmp, "w") as f:
+            json.dump(fields, f)
+        os.rename(tmp, os.path.join(d, rid + ".json"))
+        return rid
+
+    def _ids(self, stream):
+        d = self._sdir(stream)
+        return sorted(n[:-5] for n in os.listdir(d)
+                      if n.endswith(".json") and not n.startswith("."))
+
+    def xread(self, stream, count, last_id="0", block_ms=0):
+        deadline = time.monotonic() + block_ms / 1000.0
+        d = self._sdir(stream)
+        while True:
+            out = []
+            for rid in self._ids(stream):
+                if rid <= last_id:
+                    continue
+                try:
+                    with open(os.path.join(d, rid + ".json")) as f:
+                        out.append((rid, json.load(f)))
+                except (OSError, json.JSONDecodeError):
+                    continue  # trimmed or mid-write by a racing producer
+                if len(out) >= count:
+                    break
+            if out or time.monotonic() >= deadline:
+                return out
+            time.sleep(0.01)
+
+    def xlen(self, stream):
+        return len(self._ids(stream))
+
+    def xtrim(self, stream, maxlen):
+        ids = self._ids(stream)
+        d = self._sdir(stream)
+        for rid in ids[:max(0, len(ids) - maxlen)]:
+            try:
+                os.remove(os.path.join(d, rid + ".json"))
+            except OSError:
+                pass
+
+    def ack(self, stream, upto_id):
+        d = self._sdir(stream)
+        for rid in self._ids(stream):
+            if rid > upto_id:
+                break
+            try:
+                os.remove(os.path.join(d, rid + ".json"))
+            except OSError:
+                pass
+
+    def hset(self, key, mapping):
+        p = self._hpath(key)
+        cur = self.hgetall(key)
+        cur.update(mapping)
+        tmp = p + ".tmp-" + uuid.uuid4().hex[:8]
+        with open(tmp, "w") as f:
+            json.dump(cur, f)
+        os.rename(tmp, p)
+
+    def hgetall(self, key):
+        try:
+            with open(self._hpath(key)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def delete(self, key):
+        try:
+            os.remove(self._hpath(key))
+        except OSError:
+            pass
+
+
+class RedisBroker(Broker):
+    """The reference transport (Jedis in ClusterServing.scala:119).  Gated
+    on the ``redis`` package; raises ImportError with guidance if absent."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379):
+        try:
+            import redis
+        except ImportError as e:  # pragma: no cover - redis not in image
+            raise ImportError(
+                "RedisBroker requires the 'redis' package; use "
+                "FileBroker/InMemoryBroker or install redis-py") from e
+        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+
+    def xadd(self, stream, fields):  # pragma: no cover - needs server
+        return self._r.xadd(stream, fields)
+
+    def xread(self, stream, count, last_id="0", block_ms=0):
+        # pragma: no cover - needs server
+        res = self._r.xread({stream: last_id}, count=count,
+                            block=block_ms or None)
+        return [(rid, fields) for _, recs in res for rid, fields in recs]
+
+    def xlen(self, stream):  # pragma: no cover
+        return self._r.xlen(stream)
+
+    def xtrim(self, stream, maxlen):  # pragma: no cover
+        self._r.xtrim(stream, maxlen=maxlen, approximate=True)
+
+    def ack(self, stream, upto_id):  # pragma: no cover
+        # XTRIM MINID evicts ids strictly below minid, so pass the successor
+        # of upto_id (redis ids are "<ms>-<seq>")
+        ms, _, seq = upto_id.partition("-")
+        succ = f"{ms}-{int(seq or 0) + 1}"
+        self._r.xtrim(stream, minid=succ, approximate=False)
+
+    def hset(self, key, mapping):  # pragma: no cover
+        self._r.hset(key, mapping=mapping)
+
+    def hgetall(self, key):  # pragma: no cover
+        return self._r.hgetall(key)
+
+    def delete(self, key):  # pragma: no cover
+        self._r.delete(key)
+
+    def memory_ratio(self):  # pragma: no cover
+        info = self._r.info("memory")
+        mx = int(info.get("maxmemory", 0))
+        return (int(info["used_memory"]) / mx) if mx else 0.0
+
+
+def connect_broker(spec) -> Broker:
+    """Build a broker from a spec: a Broker instance (returned as-is), a
+    ``dir:`` / plain path (FileBroker), ``memory``, or ``host:port``
+    (RedisBroker)."""
+    if isinstance(spec, Broker):
+        return spec
+    if spec is None or spec == "memory":
+        return InMemoryBroker()
+    spec = str(spec)
+    if spec.startswith("dir:"):
+        return FileBroker(spec[4:])
+    if ":" in spec and not os.sep in spec:
+        host, port = spec.rsplit(":", 1)
+        return RedisBroker(host, int(port))
+    return FileBroker(spec)

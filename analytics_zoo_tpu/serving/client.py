@@ -1,0 +1,86 @@
+"""Cluster Serving client (reference pyzoo/zoo/serving/client.py).
+
+``InputQueue.enqueue_image`` pushes (uri, tensor) onto the input stream;
+``OutputQueue.query/dequeue`` reads prediction results back.  Tensors travel
+base64-encoded (npy bytes) like the reference's base64 JPEG strings
+(client.py:122 ``base64_encode_image``), but dtype/shape-preserving.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+
+from .broker import connect_broker
+
+INPUT_STREAM = "image_stream"  # reference stream key, ClusterServing.scala:108
+RESULT_PREFIX = "result:"
+
+
+def encode_ndarray(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_ndarray(s: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(s)), allow_pickle=False)
+
+
+class API:
+    """Shared connection state (reference client.py:25-56)."""
+
+    def __init__(self, broker=None, host: str = "localhost",
+                 port: int = 6379):
+        if broker is None:
+            broker = f"{host}:{port}"
+        self.db = connect_broker(broker)
+
+
+class InputQueue(API):
+    def enqueue_image(self, uri: str, data) -> None:
+        """Push one record.  ``data``: ndarray, or a path to ``.npy`` /
+        an image file (decoded via PIL when available)."""
+        if isinstance(data, str):
+            if data.endswith(".npy"):
+                data = np.load(data)
+            else:
+                try:
+                    from PIL import Image
+                except ImportError as e:
+                    raise ImportError(
+                        "decoding image files needs PIL; pass an ndarray "
+                        "or .npy path instead") from e
+                data = np.asarray(Image.open(data))
+        arr = np.asarray(data)
+        self.db.xadd(INPUT_STREAM, {"uri": uri, "image": encode_ndarray(arr)})
+
+    enqueue = enqueue_image
+
+    def backlog(self) -> int:
+        return self.db.xlen(INPUT_STREAM)
+
+
+class OutputQueue(API):
+    def query(self, uri: str):
+        """Result for one uri, or None if not ready (client.py:142)."""
+        h = self.db.hgetall(RESULT_PREFIX + uri)
+        if not h:
+            return None
+        return _decode_result(h)
+
+    def dequeue(self) -> dict:
+        """All finished results, removing them (client.py:131)."""
+        raise NotImplementedError(
+            "dequeue requires key-scan support; use query(uri)")
+
+
+def _decode_result(h: dict):
+    if "value" in h:
+        import json
+        return json.loads(h["value"])
+    if "tensor" in h:
+        return decode_ndarray(h["tensor"])
+    return h

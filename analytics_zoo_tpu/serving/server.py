@@ -1,0 +1,195 @@
+"""Cluster Serving server (reference serving/ClusterServing.scala:44-230 and
+serving/utils/ClusterServingHelper.scala).
+
+The loop: read up to ``batch_size`` records from the input stream, decode,
+stack into one micro-batch, run the pooled/bucketed InferenceModel (one
+jitted XLA executable per batch bucket — device math stays on TPU), write
+per-uri result hashes back, apply backpressure by trimming the stream when
+the broker is near memory capacity (ClusterServing.scala:126-134).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+
+from ..tensorboard import InferenceSummary
+from .broker import connect_broker
+from .client import INPUT_STREAM, RESULT_PREFIX, decode_ndarray, \
+    encode_ndarray
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class ClusterServingHelper:
+    """Config holder (reference ClusterServingHelper.scala yaml schema:
+    model path, data shape, batch size, top_n, redis host/port)."""
+
+    def __init__(self, config_path: str | None = None, **overrides):
+        cfg = {}
+        if config_path:
+            import yaml
+            with open(config_path) as f:
+                cfg = yaml.safe_load(f) or {}
+        model = cfg.get("model", {}) or {}
+        params = cfg.get("params", {}) or {}
+        data = cfg.get("data", {}) or {}
+        self.model_path = overrides.get("model_path", model.get("path"))
+        self.batch_size = int(overrides.get(
+            "batch_size", params.get("batch_size", 4)))
+        self.top_n = int(overrides.get("top_n", params.get("top_n", 1)))
+        self.data_shape = overrides.get("data_shape",
+                                        data.get("image_shape"))
+        if isinstance(self.data_shape, str):
+            self.data_shape = tuple(
+                int(v) for v in self.data_shape.split(","))
+        src = data.get("src", "localhost:6379")
+        self.broker_spec = overrides.get("broker", src)
+        self.log_dir = overrides.get("log_dir", cfg.get("log_dir", "."))
+        # reference filter spec, e.g. "topN(5)" — wired into postprocess
+        self.filter = overrides.get("filter", params.get("filter"))
+        if isinstance(self.filter, str) and self.filter.startswith("topN("):
+            self.top_n = int(self.filter[5:].rstrip(")"))
+
+    def load_inference_model(self):
+        from ..pipeline.inference import InferenceModel
+        m = InferenceModel(concurrent_num=1)
+        m.load(self.model_path)
+        return m
+
+
+class ClusterServing:
+    """The serving main loop (reference ClusterServing.main)."""
+
+    # backpressure thresholds (ClusterServing.scala:126-128)
+    INPUT_THRESHOLD = 0.6 * 0.8
+    CUT_RATIO = 0.5
+
+    def __init__(self, helper: ClusterServingHelper | None = None,
+                 model=None, broker=None, config_path: str | None = None,
+                 **overrides):
+        self.helper = helper or ClusterServingHelper(config_path,
+                                                     **overrides)
+        self.db = connect_broker(broker if broker is not None
+                                 else self.helper.broker_spec)
+        self.model = model if model is not None \
+            else self.helper.load_inference_model()
+        self.summary = InferenceSummary(
+            self.helper.log_dir,
+            time.strftime("%Y%m%d-%H%M%S") + "-ClusterServing")
+        self._last_id = "0"
+        self._stop = threading.Event()
+        self._thread = None
+        self.total_count = 0
+
+    # ------------------------------------------------------------------
+
+    def _postprocess(self, uri: str, out: np.ndarray) -> dict:
+        """Top-N (class, prob) json for vectors, tensor payload otherwise
+        (reference writes top-N class records back to redis)."""
+        out = np.asarray(out)
+        if out.ndim == 1 and self.helper.top_n:
+            n = min(self.helper.top_n, out.shape[0])
+            top = np.argsort(out)[::-1][:n]
+            return {"value": json.dumps(
+                [[int(i), float(out[i])] for i in top])}
+        return {"tensor": encode_ndarray(out)}
+
+    def process_batch(self, records) -> int:
+        if not records:
+            return 0
+        uris, arrs = [], []
+        for rid, fields in records:
+            try:
+                arr = decode_ndarray(fields["image"])
+            except Exception:
+                logger.warning("serving: undecodable record %s", rid)
+                continue
+            if self.helper.data_shape and \
+                    tuple(arr.shape) != tuple(self.helper.data_shape):
+                logger.warning("serving: shape %s != expected %s (uri=%s)",
+                               arr.shape, self.helper.data_shape,
+                               fields.get("uri"))
+                continue
+            uris.append(fields.get("uri", rid))
+            arrs.append(arr)
+        if not arrs:
+            return 0
+        t0 = time.perf_counter()
+        # group by shape: with no configured data_shape, clients may send
+        # mixed sizes; each group becomes one stacked micro-batch
+        groups: dict = {}
+        for uri, arr in zip(uris, arrs):
+            groups.setdefault(arr.shape, ([], []))
+            groups[arr.shape][0].append(uri)
+            groups[arr.shape][1].append(arr)
+        for g_uris, g_arrs in groups.values():
+            preds = self.model.predict(np.stack(g_arrs))
+            if isinstance(preds, list):  # multi-output: report first head
+                preds = preds[0]
+            for uri, out in zip(g_uris, np.asarray(preds)):
+                self.db.hset(RESULT_PREFIX + uri,
+                             self._postprocess(uri, out))
+        dt = time.perf_counter() - t0
+        self.total_count += len(uris)
+        self.summary.add_scalar("Throughput", len(uris) / max(dt, 1e-9),
+                                self.total_count)
+        logger.info("serving: batch of %d in %.1f ms", len(uris), dt * 1e3)
+        return len(uris)
+
+    def step(self, block_ms: int = 100) -> int:
+        """One poll + predict + write-back cycle; returns #records served."""
+        if self.db.memory_ratio() >= self.INPUT_THRESHOLD:
+            keep = int(self.db.xlen(INPUT_STREAM) * self.CUT_RATIO)
+            self.db.xtrim(INPUT_STREAM, keep)
+        records = self.db.xread(INPUT_STREAM, self.helper.batch_size,
+                                last_id=self._last_id, block_ms=block_ms)
+        if records:
+            self._last_id = records[-1][0]
+        try:
+            n = self.process_batch(records)
+        finally:
+            if records:
+                # ack consumed records so the stream cannot grow unbounded
+                self.db.ack(INPUT_STREAM, self._last_id)
+        return n
+
+    def run(self, max_records: int | None = None,
+            idle_timeout: float | None = None) -> int:
+        """Blocking serve loop.  Stops after ``max_records`` served, after
+        ``idle_timeout`` seconds without input, or on :meth:`stop`."""
+        served = 0
+        last_active = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                n = self.step()
+            except Exception:
+                # a bad batch must not kill the serving loop/thread
+                logger.exception("serving: batch failed; continuing")
+                n = 0
+            served += n
+            if n:
+                last_active = time.monotonic()
+            if max_records is not None and served >= max_records:
+                break
+            if idle_timeout is not None and \
+                    time.monotonic() - last_active > idle_timeout:
+                break
+        self.summary.close()
+        return served
+
+    def start(self, **kwargs) -> "ClusterServing":
+        """Run the loop on a daemon thread (embedded serving)."""
+        self._thread = threading.Thread(target=self.run, kwargs=kwargs,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

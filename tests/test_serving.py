@@ -1,0 +1,146 @@
+"""Cluster Serving: broker semantics + end-to-end streaming inference
+(reference serving/ClusterServing.scala, pyzoo/zoo/serving/client.py)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import (
+    ClusterServing, ClusterServingHelper, FileBroker, InMemoryBroker,
+    InputQueue, OutputQueue,
+)
+from analytics_zoo_tpu.serving.client import decode_ndarray, encode_ndarray
+
+
+@pytest.fixture(params=["memory", "file"])
+def broker(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryBroker()
+    return FileBroker(str(tmp_path / "spool"))
+
+
+def test_broker_stream_roundtrip(broker):
+    ids = [broker.xadd("s", {"uri": f"u{i}", "image": str(i)})
+           for i in range(5)]
+    assert broker.xlen("s") == 5
+    recs = broker.xread("s", 3)
+    assert [f["uri"] for _, f in recs] == ["u0", "u1", "u2"]
+    # read after last_id resumes
+    recs2 = broker.xread("s", 10, last_id=recs[-1][0])
+    assert [f["uri"] for _, f in recs2] == ["u3", "u4"]
+    assert ids == sorted(ids)
+
+
+def test_broker_trim_and_hash(broker):
+    for i in range(6):
+        broker.xadd("s", {"i": str(i)})
+    broker.xtrim("s", 2)
+    assert broker.xlen("s") == 2
+    assert [f["i"] for _, f in broker.xread("s", 10)] == ["4", "5"]
+    broker.hset("result:a", {"value": "1"})
+    broker.hset("result:a", {"extra": "2"})
+    assert broker.hgetall("result:a") == {"value": "1", "extra": "2"}
+    broker.delete("result:a")
+    assert broker.hgetall("result:a") == {}
+
+
+def test_broker_ack(broker):
+    ids = [broker.xadd("s", {"i": str(i)}) for i in range(4)]
+    broker.ack("s", ids[1])
+    assert broker.xlen("s") == 2
+    assert [f["i"] for _, f in broker.xread("s", 10)] == ["2", "3"]
+
+
+def test_server_acks_consumed_records(tmp_path):
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(6):
+        inq.enqueue_image(f"u{i}", np.zeros((4, 4, 1), np.float32))
+    serving.run(max_records=6)
+    assert broker.xlen("image_stream") == 0  # stream drained, not leaked
+
+
+def test_ndarray_codec():
+    arr = np.random.default_rng(0).normal(size=(3, 4, 2)).astype(np.float32)
+    out = decode_ndarray(encode_ndarray(arr))
+    np.testing.assert_array_equal(arr, out)
+    assert out.dtype == np.float32
+
+
+def _tiny_classifier(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Flatten
+    from analytics_zoo_tpu.pipeline.api.keras.topology import Sequential
+
+    m = Sequential()
+    m.add(Flatten(input_shape=(4, 4, 1)))
+    m.add(Dense(5, activation="softmax"))
+    m.build_params()
+    path = str(tmp_path / "model.zoo")
+    m.save(path)
+    return path
+
+
+def test_end_to_end_serving(tmp_path, broker):
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4, top_n=2,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    rng = np.random.default_rng(1)
+    for i in range(10):
+        inq.enqueue_image(f"img-{i}", rng.normal(
+            size=(4, 4, 1)).astype(np.float32))
+    served = serving.run(max_records=10)
+    assert served == 10
+    for i in range(10):
+        res = outq.query(f"img-{i}")
+        assert res is not None and len(res) == 2  # top-2 [class, prob]
+        cls, prob = res[0]
+        assert 0 <= cls < 5 and 0.0 <= prob <= 1.0
+    assert outq.query("missing") is None
+
+
+def test_serving_thread_and_bad_records(tmp_path):
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=2,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker).start(idle_timeout=5.0)
+    inq = InputQueue(broker=broker)
+    broker.xadd("image_stream", {"uri": "bad", "image": "not-b64!!"})
+    inq.enqueue_image("wrong-shape", np.zeros((2, 2, 1), np.float32))
+    inq.enqueue_image("ok", np.zeros((4, 4, 1), np.float32))
+    outq = OutputQueue(broker=broker)
+    deadline = time.time() + 30
+    while outq.query("ok") is None and time.time() < deadline:
+        time.sleep(0.05)
+    serving.stop()
+    assert outq.query("ok") is not None
+    assert outq.query("bad") is None
+    assert outq.query("wrong-shape") is None
+
+
+def test_yaml_config(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "model:\n  path: /m\nparams:\n  batch_size: 8\n  top_n: 3\n"
+        "data:\n  src: memory\n  image_shape: 3,224,224\n")
+    h = ClusterServingHelper(str(cfg))
+    assert h.model_path == "/m"
+    assert h.batch_size == 8
+    assert h.top_n == 3
+    assert h.data_shape == (3, 224, 224)
+    assert h.broker_spec == "memory"
