@@ -123,8 +123,7 @@ class ImageClassifier(ZooModel):
                           batch_size: int = 32):
         """Reference ``predictImageSet`` + LabelOutput: preprocess chain ->
         batched forward -> top-k (name, prob) per image."""
-        pre = self.config.preprocessing()
-        xs = np.stack([np.asarray(pre(img), np.float32)
-                       for img in image_set.images])
+        transformed = image_set.transform(self.config.preprocessing())
+        xs = transformed.to_feature_set().xs[0]
         probs = self.model.predict(xs, batch_size=batch_size)
         return LabelOutput(self.config.label_map, top_k)(probs)

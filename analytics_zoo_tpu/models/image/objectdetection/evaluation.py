@@ -63,7 +63,8 @@ def average_precision(detections, ground_truths, class_id: int,
         gts[img_id] = (boxes, difficult, np.zeros(len(boxes), bool))
         n_positive += int((~difficult).sum())
     if n_positive == 0:
-        return 0.0
+        # VOC convention: a class with no gt instances is excluded from mAP
+        return float("nan")
 
     tp = np.zeros(len(rows))
     fp = np.zeros(len(rows))
@@ -97,6 +98,7 @@ def mean_average_precision(detections, ground_truths, n_classes: int,
                           use_07_metric)
         for c in range(n_classes)
     ]
+    aps = [a for a in aps if not np.isnan(a)]  # skip classes with no gt
     return float(np.mean(aps)) if aps else 0.0
 
 
@@ -116,8 +118,8 @@ class PascalVocEvaluator:
                 self.use_07_metric)
             for c, name in enumerate(self.class_names)
         }
+        present = [a for a in per_class.values() if not np.isnan(a)]
         return {
             "AP": per_class,
-            "mAP": float(np.mean(list(per_class.values())))
-            if per_class else 0.0,
+            "mAP": float(np.mean(present)) if present else 0.0,
         }

@@ -6,10 +6,11 @@ loss on matched priors + softmax conf loss with 3:1 hard-negative mining).
 TPU re-design: everything is static-shape jnp inside the jitted train step.
 Ground truth arrives padded to ``max_boxes`` per image (label -1 = padding) —
 the padding/bucketing answer to jit's static-shape regime called out in
-SURVEY.md §7 hard-part 3.  Matching is vectorized IoU + argmax (no mutable
-bipartite loop as in the reference): each prior takes its best gt, and each
-gt's single best prior is force-matched through a one-hot override so every
-gt owns >= 1 prior.  Hard-negative mining uses the rank-of-rank sort trick —
+SURVEY.md §7 hard-part 3.  Matching is vectorized IoU + argmax, with the
+reference's *sequential bipartite* force-match re-expressed as a fixed-trip
+``lax.fori_loop`` (each iteration claims the globally best unmatched
+(prior, gt) pair), so every gt owns a distinct prior even when two gts share
+the same best prior.  Hard-negative mining uses the rank-of-rank sort trick —
 a fixed-shape replacement for the reference's per-image mutable heap.
 """
 
@@ -55,6 +56,34 @@ def decode_boxes(loc, priors_center, variances=(0.1, 0.2)):
     return jnp.concatenate([lo, hi], axis=-1)
 
 
+def _bipartite_force(iou, valid):
+    """Sequential bipartite matching as a fixed-trip loop.
+
+    Mirrors the reference's mutable bipartite pass (MultiBoxLoss.scala):
+    repeat M times — claim the globally-best remaining (prior, gt) pair,
+    then retire that prior row and gt column — so every valid gt gets its
+    own prior even when two gts share the same best prior (plain argmax
+    force-matching would drop one).  Returns a (P, M) force matrix with 2.0
+    at the claimed pairs.
+    """
+    p, m = iou.shape
+    work = jnp.where(valid[None, :], iou, -1.0)
+    force = jnp.zeros_like(iou)
+
+    def body(_, carry):
+        work, force = carry
+        idx = jnp.argmax(work)
+        pi, gi = idx // m, idx % m
+        ok = work[pi, gi] >= 0.0  # a still-unmatched valid gt remains
+        force = jnp.where(ok, force.at[pi, gi].set(2.0), force)
+        work = jnp.where(ok,
+                         work.at[pi, :].set(-1.0).at[:, gi].set(-1.0), work)
+        return work, force
+
+    _, force = jax.lax.fori_loop(0, m, body, (work, force))
+    return force
+
+
 def match_priors(gt_corner, gt_labels, priors_corner, iou_threshold=0.5):
     """Per-image matching.
 
@@ -71,16 +100,9 @@ def match_priors(gt_corner, gt_labels, priors_corner, iou_threshold=0.5):
     iou = iou_matrix(priors_corner, gt_corner)          # (P, M)
     iou = jnp.where(valid[None, :], iou, -1.0)
 
+    # force-match: bipartite pass gives each valid gt a distinct prior
+    iou = jnp.maximum(iou, _bipartite_force(iou, valid))
     best_gt = jnp.argmax(iou, axis=1)                   # (P,)
-    best_gt_iou = jnp.max(iou, axis=1)
-
-    # force-match: each gt's best prior adopts that gt with iou 2.0
-    best_prior = jnp.argmax(iou, axis=0)                # (M,)
-    m = gt_corner.shape[0]
-    force = jnp.zeros_like(iou).at[
-        best_prior, jnp.arange(m)].set(jnp.where(valid, 2.0, -1.0))
-    iou = jnp.maximum(iou, force)
-    best_gt = jnp.argmax(iou, axis=1)
     best_gt_iou = jnp.max(iou, axis=1)
 
     matched_corner = gt_corner[best_gt]                 # (P, 4)

@@ -36,7 +36,15 @@ def pad_ground_truth(boxes_list, labels_list, max_boxes: int) -> np.ndarray:
     out = np.zeros((b, max_boxes, 5), np.float32)
     out[..., 4] = -1.0
     for i, (boxes, labels) in enumerate(zip(boxes_list, labels_list)):
-        boxes = np.asarray(boxes, np.float32).reshape(-1, 4)[:max_boxes]
+        boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+        if len(boxes) > max_boxes:
+            import logging
+
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "image %d has %d gt boxes; only the first max_boxes=%d are "
+                "kept — raise max_boxes for crowded datasets",
+                i, len(boxes), max_boxes)
+        boxes = boxes[:max_boxes]
         labels = np.asarray(labels, np.float32).reshape(-1)[:max_boxes]
         out[i, :len(boxes), :4] = boxes
         out[i, :len(labels), 4] = labels

@@ -67,23 +67,10 @@ def generate_priors(specs: Sequence[PriorSpec]) -> np.ndarray:
     return np.clip(np.asarray(out, np.float32), 0.0, 1.0)
 
 
-def corner_to_center(boxes):
-    """(xmin, ymin, xmax, ymax) -> (cx, cy, w, h)."""
-    wh = boxes[..., 2:4] - boxes[..., 0:2]
-    c = boxes[..., 0:2] + 0.5 * wh
-    return np.concatenate([c, wh], axis=-1) if isinstance(
-        boxes, np.ndarray) else _jnp_concat([c, wh])
-
-
 def center_to_corner(boxes):
+    """(cx, cy, w, h) -> (xmin, ymin, xmax, ymax)."""
+    boxes = np.asarray(boxes)
     half = 0.5 * boxes[..., 2:4]
     lo = boxes[..., 0:2] - half
     hi = boxes[..., 0:2] + half
-    return np.concatenate([lo, hi], axis=-1) if isinstance(
-        boxes, np.ndarray) else _jnp_concat([lo, hi])
-
-
-def _jnp_concat(xs):
-    import jax.numpy as jnp
-
-    return jnp.concatenate(xs, axis=-1)
+    return np.concatenate([lo, hi], axis=-1)

@@ -63,6 +63,20 @@ class TestMatching:
         assert int(jnp.sum(conf_t == 1)) >= 1
         assert int(jnp.sum(conf_t == 3)) >= 1
 
+    def test_contended_best_prior_split_between_gts(self):
+        # two gts whose best prior is the SAME prior: bipartite matching
+        # must give each a distinct prior (plain argmax would drop one)
+        priors_c = np.asarray([[0.25, 0.25, 0.5, 0.5],
+                               [0.8, 0.8, 0.2, 0.2]], np.float32)
+        priors_corner = jnp.asarray(center_to_corner(priors_c))
+        gt = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.0, 0.0, 0.45, 0.45],
+                          [0, 0, 0, 0]], jnp.float32)
+        labels = jnp.asarray([0, 1, -1], jnp.int32)
+        conf_t, _ = match_priors(gt, labels, priors_corner)
+        # both gts force-matched, necessarily to the two different priors
+        assert int(jnp.sum(conf_t == 1)) >= 1
+        assert int(jnp.sum(conf_t == 2)) >= 1
+
     def test_padding_ignored(self):
         priors_c = jnp.asarray(generate_priors([PriorSpec(2, 0.3, 0.5,
                                                           (2.0,))]))
@@ -119,6 +133,14 @@ class TestMAP:
                     scores=np.array([0.9]), classes=np.array([0]))]
         m = mean_average_precision(det, gt, 2)
         assert m == pytest.approx(0.5)
+
+    def test_map_skips_classes_with_no_gt(self):
+        # VOC convention: absent classes are excluded, not scored 0
+        gt = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]),
+                   classes=np.array([0]))]
+        det = [dict(boxes=np.array([[0, 0, 0.5, 0.5]]),
+                    scores=np.array([0.9]), classes=np.array([0]))]
+        assert mean_average_precision(det, gt, 20) == pytest.approx(1.0)
 
 
 class TestSSDTrainingE2E:
