@@ -177,9 +177,13 @@ class KerasNet(_ContainerBase):
                                 pad_to_batch=ctx.data_parallel_size):
             xb = ctx.shard_batch(batch["x"])
             out = fwd(self.params, self.state, xb)
-            outs.append(np.asarray(out))
-        full = np.concatenate(outs, axis=0)[:n]
-        return full
+            outs.append([np.asarray(o) for o in out]
+                        if isinstance(out, (list, tuple))
+                        else np.asarray(out))
+        if isinstance(outs[0], list):  # multi-output graph
+            return [np.concatenate([o[i] for o in outs], axis=0)[:n]
+                    for i in range(len(outs[0]))]
+        return np.concatenate(outs, axis=0)[:n]
 
     def predict_classes(self, x, batch_size=32, zero_based_label=True):
         """Reference ``predictClasses`` (Topology.scala:549+)."""

@@ -1,0 +1,20 @@
+"""tfpark-equivalent high-level APIs (reference pyzoo/zoo/tfpark).
+
+The reference's tfpark exists to train *user-defined TensorFlow graphs* on
+the distributed engine: ``KerasModel`` (model.py:30-315), ``TFEstimator``
+(estimator.py:84-357, tf.estimator-style model_fn), ``GANEstimator``
+(gan/gan_estimator.py:29), BERT estimators and text models.  Its mechanism
+— push weights into a TF session, run loss+grads, pull grads back into the
+BigDL all-reduce (TFTrainingHelper.scala:188-250) — collapses on TPU into a
+single jit-compiled SPMD step (SURVEY.md §3.3), so this package keeps only
+the *API shapes*: bring-your-own model function, spec-driven estimators,
+alternating GAN optimization, and ready-made text estimators, all building
+the framework's own symbolic graph (autograd Variables + keras layers).
+"""
+
+from .estimator import TFEstimator, TFEstimatorSpec, ZooOptimizer
+from .gan import GANEstimator
+from .model import KerasModel
+
+__all__ = ["KerasModel", "TFEstimator", "TFEstimatorSpec", "ZooOptimizer",
+           "GANEstimator"]

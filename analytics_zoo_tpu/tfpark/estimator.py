@@ -1,0 +1,272 @@
+"""TFEstimator-equivalent: spec-driven, bring-your-own-model-function
+training (reference pyzoo/zoo/tfpark/estimator.py:84-357).
+
+The reference's ``model_fn(features, labels, mode, params)`` builds a TF
+graph; variables are collected from the session and trained through the
+push-weights/run-graph/pull-grads sandwich (SURVEY.md §3.3).  Here
+``model_fn`` receives *symbolic Variables* (the framework's autograd/keras
+graph tensors), composes layers and AutoGrad math, and returns a
+:class:`TFEstimatorSpec` with ``loss``/``predictions`` graph outputs.  The
+estimator lowers that graph to the standard jitted SPMD train step — no
+session, no weight shuttling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.dataset import FeatureSet
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input, Variable
+from analytics_zoo_tpu.pipeline.api.keras.metrics import get_metric
+from analytics_zoo_tpu.pipeline.api.keras.objectives import LossFunction
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+
+TRAIN, EVAL, PREDICT = "train", "eval", "predict"
+
+
+def ZooOptimizer(optimizer):
+    """Reference tfpark.ZooOptimizer wraps a tf.train.Optimizer for the
+    distributed engine; here any framework optimizer/name passes through."""
+    return get_optimizer(optimizer)
+
+
+class TFEstimatorSpec:
+    """Ops returned by a model_fn (reference estimator.py:76-82)."""
+
+    def __init__(self, mode, predictions=None, loss=None):
+        if mode in (TRAIN, EVAL) and loss is None:
+            raise ValueError(f"mode {mode!r} requires a loss")
+        if mode in (EVAL, PREDICT) and predictions is None:
+            raise ValueError(f"mode {mode!r} requires predictions")
+        for v, what in ((predictions, "predictions"), (loss, "loss")):
+            if v is not None and not _all_variables(v):
+                raise TypeError(f"{what} must be symbolic Variable(s) built "
+                                "from the features/labels arguments")
+        self.mode = mode
+        self.predictions = predictions
+        self.loss = loss
+
+
+def _all_variables(v) -> bool:
+    vs = v if isinstance(v, (list, tuple)) else [v]
+    return all(isinstance(x, Variable) for x in vs)
+
+
+def _peek_shapes(fs: FeatureSet):
+    """(feature_shapes, label_shapes) without the batch dim, plus dtypes."""
+    batch = next(fs.batches(1, shuffle=False, drop_last=False))
+    xs = batch["x"] if isinstance(batch["x"], list) else [batch["x"]]
+    ys = batch.get("y")
+    ys = [] if ys is None else (ys if isinstance(ys, list) else [ys])
+    return ([tuple(a.shape[1:]) for a in xs],
+            [tuple(a.shape[1:]) for a in ys])
+
+
+class TFEstimator:
+    """Reference TFEstimator (estimator.py:84): train/evaluate/predict from
+    input_fns, spec-driven model building, gradient-clipping setters."""
+
+    def __init__(self, model_fn, optimizer=None, model_dir: str | None = None,
+                 config=None, params=None, warm_start_from=None):
+        self.model_fn = model_fn
+        self.optimizer = get_optimizer(optimizer) if optimizer is not None \
+            else None
+        self.model_dir = model_dir
+        self.config = config
+        self.params = params or {}
+        self._grad_clip = None
+        # built lazily from the first dataset seen
+        self._spec = None
+        self._train_net = None
+        self._pred_net = None
+        self._label_count = 0
+
+    # -- gradient clipping (reference estimator.py:168-189) --------------
+    def clear_gradient_clipping(self):
+        self._grad_clip = None
+
+    def set_constant_gradient_clipping(self, min, max):  # noqa: A002
+        self._grad_clip = ("const", float(min), float(max))
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self._grad_clip = ("l2norm", float(clip_norm))
+
+    def set_optimizer(self, optimizer):
+        self.optimizer = get_optimizer(optimizer)
+
+    # -- graph building ---------------------------------------------------
+    def _ensure_built(self, fs: FeatureSet, mode: str):
+        """Call model_fn once on symbolic inputs; derive train + predict
+        nets from the same graph so they share layers (the role of the
+        reference's TF variable reuse)."""
+        if self._spec is not None:
+            return
+        f_shapes, l_shapes = _peek_shapes(fs)
+        features = [Input(shape=s, name=f"feature_{i}")
+                    for i, s in enumerate(f_shapes)]
+        labels = [Input(shape=s, name=f"label_{i}")
+                  for i, s in enumerate(l_shapes)]
+        f_arg = features[0] if len(features) == 1 else features
+        l_arg = (labels[0] if len(labels) == 1 else labels) if labels \
+            else None
+        build_mode = mode if (mode == PREDICT or labels) else PREDICT
+        spec = self.model_fn(f_arg, l_arg, build_mode, self.params)
+        if not isinstance(spec, TFEstimatorSpec):
+            raise TypeError("model_fn must return a TFEstimatorSpec")
+        self._spec = spec
+        self._label_count = len(labels)
+        # train net FIRST so canonical layer names are fixed by the full
+        # graph; the predict net reuses the already-named layers
+        if spec.loss is not None:
+            self._train_net = Model(features + labels, spec.loss)
+        if spec.predictions is not None:
+            self._pred_net = Model(features, spec.predictions)
+
+    def _training_estimator(self):
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+        if self.optimizer is None:
+            raise ValueError("no optimizer set; pass optimizer= or call "
+                             "set_optimizer")
+        # the graph already computes the loss; the training loss fn just
+        # averages the graph output
+        passthrough = LossFunction(lambda y_true, y_pred: y_pred,
+                                   "model_fn_loss")
+        return Estimator(self._train_net, optimizer=self.optimizer,
+                         loss=passthrough, grad_clip=self._grad_clip,
+                         model_dir=self.model_dir)
+
+    @staticmethod
+    def _to_feature_set(data) -> FeatureSet:
+        if isinstance(data, FeatureSet):
+            return data
+        if isinstance(data, tuple):
+            return FeatureSet.of(*data)
+        return FeatureSet.of(data)
+
+    # -- the tf.estimator-style entry points ------------------------------
+    def train(self, input_fn, steps: int | None = None,
+              batch_size: int = 32) -> "TFEstimator":
+        """Reference estimator.py:194-251: train until ``steps`` iterations
+        (or one epoch if None)."""
+        from analytics_zoo_tpu.common.triggers import MaxEpoch, MaxIteration
+
+        fs = self._to_feature_set(input_fn())
+        self._ensure_built(fs, TRAIN)
+        if self._train_net is None:
+            raise ValueError("model_fn returned no loss; cannot train")
+        # re-wrap: features+labels all become model *inputs* of the loss net
+        merged = _MergedFeatureSet(fs)
+        est = self._training_estimator()
+        end = MaxIteration(steps) if steps is not None else MaxEpoch(1)
+        est.train(merged, batch_size=batch_size, end_trigger=end)
+        self._sync_params_to_pred()
+        return self
+
+    @property
+    def _trained(self) -> bool:
+        return self._train_net is not None and \
+            self._train_net.params is not None
+
+    def _sync_params_to_pred(self):
+        if self._pred_net is not None and self._trained:
+            self._pred_net.build_params()
+            # overwrite the shared layers' params with the trained values;
+            # keep params of layers only on the predictions path
+            self._pred_net.params = {
+                **self._pred_net.params,
+                **{k: v for k, v in self._train_net.params.items()
+                   if k in self._pred_net.params},
+            }
+            self._pred_net.state = {
+                **self._pred_net.state,
+                **{k: v for k, v in self._train_net.state.items()
+                   if k in self._pred_net.state},
+            }
+
+    def evaluate(self, input_fn, eval_methods, steps=None,
+                 checkpoint_path=None) -> dict:
+        """Reference estimator.py:253-313: dict of metric -> value."""
+        fs = self._to_feature_set(input_fn())
+        self._ensure_built(fs, EVAL)
+        if self._pred_net is None:
+            raise ValueError("model_fn returned no predictions")
+        preds, labels = self._forward_all(fs)
+        out = {}
+        for name in eval_methods:
+            metric = get_metric(name)
+            out[name] = metric.finalize(metric.batch_stats(labels, preds))
+        if self._trained:
+            losses = self._loss_all(fs)
+            out["loss"] = float(np.mean(losses))
+        return out
+
+    def predict(self, input_fn, checkpoint_path=None,
+                batch_size: int = 32) -> np.ndarray:
+        """Reference estimator.py:315+."""
+        fs = self._to_feature_set(input_fn())
+        self._ensure_built(fs, PREDICT)
+        if self._pred_net is None:
+            raise ValueError("model_fn returned no predictions")
+        self._sync_params_to_pred()
+        xs = _stack_all(fs, labels=False)
+        return self._pred_net.predict(
+            xs[0] if len(xs) == 1 else xs, batch_size=batch_size)
+
+    # -- helpers -----------------------------------------------------------
+    def _forward_all(self, fs: FeatureSet):
+        self._sync_params_to_pred()
+        xs = _stack_all(fs, labels=False)
+        ys = _stack_all(fs, labels=True)
+        preds = self._pred_net.predict(xs[0] if len(xs) == 1 else xs)
+        return preds, (ys[0] if len(ys) == 1 else ys)
+
+    def _loss_all(self, fs: FeatureSet):
+        # the loss output may be scalar per batch; run batch-wise forwards
+        net = self._train_net
+        net.build_params()
+        losses = []
+        for batch in _MergedFeatureSet(fs).batches(256, shuffle=False,
+                                                   drop_last=False):
+            out, _ = net.forward(net.params, batch["x"], state=net.state,
+                                 training=False)
+            losses.append(float(np.mean(np.asarray(out))))
+        return losses
+
+
+def _stack_all(fs: FeatureSet, labels: bool) -> list:
+    """Materialize a FeatureSet side as full arrays (eval/predict path)."""
+    chunks = []
+    for batch in fs.batches(1024, shuffle=False, drop_last=False):
+        part = batch.get("y") if labels else batch["x"]
+        if part is None:
+            return []
+        chunks.append(part if isinstance(part, list) else [part])
+    return [np.concatenate([c[i] for c in chunks])
+            for i in range(len(chunks[0]))]
+
+
+class _MergedFeatureSet(FeatureSet):
+    """View of a FeatureSet where labels are appended to the features (the
+    loss net takes features+labels as inputs and outputs the loss)."""
+
+    def __init__(self, base: FeatureSet):
+        self.base = base
+
+    @property
+    def num_samples(self):
+        return self.base.num_samples
+
+    def batches(self, *args, **kwargs):
+        for batch in self.base.batches(*args, **kwargs):
+            xs = batch["x"] if isinstance(batch["x"], list) else [batch["x"]]
+            ys = batch.get("y")
+            ys = [] if ys is None else (
+                ys if isinstance(ys, list) else [ys])
+            merged = {"x": list(xs) + list(ys)}
+            if "w" in batch:
+                merged["w"] = batch["w"]
+            if "n_valid" in batch:
+                merged["n_valid"] = batch["n_valid"]
+            yield merged

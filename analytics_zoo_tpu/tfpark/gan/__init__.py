@@ -1,0 +1,3 @@
+from .gan_estimator import GANEstimator
+
+__all__ = ["GANEstimator"]

@@ -1,0 +1,211 @@
+"""GANEstimator (reference pyzoo/zoo/tfpark/gan/gan_estimator.py:29-152).
+
+The reference alternates generator/discriminator phases with a TF counter
+variable and cond branches inside one exported graph, trained by the Spark
+all-reduce.  The TPU-native step keeps the same phase algebra —
+``step % (d_steps + g_steps) < d_steps`` selects the discriminator — but as
+a single jitted function: ``lax.cond`` picks which parameter group gets the
+gradient update, weight sharing is plain functional reuse of the
+discriminator net (no variable_scope reuse), and both phases ride the same
+SPMD data-parallel mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.engine import get_zoo_context
+from analytics_zoo_tpu.feature.dataset import FeatureSet
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+
+
+def _build_net(fn, *input_shapes):
+    """Call a user graph-builder fn on fresh Inputs -> Model."""
+    ins = [Input(shape=s) for s in input_shapes]
+    out = fn(*ins) if len(ins) > 1 else fn(ins[0])
+    return Model(ins if len(ins) > 1 else ins[0], out)
+
+
+class GANEstimator:
+    """Alternating-phase GAN trainer.
+
+    ``generator_fn`` / ``discriminator_fn`` are graph builders over symbolic
+    Variables (autograd facade); ``*_loss_fn`` are pure jnp functions —
+    ``generator_loss_fn(fake_logits)`` and
+    ``discriminator_loss_fn(real_logits, fake_logits)`` — matching the
+    reference's TFGAN-style contract.
+    """
+
+    def __init__(self, generator_fn, discriminator_fn, generator_loss_fn,
+                 discriminator_loss_fn, generator_optimizer,
+                 discriminator_optimizer, generator_steps: int = 1,
+                 discriminator_steps: int = 1,
+                 model_dir: str | None = None):
+        self._generator_fn = generator_fn
+        self._discriminator_fn = discriminator_fn
+        self._generator_loss_fn = generator_loss_fn
+        self._discriminator_loss_fn = discriminator_loss_fn
+        self._g_opt = get_optimizer(generator_optimizer)
+        self._d_opt = get_optimizer(discriminator_optimizer)
+        self._g_steps = int(generator_steps)
+        self._d_steps = int(discriminator_steps)
+        self.checkpoint_path = os.path.join(
+            model_dir or tempfile.mkdtemp(), "gan_model")
+        self.gen_net = None
+        self.disc_net = None
+        self._gp = self._dp = None
+        self._gs = self._ds = None  # layer states
+        self.step = 0
+
+    # ------------------------------------------------------------------
+    def _ensure_built(self, noise_shape, real_shape, rng):
+        k1, k2 = jax.random.split(rng)
+        if self.gen_net is None:
+            self.gen_net = _build_net(self._generator_fn, noise_shape)
+            self._gp, self._gs = self.gen_net.build_params(k1)
+        if self.disc_net is None:
+            # generate() may have built only the generator; the
+            # discriminator and optimizer states still need initializing
+            self.disc_net = _build_net(self._discriminator_fn, real_shape)
+            self._dp, self._ds = self.disc_net.build_params(k2)
+            self._g_opt_state = self._g_opt.init(self._gp)
+            self._d_opt_state = self._d_opt.init(self._dp)
+
+    def _build_step(self):
+        gen, disc = self.gen_net, self.disc_net
+        g_loss_fn, d_loss_fn = self._generator_loss_fn, \
+            self._discriminator_loss_fn
+        g_opt, d_opt = self._g_opt, self._d_opt
+        period = self._g_steps + self._d_steps
+        d_steps = self._d_steps
+
+        @jax.jit
+        def train_step(gp, dp, g_os, d_os, gs, ds, step, noise, real, rng):
+            k_g, k_d = jax.random.split(rng)
+
+            def fake_of(gp_):
+                out, _ = gen.forward(gp_, noise, state=gs, training=True,
+                                     rng=k_g)
+                return out
+
+            def d_phase(args):
+                gp, dp, g_os, d_os = args
+
+                def loss(dp_):
+                    fake = fake_of(gp)
+                    real_out, _ = disc.forward(dp_, real, state=ds,
+                                               training=True, rng=k_d)
+                    fake_out, _ = disc.forward(dp_, fake, state=ds,
+                                               training=True, rng=k_d)
+                    return jnp.mean(d_loss_fn(real_out, fake_out))
+
+                l, grads = jax.value_and_grad(loss)(dp)
+                updates, d_os = d_opt.update(grads, d_os, dp)
+                dp = optax.apply_updates(dp, updates)
+                return (gp, dp, g_os, d_os), l
+
+            def g_phase(args):
+                gp, dp, g_os, d_os = args
+
+                def loss(gp_):
+                    fake = fake_of(gp_)
+                    fake_out, _ = disc.forward(dp, fake, state=ds,
+                                               training=True, rng=k_d)
+                    return jnp.mean(g_loss_fn(fake_out))
+
+                l, grads = jax.value_and_grad(loss)(gp)
+                updates, g_os = g_opt.update(grads, g_os, gp)
+                gp = optax.apply_updates(gp, updates)
+                return (gp, dp, g_os, d_os), l
+
+            is_d = (step % period) < d_steps
+            (gp, dp, g_os, d_os), l = jax.lax.cond(
+                is_d, d_phase, g_phase, (gp, dp, g_os, d_os))
+            return gp, dp, g_os, d_os, l
+
+        return train_step
+
+    # ------------------------------------------------------------------
+    def train(self, dataset, end_trigger=None, steps: int | None = None,
+              batch_size: int = 32) -> "GANEstimator":
+        """Train for ``steps`` phase-steps (reference train(dataset,
+        end_trigger), gan_estimator.py:65).  ``dataset``: FeatureSet or
+        (noise, real) arrays — the reference's two dataset tensors."""
+        ctx = get_zoo_context()
+        if isinstance(dataset, tuple):
+            dataset = FeatureSet.of(list(dataset))
+        if steps is None:
+            steps = getattr(end_trigger, "max_iteration", None) or 100
+        if dataset.num_samples < batch_size:
+            raise ValueError(
+                f"dataset has {dataset.num_samples} samples < batch_size "
+                f"{batch_size}; shrink batch_size")
+        rng = jax.random.PRNGKey(ctx.seed)
+        batch0 = next(dataset.batches(batch_size, shuffle=False,
+                                      drop_last=False))
+        noise0, real0 = batch0["x"]
+        self._ensure_built(tuple(noise0.shape[1:]), tuple(real0.shape[1:]),
+                           rng)
+        step_fn = self._build_step()
+        it = None
+        while self.step < steps:
+            if it is None:
+                it = dataset.batches(batch_size, shuffle=True,
+                                     seed=ctx.seed, epoch=self.step)
+            batch = next(it, None)
+            if batch is None:
+                it = None
+                continue
+            noise, real = batch["x"]
+            rng, sub = jax.random.split(rng)
+            out = step_fn(self._gp, self._dp, self._g_opt_state,
+                          self._d_opt_state, self._gs, self._ds,
+                          jnp.asarray(self.step), noise, real, sub)
+            self._gp, self._dp, self._g_opt_state, self._d_opt_state, _ = out
+            self.step += 1
+        self._save()
+        return self
+
+    # ------------------------------------------------------------------
+    def generate(self, noise, batch_size: int = 256) -> np.ndarray:
+        """Sample the generator (the reference exposes this by re-loading
+        the generator variable scope from checkpoint)."""
+        if self.gen_net is None:
+            self.gen_net = _build_net(
+                self._generator_fn, tuple(np.asarray(noise).shape[1:]))
+            self.gen_net.build_params()
+            self._load()
+        outs = []
+        for lo in range(0, len(noise), batch_size):
+            out, _ = self.gen_net.forward(self._gp, noise[lo:lo + batch_size],
+                                          state=self._gs, training=False)
+            outs.append(np.asarray(out))
+        return np.concatenate(outs)
+
+    def _save(self):
+        os.makedirs(os.path.dirname(self.checkpoint_path), exist_ok=True)
+        blob = {
+            "gp": jax.tree_util.tree_map(np.asarray, self._gp),
+            "dp": jax.tree_util.tree_map(np.asarray, self._dp),
+            "gs": jax.tree_util.tree_map(np.asarray, self._gs),
+            "ds": jax.tree_util.tree_map(np.asarray, self._ds),
+            "step": self.step,
+        }
+        with open(self.checkpoint_path, "wb") as f:
+            pickle.dump(blob, f)
+
+    def _load(self):
+        with open(self.checkpoint_path, "rb") as f:
+            blob = pickle.load(f)
+        self._gp, self._dp = blob["gp"], blob["dp"]
+        self._gs, self._ds = blob["gs"], blob["ds"]
+        self.step = blob["step"]

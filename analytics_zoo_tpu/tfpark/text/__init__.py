@@ -1,0 +1,1 @@
+from . import estimator, keras  # noqa: F401

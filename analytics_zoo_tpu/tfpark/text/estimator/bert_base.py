@@ -1,0 +1,147 @@
+"""BERT estimator base (reference pyzoo/zoo/tfpark/text/estimator/
+bert_base.py:21-130).
+
+The reference's ``bert_model`` builds google-research BERT from a config
+file and checkpoints; here the encoder is the framework's own
+:class:`~analytics_zoo_tpu.pipeline.api.keras.layers.BERT` layer (one fused
+XLA program, bf16-friendly), and each estimator supplies a head over the
+``[sequence_output, pooled_output]`` pair.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.dataset import FeatureSet
+from analytics_zoo_tpu.pipeline.api.keras.layers import BERT
+from analytics_zoo_tpu.tfpark.estimator import TFEstimator
+
+
+def read_bert_config(bert_config_file: str | None) -> dict:
+    """google-research bert_config.json -> BERT layer kwargs."""
+    if bert_config_file is None:
+        return {}
+    with open(bert_config_file) as f:
+        cfg = json.load(f)
+    return dict(
+        vocab=cfg.get("vocab_size", 30522),
+        hidden_size=cfg.get("hidden_size", 768),
+        n_block=cfg.get("num_hidden_layers", 12),
+        n_head=cfg.get("num_attention_heads", 12),
+        seq_len=cfg.get("max_position_embeddings", 512),
+        intermediate_size=cfg.get("intermediate_size", 3072),
+        hidden_p_drop=cfg.get("hidden_dropout_prob", 0.1),
+        attn_p_drop=cfg.get("attention_probs_dropout_prob", 0.1),
+        type_vocab=cfg.get("type_vocab_size", 2),
+    )
+
+
+def bert_input_fn(data, max_seq_length: int, batch_size: int = 32,
+                  labels=None):
+    """Build an input_fn from token arrays (reference bert_base.py:51-106
+    takes an RDD of feature dicts).
+
+    ``data``: dict with ``input_ids``, optional ``token_type_ids``,
+    ``position_ids``, ``input_mask`` arrays of shape (N, max_seq_length),
+    or just the input_ids array.
+    """
+    if not isinstance(data, dict):
+        data = {"input_ids": np.asarray(data)}
+    ids = np.asarray(data["input_ids"], np.int32)
+    n, l = ids.shape
+    assert l == max_seq_length, f"input_ids length {l} != {max_seq_length}"
+    types = np.asarray(data.get("token_type_ids",
+                                np.zeros_like(ids)), np.int32)
+    positions = np.asarray(data.get(
+        "position_ids", np.broadcast_to(np.arange(l, dtype=np.int32),
+                                        (n, l))), np.int32)
+    mask = np.asarray(data.get("input_mask", np.ones_like(ids)), np.int32)
+    xs = [ids, types, positions, mask]
+    y = data.get("labels", labels)
+
+    def input_fn():
+        return FeatureSet.of(xs, None if y is None else np.asarray(y))
+
+    return input_fn
+
+
+class BERTBaseEstimator(TFEstimator):
+    """Reference bert_base.py:108-130: TFEstimator whose model_fn runs the
+    BERT encoder then a task head.
+
+    ``head_fn(seq_output, pooled_output, labels, mode, params)`` returns a
+    TFEstimatorSpec.
+    """
+
+    def __init__(self, head_fn, bert_config_file=None, init_checkpoint=None,
+                 optimizer=None, model_dir=None, **bert_overrides):
+        bert_kwargs = read_bert_config(bert_config_file)
+        bert_kwargs.update(bert_overrides)
+        self._bert_kwargs = bert_kwargs
+        self._init_checkpoint = init_checkpoint
+        self._head_fn = head_fn
+        self.bert = None
+
+        def model_fn(features, labels, mode, params):
+            self.bert = BERT(**bert_kwargs)
+            seq, pooled = self.bert(list(features))
+            return head_fn(seq, pooled, labels, mode, params)
+
+        super().__init__(model_fn, optimizer=optimizer, model_dir=model_dir)
+
+    def _ensure_built(self, fs, mode):
+        first = self._spec is None
+        super()._ensure_built(fs, mode)
+        if first and self._init_checkpoint:
+            self._load_init_checkpoint()
+
+    def _load_init_checkpoint(self):
+        """Warm-start the encoder from saved weights (reference
+        init_checkpoint: tf checkpoint restore)."""
+        net = self._train_net or self._pred_net
+        params, _ = net.build_params()
+        with np.load(self._init_checkpoint, allow_pickle=True) as data:
+            saved = {k: data[k] for k in data.files}
+        name = self.bert.name
+        bert_params = params.get(name)
+        if bert_params is None:
+            raise ValueError(
+                f"no parameter group {name!r} in the built net; cannot "
+                "warm-start")
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten(bert_params)
+        restored, misses = [], 0
+        for i, leaf in enumerate(flat):
+            hit = saved.get(f"{name}/{i}")
+            if hit is None or hit.shape != np.asarray(leaf).shape:
+                misses += 1
+                restored.append(leaf)
+            else:
+                restored.append(hit)
+        if misses == len(flat):
+            raise ValueError(
+                f"init_checkpoint {self._init_checkpoint!r} matches none of "
+                f"the {len(flat)} encoder leaves (saved under a different "
+                "layer name or architecture)")
+        if misses:
+            import logging
+
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "warm-start restored %d/%d encoder leaves; %d kept their "
+                "fresh initialization (shape/name mismatch)",
+                len(flat) - misses, len(flat), misses)
+        params[name] = jax.tree_util.tree_unflatten(treedef, restored)
+        net.params = params
+
+    def save_init_checkpoint(self, path: str):
+        """Save the trained encoder for later warm-starts."""
+        import jax
+
+        net = self._train_net or self._pred_net
+        name = self.bert.name
+        flat, _ = jax.tree_util.tree_flatten(net.params[name])
+        np.savez(path, **{f"{name}/{i}": np.asarray(a)
+                          for i, a in enumerate(flat)})

@@ -1,0 +1,52 @@
+"""BERTClassifier (reference pyzoo/zoo/tfpark/text/estimator/
+bert_classifier.py:20-90): pooled output -> dropout -> dense softmax."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Dropout
+from analytics_zoo_tpu.tfpark.estimator import TFEstimatorSpec
+from analytics_zoo_tpu.tfpark.text.estimator.bert_base import (
+    BERTBaseEstimator,
+)
+
+
+def sparse_ce(probs, labels):
+    """Per-sample sparse CE as a graph op over (probs, int labels)
+    Variables; used by the BERT heads to express loss inside the model_fn
+    graph (the reference uses tf.nn.sparse_softmax_cross_entropy)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.autograd import _apply_op
+
+    def fn(p, y):
+        logp = jnp.log(jnp.clip(p, 1e-7, 1.0))
+        y = y.astype(jnp.int32).reshape(y.shape[0], -1)
+        if y.shape[-1:] != (1,):  # sequence labels: mean over positions
+            picked = jnp.take_along_axis(
+                logp.reshape(y.shape + (logp.shape[-1],)), y[..., None],
+                axis=-1)[..., 0]
+            return -jnp.mean(picked, axis=-1)
+        picked = jnp.take_along_axis(logp, y, axis=-1)[..., 0]
+        return -picked
+
+    return _apply_op(fn, lambda shapes: (shapes[0][0],), "sparse_ce",
+                     probs, labels)
+
+
+class BERTClassifier(BERTBaseEstimator):
+    def __init__(self, num_classes, bert_config_file=None,
+                 init_checkpoint=None, optimizer=None, model_dir=None,
+                 dropout=0.1, **bert_overrides):
+        def head_fn(seq, pooled, labels, mode, params):
+            h = Dropout(dropout)(pooled)
+            probs = Dense(num_classes, activation="softmax",
+                          name="classifier_out")(h)
+            if mode == "predict" or labels is None:
+                return TFEstimatorSpec(mode, predictions=probs)
+            loss = sparse_ce(probs, labels)
+            return TFEstimatorSpec(mode, predictions=probs, loss=loss)
+
+        super().__init__(head_fn, bert_config_file=bert_config_file,
+                         init_checkpoint=init_checkpoint,
+                         optimizer=optimizer, model_dir=model_dir,
+                         **bert_overrides)
