@@ -17,12 +17,15 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.core import (  # noqa: F401
     Highway,
     Identity,
     Masking,
+    MaxoutDense,
     Permute,
     RepeatVector,
     Reshape,
     Select,
+    SparseDense,
     SpatialDropout1D,
     SpatialDropout2D,
+    SpatialDropout3D,
     Squeeze,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
@@ -36,7 +39,9 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
     Cropping3D,
     Deconvolution2D,
     LocallyConnected1D,
+    LocallyConnected2D,
     SeparableConvolution2D,
+    ShareConvolution2D,
     UpSampling1D,
     UpSampling2D,
     UpSampling3D,
@@ -46,6 +51,8 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (  # noqa: F401
     Embedding,
+    SparseEmbedding,
+    WordEmbedding,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.merge import (  # noqa: F401
     Merge,
@@ -68,6 +75,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (  # noqa: F40
     LSTM,
     Bidirectional,
     ConvLSTM2D,
+    ConvLSTM3D,
     SimpleRNN,
     TimeDistributed,
 )
@@ -88,6 +96,37 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (  # noqa: F401
     MaxPooling1D,
     MaxPooling2D,
     MaxPooling3D,
+)
+
+from analytics_zoo_tpu.pipeline.api.keras.layers.tensor_ops import (  # noqa: F401
+    LRN2D,
+    AddConstant,
+    BinaryThreshold,
+    CAdd,
+    CMul,
+    Exp,
+    Expand,
+    GaussianSampler,
+    GetShape,
+    HardShrink,
+    HardTanh,
+    Log,
+    Max,
+    Mul,
+    MulConstant,
+    Narrow,
+    Negative,
+    Power,
+    ResizeBilinear,
+    RReLU,
+    Scale,
+    SelectTable,
+    Softmax,
+    SoftShrink,
+    SplitTensor,
+    Sqrt,
+    Square,
+    Threshold,
 )
 
 # Keras-2-style aliases (reference keras2 package provides these names).

@@ -410,3 +410,103 @@ class LocallyConnected1D(Layer):
     def compute_output_shape(self, input_shape):
         return (input_shape[0], self._out_len(input_shape[1]),
                 self.nb_filter)
+
+
+class LocallyConnected2D(Layer):
+    """Unshared-weights 2D conv (reference LocallyConnected2D.scala), NHWC.
+
+    Like LocallyConnected1D, lowered to one einsum over extracted patches —
+    a single large MXU contraction instead of per-position kernels.
+    """
+
+    def __init__(self, nb_filter, nb_row, nb_col, subsample=(1, 1),
+                 border_mode="valid", activation=None, bias=True,
+                 init="glorot_uniform", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        assert border_mode == "valid", (
+            "LocallyConnected2D supports border_mode='valid' (the reference "
+            "raises for 'same' too)"
+        )
+        self.nb_filter = int(nb_filter)
+        self.nb_row = int(nb_row)
+        self.nb_col = int(nb_col)
+        self.subsample = _ntuple(subsample, 2)
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+
+    def _out_hw(self, h, w):
+        return ((h - self.nb_row) // self.subsample[0] + 1,
+                (w - self.nb_col) // self.subsample[1] + 1)
+
+    def build(self, input_shape):
+        h, w, in_ch = (int(s) for s in input_shape[-3:])
+        oh, ow = self._out_hw(h, w)
+        k = self.nb_row * self.nb_col * in_ch
+        self.add_weight("kernel", (oh, ow, k, self.nb_filter), self.init)
+        if self.bias:
+            self.add_weight("bias", (oh, ow, self.nb_filter), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        b, h, w, c = inputs.shape
+        oh, ow = self._out_hw(h, w)
+        rows = (np.arange(oh) * self.subsample[0])[:, None] \
+            + np.arange(self.nb_row)[None, :]
+        cols = (np.arange(ow) * self.subsample[1])[:, None] \
+            + np.arange(self.nb_col)[None, :]
+        # (B,H,W,C) -> (B,OH,kh,W,C) -> (B,OH,kh,OW,kw,C)
+        patches = inputs[:, rows][:, :, :, cols]
+        patches = jnp.transpose(patches, (0, 1, 3, 2, 4, 5))
+        patches = patches.reshape(b, oh, ow, -1)
+        y = jnp.einsum("bhwk,hwkf->bhwf", patches, params["kernel"])
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        oh, ow = self._out_hw(input_shape[1], input_shape[2])
+        return (input_shape[0], oh, ow, self.nb_filter)
+
+
+class ShareConvolution2D(_ConvND):
+    """Reference ShareConvolution2D.scala: a Convolution2D variant that in
+    BigDL shares the im2col workspace across replicas to save host memory.
+    Under XLA there is no im2col buffer to share (the conv is emitted
+    directly on the MXU), so this is the same lowering as Convolution2D;
+    kept as a distinct class for API parity, including the explicit pad
+    arguments.
+    """
+
+    rank = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col, subsample=(1, 1),
+                 pad_h=0, pad_w=0, propagate_back=True, activation=None,
+                 bias=True, init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(nb_filter, (nb_row, nb_col), subsample, "valid",
+                         activation, bias, 1, init, input_shape, name,
+                         **kwargs)
+        self.pad_h = int(pad_h)
+        self.pad_w = int(pad_w)
+        self.propagate_back = bool(propagate_back)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if self.pad_h or self.pad_w:
+            inputs = jnp.pad(
+                inputs,
+                ((0, 0), (self.pad_h, self.pad_h),
+                 (self.pad_w, self.pad_w), (0, 0)),
+            )
+        if not self.propagate_back:
+            inputs = lax.stop_gradient(inputs)
+        return super().call(params, inputs, state=state, training=training,
+                            rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        b, h, w, _ = input_shape
+        padded = (b,
+                  None if h is None else h + 2 * self.pad_h,
+                  None if w is None else w + 2 * self.pad_w,
+                  input_shape[3])
+        return super().compute_output_shape(padded)

@@ -78,28 +78,27 @@ class Dropout(Layer):
         return jnp.where(mask, inputs / keep, 0.0)
 
 
-class SpatialDropout1D(Dropout):
-    """Drops whole feature maps (reference SpatialDropout1D.scala)."""
+class _SpatialDropoutND(Dropout):
+    """Drops whole (channels-last) feature maps — one Bernoulli draw per
+    (sample, channel), broadcast over the spatial dims (reference
+    SpatialDropout1D/2D/3D.scala)."""
 
     def call(self, params, inputs, state=None, training=False, rng=None):
         if not training or self.p <= 0.0 or rng is None:
             return inputs
         keep = 1.0 - self.p
-        shape = (inputs.shape[0], 1, inputs.shape[2])
+        shape = ((inputs.shape[0],) + (1,) * (inputs.ndim - 2)
+                 + (inputs.shape[-1],))
         mask = jax.random.bernoulli(rng, keep, shape)
         return jnp.where(mask, inputs / keep, 0.0)
 
 
-class SpatialDropout2D(Dropout):
-    """NHWC feature-map dropout (reference SpatialDropout2D.scala)."""
+class SpatialDropout1D(_SpatialDropoutND):
+    """(B, steps, C) feature-map dropout."""
 
-    def call(self, params, inputs, state=None, training=False, rng=None):
-        if not training or self.p <= 0.0 or rng is None:
-            return inputs
-        keep = 1.0 - self.p
-        shape = (inputs.shape[0], 1, 1, inputs.shape[3])
-        mask = jax.random.bernoulli(rng, keep, shape)
-        return jnp.where(mask, inputs / keep, 0.0)
+
+class SpatialDropout2D(_SpatialDropoutND):
+    """NHWC feature-map dropout."""
 
 
 class GaussianNoise(Layer):
@@ -290,3 +289,126 @@ class ExpandDim(Layer):
         dim = self.dim if self.dim >= 0 else len(shape) + 1 + self.dim
         shape.insert(dim, 1)
         return tuple(shape)
+
+
+class SpatialDropout3D(_SpatialDropoutND):
+    """NDHWC volume feature-map dropout."""
+
+
+class MaxoutDense(Layer):
+    """Maxout over ``nb_feature`` linear maps (reference MaxoutDense.scala):
+    ``y_j = max_k (x @ W_k + b_k)_j``.  The k maps are one fused matmul
+    (in, nb_feature*out) so the MXU sees a single large contraction.
+    """
+
+    def __init__(self, output_dim, nb_feature=4, bias=True,
+                 init="glorot_uniform", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.bias = bias
+        self.init = init
+        self._config = dict(output_dim=output_dim, nb_feature=nb_feature)
+
+    def build(self, input_shape):
+        in_dim = int(input_shape[-1])
+        self.add_weight(
+            "kernel", (in_dim, self.nb_feature * self.output_dim), self.init
+        )
+        if self.bias:
+            self.add_weight("bias", (self.nb_feature * self.output_dim,),
+                            "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = inputs @ params["kernel"]
+        if self.bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class SparseDense(Layer):
+    """Dense layer over sparse COO input (reference SparseDense.scala, which
+    wraps BigDL SparseLinear).
+
+    Input may be a dense array or a ``(indices, values, dense_shape)`` COO
+    triple — ``indices`` (nnz, 2) int rows of (sample, feature);
+    ``dense_shape`` must be static Python ints (it fixes the output batch
+    size at trace time), not a traced array.  The sparse
+    path materialises per-sample dense rows with a segment-sum scatter, the
+    natural XLA lowering (TPUs have no sparse MXU path; for the very sparse
+    + very wide case shard the kernel over the model axis instead).
+    Gradients flow to kernel/bias and the COO ``values``;
+    ``backward_start``/``backward_length`` (1-based, like the reference)
+    restrict which input features receive gradient.
+    """
+
+    def __init__(self, output_dim, activation=None, bias=True,
+                 init="glorot_uniform", backward_start=-1, backward_length=-1,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.output_dim = int(output_dim)
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+        self.backward_start = int(backward_start)
+        self.backward_length = int(backward_length)
+        self._config = dict(output_dim=output_dim, bias=bias,
+                            backward_start=backward_start,
+                            backward_length=backward_length)
+
+    def _grad_window(self, n_features):
+        if self.backward_start < 0:
+            return None
+        start = self.backward_start - 1  # reference is 1-based
+        length = (self.backward_length if self.backward_length >= 0
+                  else n_features - start)
+        return start, start + length
+
+    def build(self, input_shape):
+        in_dim = int(input_shape[-1])
+        self.add_weight("kernel", (in_dim, self.output_dim), self.init)
+        if self.bias:
+            self.add_weight("bias", (self.output_dim,), "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if isinstance(inputs, (tuple, list)) and len(inputs) == 3:
+            indices, values, dense_shape = inputs
+            try:
+                n = int(dense_shape[0])
+                n_feat = int(dense_shape[1])
+            except TypeError as e:  # traced array under jit
+                raise TypeError(
+                    "SparseDense: dense_shape must be static Python ints "
+                    "(it fixes the output batch size at trace time); pass a "
+                    "plain tuple, not a traced jax array"
+                ) from e
+            window = self._grad_window(n_feat)
+            if window is not None:
+                lo, hi = window
+                in_win = (indices[:, 1] >= lo) & (indices[:, 1] < hi)
+                frozen = jax.lax.stop_gradient(values)
+                values = jnp.where(in_win, values, frozen)
+            # rows of W gathered per nnz, scaled, then scatter-added per
+            # sample: one gather + one segment_sum, both XLA-native.
+            contrib = values[:, None] * params["kernel"][indices[:, 1]]
+            y = jax.ops.segment_sum(contrib, indices[:, 0], num_segments=n)
+        else:
+            window = self._grad_window(inputs.shape[-1])
+            if window is not None:
+                lo, hi = window
+                mask = jnp.zeros(inputs.shape[-1], inputs.dtype
+                                 ).at[lo:hi].set(1.0)
+                frozen = jax.lax.stop_gradient(inputs)
+                inputs = frozen + (inputs - frozen) * mask
+            y = inputs @ params["kernel"]
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)

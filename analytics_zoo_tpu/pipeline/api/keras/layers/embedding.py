@@ -71,3 +71,131 @@ class _Pretrained:
 
     def __call__(self, rng, shape, dtype):
         return jnp.asarray(self.w, dtype)
+
+
+class SparseEmbedding(Embedding):
+    """Reference SparseEmbedding.scala: an Embedding whose backward produces
+    sparse gradient updates.  Under XLA the gradient of ``jnp.take`` is
+    already a scatter-add touching only the looked-up rows, so the dense
+    Embedding lowering gives the same behavior; kept as a distinct class for
+    API parity.
+    """
+
+
+class WordEmbedding(Embedding):
+    """Frozen pretrained word embeddings (reference WordEmbedding.scala):
+    loads GloVe-format text vectors, maps them through ``word_index``, and
+    is non-trainable.
+
+    ``WordEmbedding(embedding_file, word_index, input_length)``; index 0 is
+    reserved for padding/unknown (zero vector), matching the reference's
+    1-based word ids with a zero row.
+    """
+
+    # parse cache keyed by (path, mtime) so get_word_index() followed by
+    # the constructor reads a multi-GB GloVe file once, not twice
+    _vector_cache: dict = {}
+
+    def __init__(self, embedding_file, word_index=None, trainable=False,
+                 input_length=None, input_shape=None, name=None, **kwargs):
+        vectors, dim = self._load_vectors(embedding_file)
+        if word_index is None:
+            word_index = {w: i + 1 for i, w in enumerate(sorted(vectors))}
+        self.word_index = dict(word_index)
+        vocab = max(self.word_index.values()) + 1
+        table = np.zeros((vocab, dim), dtype=np.float32)
+        hit = 0
+        for word, idx in self.word_index.items():
+            vec = vectors.get(word)
+            if vec is not None and 0 <= idx < vocab:
+                table[idx] = vec
+                hit += 1
+        if input_shape is None and input_length is not None:
+            input_shape = (int(input_length),)
+        super().__init__(vocab, dim, weights=table, trainable=trainable,
+                         input_shape=input_shape, name=name, **kwargs)
+        self.n_pretrained = hit
+
+    @staticmethod
+    def _load_vectors(path):
+        """Parse GloVe/word2vec ``word v1 v2 ...`` text files.
+
+        Robust to the quirks of real embedding dumps: word2vec/fastText
+        header lines (``<count> <dim>``) are skipped, and words containing
+        spaces (e.g. ``. . .`` in glove.840B) are handled by splitting the
+        float suffix off from the right.
+        """
+        key = None
+        try:
+            import os as _os
+
+            key = (path, _os.stat(path).st_mtime_ns)
+            cached = WordEmbedding._vector_cache.get(key)
+            if cached is not None:
+                return cached
+        except OSError:
+            pass
+
+        def float_suffix_len(parts):
+            # float-parseable tokens counted from the right; everything
+            # before them is the (possibly multi-token) word.
+            n = 0
+            for tok in reversed(parts[1:]):
+                try:
+                    float(tok)
+                    n += 1
+                except ValueError:
+                    break
+            return n
+
+        vectors, dim = {}, None
+        pending = []  # buffered (parts, n_float) until dim is decided
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(" ")
+                if len(parts) < 2:
+                    continue
+                if lineno == 0 and len(parts) == 2:
+                    try:  # word2vec header "<vocab> <dim>"
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
+                n_float = float_suffix_len(parts)
+                if n_float == 0:
+                    continue
+                if dim is None:
+                    # A multi-token word whose tail happens to parse as a
+                    # float inflates n_float, never deflates it — so the
+                    # minimum over a few lines is the true dim.
+                    pending.append((parts, n_float))
+                    if len(pending) < 10:
+                        continue
+                    dim = min(n for _, n in pending)
+                    rows, pending = pending, []
+                else:
+                    rows = [(parts, n_float)]
+                for p, n in rows:
+                    if n < dim:
+                        continue
+                    vectors[" ".join(p[:-dim])] = np.asarray(
+                        p[-dim:], dtype=np.float32
+                    )
+        if dim is None and pending:  # short file: fewer than 10 data lines
+            dim = min(n for _, n in pending)
+            for p, n in pending:
+                if n >= dim:
+                    vectors[" ".join(p[:-dim])] = np.asarray(
+                        p[-dim:], dtype=np.float32
+                    )
+        if dim is None:
+            raise ValueError(f"no vectors found in {path}")
+        if key is not None:
+            WordEmbedding._vector_cache[key] = (vectors, dim)
+        return vectors, dim
+
+    @staticmethod
+    def get_word_index(embedding_file):
+        """word -> id (1-based) for every word in the embedding file."""
+        vectors, _ = WordEmbedding._load_vectors(embedding_file)
+        return {w: i + 1 for i, w in enumerate(sorted(vectors))}

@@ -240,12 +240,15 @@ class TimeDistributed(Layer):
         return (input_shape[0], input_shape[1]) + tuple(inner_shape[1:])
 
 
-class ConvLSTM2D(Layer):
-    """Convolutional LSTM (reference ConvLSTM2D.scala), NHWC; the four gates
-    are one fused convolution."""
+class _ConvLSTMND(Layer):
+    """Rank-parameterized convolutional LSTM (reference ConvLSTM2D.scala /
+    ConvLSTM3D.scala): the four gates are one fused N-d convolution, scanned
+    over time with ``lax.scan``.  Channels-last layouts (NHWC / NDHWC)."""
+
+    rank: int = 2
 
     def __init__(self, nb_filter, nb_kernel, return_sequences=False,
-                 border_mode="same", subsample=(1, 1),
+                 border_mode="same", subsample=None,
                  inner_activation="hard_sigmoid", activation="tanh",
                  go_backwards=False, input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
@@ -253,50 +256,54 @@ class ConvLSTM2D(Layer):
         self.nb_kernel = int(nb_kernel)
         self.return_sequences = return_sequences
         self.border_mode = border_mode
+        if subsample is None:
+            subsample = (1,) * self.rank
         self.subsample = tuple(
             subsample if isinstance(subsample, (list, tuple))
-            else (subsample, subsample)
+            else (subsample,) * self.rank
         )
         self.activation = get_activation(activation)
         self.inner_activation = get_activation(inner_activation)
         self.go_backwards = go_backwards
 
     def build(self, input_shape):
-        # input: (T, H, W, C)
+        # input (without batch): (T, *spatial, C)
         in_ch = int(input_shape[-1])
-        k = self.nb_kernel
-        self.add_weight("kernel", (k, k, in_ch, 4 * self.nb_filter))
+        k = (self.nb_kernel,) * self.rank
+        self.add_weight("kernel", k + (in_ch, 4 * self.nb_filter))
         self.add_weight("recurrent_kernel",
-                        (k, k, self.nb_filter, 4 * self.nb_filter))
+                        k + (self.nb_filter, 4 * self.nb_filter))
         self.add_weight("bias", (4 * self.nb_filter,), "zero")
 
-    def _out_spatial(self, h, w):
+    def _out_spatial(self, spatial):
         from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
             _conv_out_dim,
         )
 
-        k = self.nb_kernel
-        return (
-            _conv_out_dim(h, k, self.subsample[0], self.border_mode),
-            _conv_out_dim(w, k, self.subsample[1], self.border_mode),
+        return tuple(
+            _conv_out_dim(s, self.nb_kernel, st, self.border_mode)
+            for s, st in zip(spatial, self.subsample)
         )
 
-    def _conv(self, x, w, strides=(1, 1), padding="SAME"):
+    def _conv(self, x, w, strides=None, padding="SAME"):
+        from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _DIMNUMS
+
         return lax.conv_general_dilated(
-            x, w, window_strides=strides, padding=padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            x, w, window_strides=strides or (1,) * self.rank,
+            padding=padding, dimension_numbers=_DIMNUMS[self.rank],
         )
 
     def call(self, params, inputs, state=None, training=False, rng=None):
-        # inputs: (B, T, H, W, C); input conv applies border_mode+stride,
-        # the recurrent conv is SAME/stride-1 over the (already strided)
-        # hidden state — matching the reference ConvLSTM2D semantics.
+        # inputs: (B, T, *spatial, C); the input conv applies
+        # border_mode+stride, the recurrent conv is SAME/stride-1 over the
+        # (already strided) hidden state — reference ConvLSTM semantics.
         x = jnp.swapaxes(inputs, 0, 1)
         if self.go_backwards:
             x = x[::-1]
-        b, hh, ww = inputs.shape[0], inputs.shape[2], inputs.shape[3]
-        oh, ow = self._out_spatial(hh, ww)
-        h0 = jnp.zeros((b, oh, ow, self.nb_filter))
+        b = inputs.shape[0]
+        out_spatial = self._out_spatial(inputs.shape[2:2 + self.rank])
+        h0 = jnp.zeros((b,) + out_spatial + (self.nb_filter,),
+                       inputs.dtype)
         c0 = jnp.zeros_like(h0)
 
         def body(carry, x_t):
@@ -323,8 +330,19 @@ class ConvLSTM2D(Layer):
         return h
 
     def compute_output_shape(self, input_shape):
-        b, t, h, w, _ = input_shape
-        oh, ow = self._out_spatial(h, w)
+        b, t = input_shape[:2]
+        out_spatial = self._out_spatial(input_shape[2:2 + self.rank])
         if self.return_sequences:
-            return (b, t, oh, ow, self.nb_filter)
-        return (b, oh, ow, self.nb_filter)
+            return (b, t) + out_spatial + (self.nb_filter,)
+        return (b,) + out_spatial + (self.nb_filter,)
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """Convolutional LSTM over NHWC frames (reference ConvLSTM2D.scala)."""
+    rank = 2
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """Volumetric convolutional LSTM over NDHWC volumes (reference
+    ConvLSTM3D.scala / InternalConvLSTM3D)."""
+    rank = 3

@@ -1,0 +1,469 @@
+"""Tensor-manipulation and scalar-op layers.
+
+Reference: pipeline/api/keras/layers/{AddConstant,MulConstant,Mul,CAdd,CMul,
+Scale,Negative,Power,Sqrt,Square,Exp,Log,BinaryThreshold,Threshold,HardShrink,
+SoftShrink,HardTanh,RReLU,Softmax,GaussianSampler,GetShape,Expand,Narrow,Max,
+SelectTable,SplitTensor,LRN2D,ResizeBilinear}.scala — thin BigDL module
+wrappers.  Here each is a pure jnp function (XLA fuses them into neighbouring
+matmuls/convs for free); the handful with weights (CAdd/CMul/Scale/Mul) carry
+them in the params pytree.
+
+All axis arguments follow the reference's Keras-1 convention: dims count the
+batch axis (dim 0 = batch) unless noted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+# ---------------------------------------------------------------------------
+# scalar / elementwise math
+# ---------------------------------------------------------------------------
+
+class AddConstant(Layer):
+    """y = x + constant (reference AddConstant.scala)."""
+
+    def __init__(self, constant_scalar, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.constant = float(constant_scalar)
+        self._config = dict(constant_scalar=self.constant)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs + self.constant
+
+
+class MulConstant(Layer):
+    """y = x * constant (reference MulConstant.scala)."""
+
+    def __init__(self, constant_scalar, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.constant = float(constant_scalar)
+        self._config = dict(constant_scalar=self.constant)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs * self.constant
+
+
+class Negative(Layer):
+    """y = -x (reference Negative.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return -inputs
+
+
+class Power(Layer):
+    """y = (shift + scale * x) ** power (reference Power.scala)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+        self._config = dict(power=power, scale=scale, shift=shift)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * inputs, self.power)
+
+
+class Sqrt(Layer):
+    """Element-wise sqrt (reference Sqrt.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.sqrt(inputs)
+
+
+class Square(Layer):
+    """Element-wise square (reference Square.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.square(inputs)
+
+
+class Exp(Layer):
+    """Element-wise exp (reference Exp.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.exp(inputs)
+
+
+class Log(Layer):
+    """Element-wise natural log (reference Log.scala)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.log(inputs)
+
+
+# ---------------------------------------------------------------------------
+# thresholding activations
+# ---------------------------------------------------------------------------
+
+class BinaryThreshold(Layer):
+    """1 where x > th else 0 (reference BinaryThreshold.scala)."""
+
+    def __init__(self, th=1e-6, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.th = float(th)
+        self._config = dict(th=self.th)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return (inputs > self.th).astype(inputs.dtype)
+
+
+class Threshold(Layer):
+    """x where x > th else v (reference Threshold.scala)."""
+
+    def __init__(self, th=1e-6, v=0.0, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.th = float(th)
+        self.v = float(v)
+        self._config = dict(th=self.th, v=self.v)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.where(inputs > self.th, inputs, self.v)
+
+
+class HardShrink(Layer):
+    """x where |x| > lambda else 0 (reference HardShrink.scala)."""
+
+    def __init__(self, value=0.5, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.value = float(value)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.where(jnp.abs(inputs) > self.value, inputs, 0.0)
+
+
+class SoftShrink(Layer):
+    """sign(x) * max(|x| - lambda, 0) (reference SoftShrink.scala)."""
+
+    def __init__(self, value=0.5, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.value = float(value)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.sign(inputs) * jnp.maximum(jnp.abs(inputs) - self.value,
+                                              0.0)
+
+
+class HardTanh(Layer):
+    """clip(x, min, max) (reference HardTanh.scala)."""
+
+    def __init__(self, min_value=-1.0, max_value=1.0, input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.clip(inputs, self.min_value, self.max_value)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference RReLU.scala): negative slope drawn
+    from U(lower, upper) per element in training, fixed to the mean slope at
+    inference."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        if training and rng is not None:
+            slope = jax.random.uniform(
+                rng, inputs.shape, inputs.dtype, self.lower, self.upper
+            )
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(inputs >= 0, inputs, slope * inputs)
+
+
+class Softmax(Layer):
+    """Softmax over the last axis (reference Softmax.scala; 2D/3D inputs)."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jax.nn.softmax(inputs, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# learnable per-channel affine ops
+# ---------------------------------------------------------------------------
+
+class CAdd(Layer):
+    """Learnable per-element bias of shape ``size``, broadcast-added
+    (reference CAdd.scala).  ``size`` excludes the batch dim."""
+
+    def __init__(self, size, init="zero", input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.size = tuple(int(s) for s in size)
+        self.init = init
+        self._config = dict(size=self.size)
+
+    def build(self, input_shape):
+        self.add_weight("bias", self.size, self.init)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs + params["bias"]
+
+
+class CMul(Layer):
+    """Learnable per-element scale of shape ``size`` (reference CMul.scala)."""
+
+    def __init__(self, size, init="one", input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.size = tuple(int(s) for s in size)
+        self.init = init
+        self._config = dict(size=self.size)
+
+    def build(self, input_shape):
+        self.add_weight("weight", self.size, self.init)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs * params["weight"]
+
+
+class Scale(Layer):
+    """CMul then CAdd with weights of shape ``size`` (reference Scale.scala —
+    the caffe Scale layer)."""
+
+    def __init__(self, size, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.size = tuple(int(s) for s in size)
+        self._config = dict(size=self.size)
+
+    def build(self, input_shape):
+        self.add_weight("weight", self.size, "one")
+        self.add_weight("bias", self.size, "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs * params["weight"] + params["bias"]
+
+
+class Mul(Layer):
+    """Single learnable scalar multiplier (reference Mul.scala)."""
+
+    def build(self, input_shape):
+        self.add_weight("weight", (1,), "uniform")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs * params["weight"]
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+class GaussianSampler(Layer):
+    """Reparameterised sampler for VAEs (reference GaussianSampler.scala):
+    input is the pair ``[mean, log_variance]``; output
+    ``mean + eps * exp(log_var / 2)`` with eps ~ N(0, 1) during training and
+    the mean at inference."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        mean, log_var = inputs
+        if not training or rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + eps * jnp.exp(log_var * 0.5)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[0]
+
+
+# ---------------------------------------------------------------------------
+# shape / table ops
+# ---------------------------------------------------------------------------
+
+class GetShape(Layer):
+    """Returns the (static) input shape as an int array (reference
+    GetShape.scala).  Under jit shapes are static, so this is a constant."""
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.asarray(inputs.shape, dtype=jnp.int32)
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)
+
+
+class Expand(Layer):
+    """Broadcast singleton dims up to ``shape`` (reference Expand /
+    InternalExpand.scala).  ``shape`` excludes the batch dim."""
+
+    def __init__(self, shape, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.target = tuple(int(s) for s in shape)
+        self._config = dict(shape=self.target)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.broadcast_to(inputs,
+                                (inputs.shape[0],) + self.target)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self.target
+
+
+class Narrow(Layer):
+    """Slice ``length`` elements from ``offset`` along ``dim`` (reference
+    Narrow.scala; dim counts the batch axis, dim >= 1 for per-sample
+    slicing; length -1 = to the end)."""
+
+    def __init__(self, dim, offset, length=1, input_shape=None, name=None,
+                 **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.dim = int(dim)
+        self.offset = int(offset)
+        self.length = int(length)
+        self._config = dict(dim=dim, offset=offset, length=length)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        n = inputs.shape[self.dim]
+        length = self.length if self.length != -1 else n - self.offset
+        idx = [slice(None)] * inputs.ndim
+        idx[self.dim] = slice(self.offset, self.offset + length)
+        return inputs[tuple(idx)]
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        n = out[self.dim]
+        out[self.dim] = (self.length if self.length != -1
+                         else n - self.offset)
+        return tuple(out)
+
+
+class Max(Layer):
+    """Max over ``dim`` (reference Max.scala); optionally keeps the dim."""
+
+    def __init__(self, dim, keep_dim=False, input_shape=None, name=None,
+                 **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.dim = int(dim)
+        self.keep_dim = bool(keep_dim)
+        self._config = dict(dim=dim, keep_dim=keep_dim)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return jnp.max(inputs, axis=self.dim, keepdims=self.keep_dim)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        if self.keep_dim:
+            out[self.dim] = 1
+        else:
+            del out[self.dim]
+        return tuple(out)
+
+
+class SelectTable(Layer):
+    """Select the ``index``-th tensor from a list input (reference
+    SelectTable.scala; zero-based here, matching the python front end)."""
+
+    def __init__(self, index, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.index = int(index)
+        self._config = dict(index=self.index)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return inputs[self.index]
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[self.index]
+
+
+class SplitTensor(Layer):
+    """Split along ``dim`` into ``num_split`` equal tensors (reference
+    SplitTensor.scala); returns a list."""
+
+    def __init__(self, dim, num_split, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.dim = int(dim)
+        self.num_split = int(num_split)
+        self._config = dict(dim=dim, num_split=num_split)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        return list(jnp.split(inputs, self.num_split, axis=self.dim))
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        out[self.dim] = out[self.dim] // self.num_split
+        return [tuple(out)] * self.num_split
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+class LRN2D(Layer):
+    """Across-channel local response normalization over NHWC input
+    (reference LRN2D.scala): ``x / (k + alpha/n * sum_{local} x^2)^beta``.
+
+    TPU note: expressed as a depthwise window sum via ``reduce_window`` on
+    the channel axis — XLA fuses the whole expression; no transpose to NCHW.
+    """
+
+    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.alpha = float(alpha)
+        self.k = float(k)
+        self.beta = float(beta)
+        self.n = int(n)
+        self._config = dict(alpha=alpha, k=k, beta=beta, n=n)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        sq = jnp.square(inputs)
+        half = self.n // 2
+        window = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)),
+        )
+        return inputs / jnp.power(self.k + self.alpha / self.n * window,
+                                  self.beta)
+
+
+class ResizeBilinear(Layer):
+    """Bilinear resize of NHWC images to (out_h, out_w) (reference
+    ResizeBilinear.scala).  Uses jax.image.resize; align_corners follows the
+    TF1 default (False)."""
+
+    def __init__(self, output_height, output_width, align_corners=False,
+                 input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.out_h = int(output_height)
+        self.out_w = int(output_width)
+        self.align_corners = bool(align_corners)
+        self._config = dict(output_height=output_height,
+                            output_width=output_width)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        b, _, _, c = inputs.shape
+        if not self.align_corners:
+            # antialias=False matches the reference's TF1 resize_bilinear
+            # (and torch interpolate) semantics on downsampling.
+            return jax.image.resize(
+                inputs, (b, self.out_h, self.out_w, c), method="bilinear",
+                antialias=False,
+            )
+        # align_corners: sample grid endpoints at the image corners.
+        h, w = inputs.shape[1], inputs.shape[2]
+        ys = jnp.linspace(0.0, h - 1.0, self.out_h)
+        xs = jnp.linspace(0.0, w - 1.0, self.out_w)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        g = inputs
+        top = g[:, y0][:, :, x0] * (1 - wx) + g[:, y0][:, :, x1] * wx
+        bot = g[:, y1][:, :, x0] * (1 - wx) + g[:, y1][:, :, x1] * wx
+        return top * (1 - wy) + bot * wy
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.out_h, self.out_w, input_shape[3])

@@ -1,0 +1,436 @@
+"""Tests for the tensor-op / scalar / sparse / locally-connected layers
+added for layer-inventory parity (reference keras/layers/*.scala) — oracle
+comparisons against torch or numpy per SURVEY.md §4."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+def apply_layer(layer, x, params=None, rng=None, training=False):
+    """Like tests.test_layers.apply_layer but rng-safe (PRNG keys are
+    arrays, so no `rng or default` truthiness)."""
+    layer.ensure_built(tuple(np.shape(x))[1:])
+    if params is None:
+        params = layer.init_params(jax.random.PRNGKey(0))
+    state = layer.init_state()
+    out, _ = layer.apply(params, jnp.asarray(x), state=state or None,
+                         training=training, rng=rng)
+    return np.asarray(out), params
+
+
+rng0 = np.random.default_rng(0)
+
+
+def test_scalar_ops():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AddConstant, Exp, Log, MulConstant, Negative, Power, Sqrt, Square,
+    )
+
+    x = rng0.uniform(0.5, 2.0, size=(3, 4)).astype(np.float32)
+    for layer, fn in [
+        (AddConstant(2.5), lambda v: v + 2.5),
+        (MulConstant(-3.0), lambda v: v * -3.0),
+        (Negative(), lambda v: -v),
+        (Power(2.0, scale=1.5, shift=0.25), lambda v: (0.25 + 1.5 * v) ** 2),
+        (Sqrt(), np.sqrt),
+        (Square(), np.square),
+        (Exp(), np.exp),
+        (Log(), np.log),
+    ]:
+        out, _ = apply_layer(layer, x)
+        np.testing.assert_allclose(out, fn(x), rtol=1e-5, atol=1e-6)
+
+
+def test_threshold_family_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        HardShrink, HardTanh, SoftShrink, Softmax, Threshold,
+    )
+
+    x = rng0.normal(size=(4, 6)).astype(np.float32)
+    t = torch.from_numpy(x)
+
+    out, _ = apply_layer(HardShrink(0.4), x)
+    np.testing.assert_allclose(out, torch.nn.Hardshrink(0.4)(t), atol=1e-6)
+
+    out, _ = apply_layer(SoftShrink(0.4), x)
+    np.testing.assert_allclose(out, torch.nn.Softshrink(0.4)(t), atol=1e-6)
+
+    out, _ = apply_layer(HardTanh(-0.5, 0.7), x)
+    np.testing.assert_allclose(
+        out, torch.nn.Hardtanh(-0.5, 0.7)(t), atol=1e-6
+    )
+
+    out, _ = apply_layer(Threshold(0.1, v=-1.0), x)
+    np.testing.assert_allclose(
+        out, torch.nn.Threshold(0.1, -1.0)(t), atol=1e-6
+    )
+
+    out, _ = apply_layer(Softmax(), x)
+    np.testing.assert_allclose(
+        out, torch.softmax(t, dim=-1), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_binary_threshold_and_rrelu():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BinaryThreshold, RReLU,
+    )
+
+    x = rng0.normal(size=(3, 5)).astype(np.float32)
+    out, _ = apply_layer(BinaryThreshold(0.0), x)
+    np.testing.assert_array_equal(out, (x > 0).astype(np.float32))
+
+    # eval: fixed mean slope
+    out, _ = apply_layer(RReLU(0.25, 0.75), x)
+    ref = np.where(x >= 0, x, 0.5 * x)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    # train: slopes within [lower, upper]
+    layer = RReLU(0.25, 0.75)
+    out, _ = apply_layer(layer, x, rng=jax.random.PRNGKey(1), training=True)
+    neg = x < 0
+    slopes = np.asarray(out)[neg] / x[neg]
+    assert np.all(slopes >= 0.25 - 1e-6) and np.all(slopes <= 0.75 + 1e-6)
+    np.testing.assert_allclose(np.asarray(out)[~neg], x[~neg])
+
+
+def test_learnable_affine_ops():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        CAdd, CMul, Mul, Scale,
+    )
+
+    x = rng0.normal(size=(2, 3, 4)).astype(np.float32)
+
+    layer = CAdd((1, 4))
+    out, params = apply_layer(layer, x)
+    np.testing.assert_allclose(out, x + np.asarray(params["bias"]),
+                               atol=1e-6)
+
+    layer = CMul((3, 1))
+    out, params = apply_layer(layer, x)
+    np.testing.assert_allclose(out, x * np.asarray(params["weight"]),
+                               atol=1e-6)
+
+    layer = Scale((3, 4))
+    out, params = apply_layer(layer, x)
+    np.testing.assert_allclose(
+        out, x * np.asarray(params["weight"]) + np.asarray(params["bias"]),
+        atol=1e-6,
+    )
+
+    layer = Mul()
+    out, params = apply_layer(layer, x)
+    np.testing.assert_allclose(out, x * np.asarray(params["weight"]),
+                               atol=1e-6)
+
+
+def test_shape_and_table_ops():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Expand, GetShape, Max, Narrow, SelectTable, SplitTensor,
+    )
+
+    x = rng0.normal(size=(2, 3, 4)).astype(np.float32)
+
+    out, _ = apply_layer(GetShape(), x)
+    np.testing.assert_array_equal(out, [2, 3, 4])
+
+    small = x[:, :1, :]
+    layer = Expand((3, 4))
+    layer.ensure_built((1, 4))
+    out, _ = layer.apply({}, jnp.asarray(small))
+    np.testing.assert_allclose(out, np.broadcast_to(small, (2, 3, 4)))
+
+    out, _ = apply_layer(Narrow(1, 1, 2), x)
+    np.testing.assert_allclose(out, x[:, 1:3])
+    assert Narrow(2, 1, -1).compute_output_shape((2, 3, 4)) == (2, 3, 3)
+
+    out, _ = apply_layer(Max(2), x)
+    np.testing.assert_allclose(out, x.max(axis=2), rtol=1e-6)
+    assert Max(1, keep_dim=True).compute_output_shape((2, 3, 4)) == (2, 1, 4)
+
+    xs = [x, 2 * x]
+    layer = SelectTable(1)
+    out = layer.call({}, xs)
+    np.testing.assert_allclose(out, 2 * x)
+
+    layer = SplitTensor(2, 2)
+    parts = layer.call({}, jnp.asarray(x))
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[0], x[:, :, :2])
+    np.testing.assert_allclose(parts[1], x[:, :, 2:])
+
+
+def test_gaussian_sampler():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import GaussianSampler
+
+    mean = rng0.normal(size=(4, 8)).astype(np.float32)
+    log_var = np.full((4, 8), -2.0, dtype=np.float32)
+    layer = GaussianSampler()
+
+    out = layer.call({}, [jnp.asarray(mean), jnp.asarray(log_var)])
+    np.testing.assert_allclose(out, mean)  # inference = mean
+
+    out = layer.call({}, [jnp.asarray(mean), jnp.asarray(log_var)],
+                     training=True, rng=jax.random.PRNGKey(0))
+    std = np.exp(-1.0)
+    diff = np.asarray(out) - mean
+    assert np.abs(diff).max() < 6 * std
+    assert np.abs(diff).max() > 0
+
+
+def test_lrn2d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LRN2D
+
+    x = rng0.normal(size=(2, 5, 5, 6)).astype(np.float32)
+    layer = LRN2D(alpha=1e-3, k=2.0, beta=0.75, n=5)
+    out, _ = apply_layer(layer, x)
+
+    ref = torch.nn.LocalResponseNorm(5, alpha=1e-3, beta=0.75, k=2.0)(
+        torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+    ).numpy()
+    np.testing.assert_allclose(
+        out, np.transpose(ref, (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_resize_bilinear_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ResizeBilinear
+
+    x = rng0.normal(size=(2, 6, 8, 3)).astype(np.float32)
+    t = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+
+    out, _ = apply_layer(ResizeBilinear(3, 4), x)
+    ref = torch.nn.functional.interpolate(
+        t, size=(3, 4), mode="bilinear", align_corners=False
+    ).numpy()
+    np.testing.assert_allclose(
+        out, np.transpose(ref, (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
+    )
+
+    out, _ = apply_layer(ResizeBilinear(11, 5, align_corners=True), x)
+    ref = torch.nn.functional.interpolate(
+        t, size=(11, 5), mode="bilinear", align_corners=True
+    ).numpy()
+    np.testing.assert_allclose(
+        out, np.transpose(ref, (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_maxout_dense():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import MaxoutDense
+
+    x = rng0.normal(size=(5, 7)).astype(np.float32)
+    layer = MaxoutDense(3, nb_feature=4)
+    out, params = apply_layer(layer, x)
+
+    w = np.asarray(params["kernel"]).reshape(7, 4, 3)
+    b = np.asarray(params["bias"]).reshape(4, 3)
+    ref = np.max(np.einsum("bi,iko->bko", x, w) + b, axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    assert layer.compute_output_shape((None, 7)) == (None, 3)
+
+
+def test_sparse_dense_matches_dense():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseDense
+
+    dense = np.zeros((3, 6), dtype=np.float32)
+    coords = [(0, 1, 2.0), (0, 4, -1.0), (1, 0, 3.0), (2, 5, 0.5)]
+    for r, c, v in coords:
+        dense[r, c] = v
+    indices = np.asarray([(r, c) for r, c, _ in coords], dtype=np.int32)
+    values = np.asarray([v for _, _, v in coords], dtype=np.float32)
+
+    layer = SparseDense(4, activation="relu")
+    out_dense, params = apply_layer(layer, dense)
+    out_sparse = layer.call(
+        params, (jnp.asarray(indices), jnp.asarray(values), (3, 6))
+    )
+    np.testing.assert_allclose(out_sparse, out_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_word_embedding(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        SparseEmbedding, WordEmbedding,
+    )
+
+    glove = tmp_path / "glove.txt"
+    glove.write_text(
+        "hello 0.1 0.2 0.3\nworld 1.0 -1.0 0.5\nzoo 0.0 0.0 1.0\n"
+    )
+    word_index = {"hello": 1, "world": 2, "zoo": 3}
+    layer = WordEmbedding(str(glove), word_index, input_length=4)
+    assert layer.n_pretrained == 3
+
+    ids = np.asarray([[1, 2, 3, 0]], dtype=np.int32)
+    out, params = apply_layer(layer, ids)
+    np.testing.assert_allclose(out[0, 0], [0.1, 0.2, 0.3], atol=1e-6)
+    np.testing.assert_allclose(out[0, 1], [1.0, -1.0, 0.5], atol=1e-6)
+    np.testing.assert_allclose(out[0, 3], [0.0, 0.0, 0.0], atol=1e-6)
+    # frozen: the table lives in (non-trainable) state, not params
+    assert not params
+    assert layer._state_specs[0].name == "embeddings"
+
+    idx = WordEmbedding.get_word_index(str(glove))
+    assert set(idx) == {"hello", "world", "zoo"}
+
+    se = SparseEmbedding(5, 3)
+    out, _ = apply_layer(se, np.asarray([[0, 4]], dtype=np.int32))
+    assert out.shape == (1, 2, 3)
+
+
+def test_locally_connected_2d_vs_manual():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        LocallyConnected2D,
+    )
+
+    x = rng0.normal(size=(2, 5, 6, 3)).astype(np.float32)
+    layer = LocallyConnected2D(4, 2, 3, subsample=(1, 2))
+    out, params = apply_layer(layer, x)
+    assert out.shape == (2, 4, 2, 4)
+
+    w = np.asarray(params["kernel"])
+    b = np.asarray(params["bias"])
+    for i in range(4):
+        for j in range(2):
+            patch = x[:, i:i + 2, j * 2:j * 2 + 3, :].reshape(2, -1)
+            ref = patch @ w[i, j] + b[i, j]
+            np.testing.assert_allclose(out[:, i, j], ref, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_share_convolution2d_matches_padded_conv():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        ShareConvolution2D,
+    )
+
+    x = rng0.normal(size=(2, 7, 7, 3)).astype(np.float32)
+    layer = ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=1)
+    out, params = apply_layer(layer, x)
+
+    conv = torch.nn.Conv2d(3, 4, 3, padding=1)
+    with torch.no_grad():
+        w = np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1))
+        conv.weight.copy_(torch.from_numpy(w))
+        conv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = conv(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(
+        out, np.transpose(ref, (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
+    )
+    assert layer.compute_output_shape((2, 7, 7, 3)) == (2, 7, 7, 4)
+
+
+def test_conv_lstm_3d_shapes():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ConvLSTM3D
+
+    x = rng0.normal(size=(2, 3, 4, 5, 6, 2)).astype(np.float32)
+    layer = ConvLSTM3D(3, 2, return_sequences=True)
+    out, _ = apply_layer(layer, x)
+    assert out.shape == (2, 3, 4, 5, 6, 3)
+
+    layer = ConvLSTM3D(3, 2, return_sequences=False, subsample=(2, 2, 2))
+    out, _ = apply_layer(layer, x)
+    assert out.shape == (2, 2, 3, 3, 3)
+
+
+def test_spatial_dropout3d():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SpatialDropout3D
+
+    x = np.ones((2, 3, 4, 5, 6), dtype=np.float32)
+    layer = SpatialDropout3D(0.5)
+    out, _ = apply_layer(layer, x, rng=jax.random.PRNGKey(3), training=True)
+    out = np.asarray(out)
+    # each (sample, channel) map is uniformly kept (scaled) or dropped
+    per_map = out.reshape(2, -1, 6)
+    for s in range(2):
+        for c in range(6):
+            vals = np.unique(per_map[s, :, c])
+            assert len(vals) == 1 and vals[0] in (0.0, 2.0)
+
+
+def test_word_embedding_robust_parsing(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras.layers import WordEmbedding
+
+    f = tmp_path / "vecs.txt"
+    # word2vec header + multi-token word + normal lines
+    f.write_text(
+        "3 3\n. . . 0.9 0.8 0.7\nhello 0.1 0.2 0.3\nworld 1.0 -1.0 0.5\n"
+    )
+    vectors, dim = WordEmbedding._load_vectors(str(f))
+    assert dim == 3
+    assert set(vectors) == {". . .", "hello", "world"}
+    np.testing.assert_allclose(vectors[". . ."], [0.9, 0.8, 0.7])
+
+
+def test_sparse_dense_backward_window():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseDense
+
+    x = rng0.normal(size=(2, 6)).astype(np.float32)
+    layer = SparseDense(3, backward_start=2, backward_length=3)
+    layer.ensure_built((6,))
+    params = layer.init_params(jax.random.PRNGKey(0))
+
+    def loss(xx):
+        return jnp.sum(layer.call(params, xx) ** 2)
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+    # grads only inside 1-based window [2, 4] -> 0-based cols 1..3
+    assert np.all(g[:, [0, 4, 5]] == 0)
+    assert np.any(g[:, 1:4] != 0)
+
+    # COO path: same window masking on values
+    indices = np.asarray([[0, 0], [0, 2], [1, 3], [1, 5]], dtype=np.int32)
+    values = np.asarray([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+
+    def loss_coo(v):
+        return jnp.sum(
+            layer.call(params, (jnp.asarray(indices), v, (2, 6))) ** 2
+        )
+
+    gv = np.asarray(jax.grad(loss_coo)(jnp.asarray(values)))
+    assert gv[0] == 0 and gv[3] == 0
+    assert gv[1] != 0 and gv[2] != 0
+
+
+def test_word_embedding_dim_inference_poison_resistant(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras.layers import WordEmbedding
+
+    f = tmp_path / "poison.txt"
+    # first data line's word ends in a float-parseable token ("win 7"),
+    # inflating its float-suffix length; dim must still come out as 3
+    lines = ["win 7 0.1 0.2 0.3"]
+    lines += [f"w{i} {i}.0 {i}.5 {i}.25" for i in range(12)]
+    f.write_text("\n".join(lines) + "\n")
+    vectors, dim = WordEmbedding._load_vectors(str(f))
+    assert dim == 3
+    assert "win 7" in vectors and len(vectors) == 13
+    np.testing.assert_allclose(vectors["win 7"], [0.1, 0.2, 0.3])
+    # parse cache: same (path, mtime) returns the identical object
+    again, _ = WordEmbedding._load_vectors(str(f))
+    assert again is vectors
+
+
+def test_sparse_dense_traced_dense_shape_raises():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseDense
+
+    layer = SparseDense(3)
+    layer.ensure_built((6,))
+    params = layer.init_params(jax.random.PRNGKey(0))
+    indices = jnp.asarray([[0, 0]], dtype=jnp.int32)
+    values = jnp.asarray([1.0], dtype=jnp.float32)
+
+    @jax.jit
+    def f(shape_arr):
+        return layer.call(params, (indices, values, shape_arr))
+
+    with pytest.raises(TypeError, match="static"):
+        f(jnp.asarray([2, 6]))
