@@ -490,6 +490,8 @@ class ShareConvolution2D(_ConvND):
         self.pad_h = int(pad_h)
         self.pad_w = int(pad_w)
         self.propagate_back = bool(propagate_back)
+        self._config.update(pad_h=self.pad_h, pad_w=self.pad_w,
+                            propagate_back=self.propagate_back)
 
     def call(self, params, inputs, state=None, training=False, rng=None):
         if self.pad_h or self.pad_w:

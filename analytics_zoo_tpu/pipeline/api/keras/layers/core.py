@@ -357,6 +357,12 @@ class SparseDense(Layer):
         self.init = init
         self.backward_start = int(backward_start)
         self.backward_length = int(backward_length)
+        if self.backward_start not in (-1,) and self.backward_start < 1:
+            raise ValueError(
+                "backward_start is 1-based (like the reference "
+                "SparseLinear): use -1 to disable or a value >= 1, got "
+                f"{backward_start}"
+            )
         self._config = dict(output_dim=output_dim, bias=bias,
                             backward_start=backward_start,
                             backward_length=backward_length)

@@ -92,9 +92,11 @@ class WordEmbedding(Embedding):
     1-based word ids with a zero row.
     """
 
-    # parse cache keyed by (path, mtime) so get_word_index() followed by
-    # the constructor reads a multi-GB GloVe file once, not twice
+    # single-entry parse cache keyed by (path, mtime) so get_word_index()
+    # followed by the constructor reads a multi-GB GloVe file once, not
+    # twice — size 1 keeps retention bounded
     _vector_cache: dict = {}
+    _VECTOR_CACHE_SIZE = 1
 
     def __init__(self, embedding_file, word_index=None, trainable=False,
                  input_length=None, input_shape=None, name=None, **kwargs):
@@ -191,7 +193,10 @@ class WordEmbedding(Embedding):
         if dim is None:
             raise ValueError(f"no vectors found in {path}")
         if key is not None:
-            WordEmbedding._vector_cache[key] = (vectors, dim)
+            cache = WordEmbedding._vector_cache
+            while len(cache) >= WordEmbedding._VECTOR_CACHE_SIZE:
+                cache.pop(next(iter(cache)))
+            cache[key] = (vectors, dim)
         return vectors, dim
 
     @staticmethod

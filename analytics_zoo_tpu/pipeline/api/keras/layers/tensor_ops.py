@@ -416,12 +416,14 @@ class LRN2D(Layer):
 
     def call(self, params, inputs, state=None, training=False, rng=None):
         sq = jnp.square(inputs)
-        half = self.n // 2
+        # window for channel i spans [i-(n-1)//2, i+n//2], the caffe/BigDL
+        # convention (differs from torch for even n)
+        lo = (self.n - 1) // 2
         window = jax.lax.reduce_window(
             sq, 0.0, jax.lax.add,
             window_dimensions=(1, 1, 1, self.n),
             window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)),
+            padding=((0, 0), (0, 0), (0, 0), (lo, self.n - 1 - lo)),
         )
         return inputs / jnp.power(self.k + self.alpha / self.n * window,
                                   self.beta)
@@ -439,7 +441,8 @@ class ResizeBilinear(Layer):
         self.out_w = int(output_width)
         self.align_corners = bool(align_corners)
         self._config = dict(output_height=output_height,
-                            output_width=output_width)
+                            output_width=output_width,
+                            align_corners=self.align_corners)
 
     def call(self, params, inputs, state=None, training=False, rng=None):
         b, _, _, c = inputs.shape
@@ -460,9 +463,10 @@ class ResizeBilinear(Layer):
         x1 = jnp.minimum(x0 + 1, w - 1)
         wy = (ys - y0)[None, :, None, None]
         wx = (xs - x0)[None, None, :, None]
-        g = inputs
-        top = g[:, y0][:, :, x0] * (1 - wx) + g[:, y0][:, :, x1] * wx
-        bot = g[:, y1][:, :, x0] * (1 - wx) + g[:, y1][:, :, x1] * wx
+        gy0 = inputs[:, y0]
+        gy1 = inputs[:, y1]
+        top = gy0[:, :, x0] * (1 - wx) + gy0[:, :, x1] * wx
+        bot = gy1[:, :, x0] * (1 - wx) + gy1[:, :, x1] * wx
         return top * (1 - wy) + bot * wy
 
     def compute_output_shape(self, input_shape):

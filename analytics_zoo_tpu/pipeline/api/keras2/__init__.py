@@ -1,0 +1,12 @@
+"""Keras-2-style API variant (reference pipeline/api/keras2 — Scala
+keras2/layers/*.scala and pyzoo/zoo/pipeline/api/keras2).
+
+Models/topology are shared with the Keras-1 engine; only the layer
+constructor surface differs.
+"""
+
+from analytics_zoo_tpu.pipeline.api.keras.topology import (  # noqa: F401
+    Model,
+    Sequential,
+)
+from analytics_zoo_tpu.pipeline.api.keras2 import layers  # noqa: F401

@@ -1,0 +1,68 @@
+"""Unified model import surface.
+
+Reference: pipeline/api/Net.scala:90-263 (``Net.load`` /``loadBigDL``/
+``loadTorch``/``loadCaffe``/``loadTF``) plus the pipeline/api/net package
+(TFNet/TorchNet).  One ``Net`` facade dispatching to the per-format
+loaders; heavy backends import lazily and raise a clear error when their
+runtime is unavailable.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.net.torch_net import (  # noqa: F401
+    TorchCriterion,
+    TorchNet,
+    import_state_dict,
+)
+from analytics_zoo_tpu.pipeline.api.net.tf_net import TFNet  # noqa: F401
+
+
+class Net:
+    """Reference Net.scala:90-263 — static loaders per serialized format."""
+
+    @staticmethod
+    def load(path):
+        """Load a model saved by this framework (``KerasNet.save``;
+        reference ``Net.load`` for the zoo/BigDL format)."""
+        from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+        return KerasNet.load(path)
+
+    # the reference's loadBigDL is its own-format loader; ours is load()
+    load_bigdl = load
+
+    @staticmethod
+    def load_torch(path, **kwargs):
+        """TorchScript archive → :class:`TorchNet` (reference
+        ``Net.loadTorch`` Net.scala:~150)."""
+        return TorchNet.load(path, **kwargs)
+
+    @staticmethod
+    def load_tf(path, input_name=None, output_name=None, **kwargs):
+        """Frozen GraphDef or SavedModel dir → :class:`TFNet` (reference
+        ``Net.loadTF`` Net.scala:~170)."""
+        import os
+
+        if os.path.isdir(path):
+            return TFNet.from_saved_model(path, **kwargs)
+        if input_name is None or output_name is None:
+            raise ValueError(
+                "loading a frozen GraphDef requires input_name/output_name"
+            )
+        return TFNet.from_frozen(path, input_name, output_name, **kwargs)
+
+    @staticmethod
+    def load_onnx(path_or_bytes):
+        """ONNX model → zoo keras graph (reference
+        pyzoo/zoo/pipeline/api/onnx loader)."""
+        from analytics_zoo_tpu.pipeline.api.onnx import load_onnx
+
+        return load_onnx(path_or_bytes)
+
+    @staticmethod
+    def load_caffe(def_path, model_path=None):
+        """Caffe prototxt (+ optional caffemodel weights) → zoo keras graph
+        (reference ``Net.loadCaffe`` → models/caffe CaffeLoader.scala)."""
+        from analytics_zoo_tpu.models.caffe import load_caffe
+
+        return load_caffe(def_path, model_path)

@@ -1,0 +1,154 @@
+"""TFNet — run TensorFlow graphs inside the TPU framework.
+
+Reference: pipeline/api/net/TFNet.scala:53-250 (frozen GraphDef executed
+through libtensorflow JNI as a BigDL module; forward feeds inputs+weights
+:173-250, backward runs a TF-generated gradient subgraph :278) and
+TFNetForInference.scala (SavedModel variant).
+
+TPU re-design: models should be jax-native (SURVEY.md §2.1 marks TFNet
+"capability covered by jax.jit"), so TFNet exists as the compatibility
+escape hatch: the TF function runs on the host CPU via
+``jax.pure_callback`` wrapped in ``jax.custom_vjp`` (input gradients via
+``tf.GradientTape``, the role of the reference's gradient subgraph).
+Gated on the ``tensorflow`` import.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _tf():
+    try:
+        import tensorflow as tf
+    except Exception as e:  # pragma: no cover
+        raise ImportError(
+            "TFNet requires tensorflow, which is not available in this "
+            "environment"
+        ) from e
+    return tf
+
+
+class TFNet(Layer):
+    """A frozen TF computation as a zoo Layer.
+
+    Construct with any callable ``tf_fn(tf.Tensor) -> tf.Tensor`` (e.g. a
+    ``tf.function`` concrete function); classmethods cover the reference's
+    load paths: ``from_frozen`` (GraphDef .pb ≈ TFNet.scala:53) and
+    ``from_saved_model`` (≈ TFNetForInference.scala).
+    """
+
+    def __init__(self, tf_fn, output_shape=None, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.tf_fn = tf_fn
+        self._out_shape = tuple(output_shape) if output_shape else None
+
+    @classmethod
+    def from_frozen(cls, graph_def_path, input_name, output_name, **kwargs):
+        """Load a frozen GraphDef ``.pb`` (reference TFNet(path) with
+        input/output names, TFNet.scala:427-452)."""
+        tf = _tf()
+        gd = tf.compat.v1.GraphDef()
+        with open(graph_def_path, "rb") as f:
+            gd.ParseFromString(f.read())
+
+        def imported(*args):
+            return tf.compat.v1.import_graph_def(
+                gd, input_map={input_name: args[0]},
+                return_elements=[output_name],
+            )[0]
+
+        wrapped = tf.compat.v1.wrap_function(
+            imported,
+            [tf.TensorSpec(None, tf.float32)],
+        )
+        return cls(wrapped, **kwargs)
+
+    @classmethod
+    def from_saved_model(cls, export_dir, signature="serving_default",
+                         **kwargs):
+        """Load a SavedModel (reference TFNetForInference.scala)."""
+        tf = _tf()
+        sm = tf.saved_model.load(export_dir)
+        fn = sm.signatures[signature]
+
+        def call_fn(x):
+            out = fn(x)
+            if isinstance(out, dict):
+                out = next(iter(out.values()))
+            return out
+
+        net = cls(call_fn, **kwargs)
+        net._saved_model = sm  # keep variables alive
+        return net
+
+    @classmethod
+    def from_keras(cls, keras_model, **kwargs):
+        """Wrap a live tf.keras model (reference TFNet.fromKeras)."""
+        return cls(lambda x: keras_model(x, training=False), **kwargs)
+
+    def _infer_out_shape(self, input_shape):
+        if self._out_shape is None:
+            tf = _tf()
+            x = tf.zeros((1,) + tuple(int(s) for s in input_shape),
+                         tf.float32)
+            y = self.tf_fn(x)
+            self._out_shape = tuple(int(s) for s in y.shape[1:])
+        return self._out_shape
+
+    def build(self, input_shape):
+        self._infer_out_shape(input_shape)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._infer_out_shape(input_shape[1:])
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        tf = _tf()
+        out_shape = self._infer_out_shape(inputs.shape[1:])
+        tf_fn = self.tf_fn
+
+        @jax.custom_vjp
+        def tf_apply(x):
+            def host(xh):
+                return np.asarray(
+                    tf_fn(tf.convert_to_tensor(np.ascontiguousarray(xh)))
+                )
+
+            return jax.pure_callback(
+                host,
+                jax.ShapeDtypeStruct((x.shape[0],) + out_shape, x.dtype),
+                x,
+            )
+
+        def fwd(x):
+            return tf_apply(x), x
+
+        def bwd(x, g):
+            def host(xh, gh):
+                xt = tf.convert_to_tensor(np.ascontiguousarray(xh))
+                with tf.GradientTape() as tape:
+                    tape.watch(xt)
+                    y = tf_fn(xt)
+                gx = tape.gradient(
+                    y, xt,
+                    output_gradients=tf.convert_to_tensor(
+                        np.ascontiguousarray(gh)
+                    ),
+                )
+                if gx is None:  # no gradient path (reference zeros
+                    #                gradInput when no backward meta,
+                    #                TFNet.scala:278)
+                    return np.zeros_like(xh)
+                return np.asarray(gx)
+
+            gx = jax.pure_callback(
+                host, jax.ShapeDtypeStruct(x.shape, x.dtype), x, g
+            )
+            return (gx,)
+
+        tf_apply.defvjp(fwd, bwd)
+        return tf_apply(inputs)

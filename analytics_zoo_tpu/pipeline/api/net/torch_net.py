@@ -1,0 +1,221 @@
+"""TorchNet / TorchCriterion — run PyTorch modules inside the TPU graph.
+
+Reference: pipeline/api/net/TorchNet.scala:39-156 and TorchCriterion.scala
+(TorchScript modules executed through libtorch JNI as BigDL modules;
+python wrappers pyzoo/zoo/pipeline/api/net/torch_net.py /
+torch_criterion.py trace an nn.Module and ship the bytes to the JVM).
+
+TPU re-design: there is no JNI sandwich — the torch module runs on the
+*host* CPU through ``jax.pure_callback``, wrapped in ``jax.custom_vjp`` so
+``jax.grad`` through it triggers torch autograd on the host.  This is an
+escape hatch for odd third-party models, exactly like the reference's
+TorchNet (which also ran torch on CPU inside each executor); the idiomatic
+path for production models is :func:`import_state_dict` — copy the weights
+into native jax layers so the whole step stays on the TPU.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _to_torch(x):
+    import torch
+
+    return torch.from_numpy(np.ascontiguousarray(x))
+
+
+class TorchNet(Layer):
+    """A frozen torch ``nn.Module`` as a zoo Layer (reference
+    TorchNet.scala:39-156; one-model-per-executor special casing at
+    Topology.scala:1101-1110 is unnecessary here — the callback is
+    process-local).
+
+    The module's parameters are captured at construction and are NOT
+    trainable from the jax side (matching the reference, whose TorchNet
+    exposes no gradWeight back to BigDL's all-reduce); the input gradient
+    IS computed (via torch autograd), so a TorchNet can sit mid-graph.
+    """
+
+    def __init__(self, module, output_shape=None, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        import torch
+
+        self.module = module.eval()
+        for p in self.module.parameters():
+            p.requires_grad_(False)
+        self._out_shape = output_shape  # per-sample shape, no batch dim
+        self._torch = torch
+
+    # -- constructors matching the reference surface -----------------------
+    @classmethod
+    def from_pytorch(cls, module, input_shape=None, **kwargs):
+        """Reference torch_net.py ``TorchNet.from_pytorch(module, ...)``."""
+        return cls(module, input_shape=input_shape, **kwargs)
+
+    @classmethod
+    def load(cls, path, **kwargs):
+        """Load a TorchScript archive saved with ``torch.jit.save``
+        (reference TorchNet.scala loads TorchScript bytes)."""
+        import torch
+
+        return cls(torch.jit.load(path, map_location="cpu"), **kwargs)
+
+    def save(self, path):
+        import torch
+
+        mod = self.module
+        if not isinstance(mod, torch.jit.ScriptModule):
+            mod = torch.jit.script(mod)
+        torch.jit.save(mod, path)
+
+    # -- shape inference ---------------------------------------------------
+    def _infer_out_shape(self, input_shape):
+        if self._out_shape is not None:
+            return tuple(self._out_shape)
+        x = self._torch.zeros((1,) + tuple(int(s) for s in input_shape))
+        with self._torch.no_grad():
+            y = self.module(x)
+        self._out_shape = tuple(y.shape[1:])
+        return self._out_shape
+
+    def build(self, input_shape):
+        self._infer_out_shape(input_shape)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._infer_out_shape(input_shape[1:])
+
+    # -- execution ---------------------------------------------------------
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        out_shape = self._infer_out_shape(inputs.shape[1:])
+        module, torch = self.module, self._torch
+
+        @jax.custom_vjp
+        def torch_apply(x):
+            def fwd_host(xh):
+                with torch.no_grad():
+                    return module(_to_torch(xh)).numpy()
+
+            return jax.pure_callback(
+                fwd_host,
+                jax.ShapeDtypeStruct((x.shape[0],) + out_shape, x.dtype),
+                x,
+            )
+
+        def torch_fwd(x):
+            return torch_apply(x), x
+
+        def torch_bwd(x, g):
+            def bwd_host(xh, gh):
+                xt = _to_torch(xh).requires_grad_(True)
+                y = module(xt)
+                y.backward(_to_torch(gh))
+                return xt.grad.numpy()
+
+            gx = jax.pure_callback(
+                bwd_host, jax.ShapeDtypeStruct(x.shape, x.dtype), x, g
+            )
+            return (gx,)
+
+        torch_apply.defvjp(torch_fwd, torch_bwd)
+        return torch_apply(inputs)
+
+
+class TorchCriterion(Layer):
+    """A torch loss as a zoo objective (reference TorchCriterion.scala;
+    python wrapper torch_criterion.py traces ``loss_fn(input, label)``).
+
+    Callable as ``crit(y_true, y_pred)`` returning per-sample losses, so it
+    plugs into ``compile(loss=TorchCriterion.from_pytorch(...))``.
+    """
+
+    def __init__(self, loss_fn, name=None):
+        super().__init__(name=name)
+        import torch
+
+        self.loss_fn = loss_fn
+        self._torch = torch
+
+    @classmethod
+    def from_pytorch(cls, loss_fn, **kwargs):
+        return cls(loss_fn, **kwargs)
+
+    def __call__(self, y_true, y_pred):  # objective protocol
+        loss_fn, torch = self.loss_fn, self._torch
+
+        @jax.custom_vjp
+        def crit(pred, true):
+            def host(ph, th):
+                with torch.no_grad():
+                    val = loss_fn(_to_torch(ph), _to_torch(th))
+                return np.asarray(val.numpy(), dtype=ph.dtype).reshape(())
+
+            return jax.pure_callback(
+                host, jax.ShapeDtypeStruct((), pred.dtype), pred, true
+            )
+
+        def fwd(pred, true):
+            return crit(pred, true), (pred, true)
+
+        def bwd(res, g):
+            pred, true = res
+
+            def host(ph, th, gh):
+                pt = _to_torch(ph).requires_grad_(True)
+                val = loss_fn(pt, _to_torch(th))
+                val.backward()
+                return (pt.grad * float(gh)).numpy()
+
+            gp = jax.pure_callback(
+                host, jax.ShapeDtypeStruct(pred.shape, pred.dtype),
+                pred, true, g,
+            )
+            return (gp, jnp.zeros_like(true))
+
+        crit.defvjp(fwd, bwd)
+        return crit(y_pred, y_true)
+
+    def mean(self, y_true, y_pred, sample_weight=None):
+        """Objective protocol used by the Estimator train step; torch
+        criterions already reduce to a scalar mean."""
+        del sample_weight
+        return self.__call__(y_true, y_pred)
+
+
+def import_state_dict(model, state_dict, mapping):
+    """Copy torch ``state_dict`` tensors into a zoo model's params pytree —
+    the idiomatic TPU path for reusing pretrained torch weights (the
+    capability TorchNet.scala provides by running torch itself).
+
+    ``mapping``: list of ``(zoo_path, torch_key, transform)`` where
+    ``zoo_path`` is a ``"layer/weight"`` key into the params dict and
+    ``transform`` (optional) maps the numpy array (e.g. transpose
+    OIHW→HWIO).  Returns the updated params.
+    """
+    params, _ = model.build_params()
+    flat = dict(params)
+    for entry in mapping:
+        zoo_path, torch_key = entry[0], entry[1]
+        transform = entry[2] if len(entry) > 2 else None
+        arr = state_dict[torch_key].detach().cpu().numpy()
+        if transform is not None:
+            arr = transform(arr)
+        node = flat
+        *parents, leaf = zoo_path.split("/")
+        for p in parents:
+            node = node[p]
+        if node[leaf].shape != arr.shape:
+            raise ValueError(
+                f"{zoo_path}: shape {node[leaf].shape} != torch "
+                f"{torch_key} {arr.shape}"
+            )
+        node[leaf] = jnp.asarray(arr)
+    model.params = params
+    return params

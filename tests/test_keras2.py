@@ -1,0 +1,90 @@
+"""keras2 API variant tests (reference pyzoo/test/zoo keras2 suite —
+run-pytests-keras2): the Keras-2-named adapters must match their Keras-1
+implementations and train end-to-end."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from analytics_zoo_tpu.pipeline.api.keras2 import Sequential, layers as k2
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+
+
+rng0 = np.random.default_rng(0)
+
+
+def _run(layer, x):
+    layer.ensure_built(tuple(x.shape)[1:])
+    params = layer.init_params(jax.random.PRNGKey(0))
+    out, _ = layer.apply(params, x)
+    return np.asarray(out), params
+
+
+def test_dense_matches_keras1():
+    x = rng0.normal(size=(4, 6)).astype(np.float32)
+    out2, p2 = _run(k2.Dense(3, activation="relu"), x)
+    l1 = k1.Dense(3, activation="relu")
+    l1.ensure_built((6,))
+    out1, _ = l1.apply(p2, x)
+    np.testing.assert_allclose(out2, np.asarray(out1), atol=1e-6)
+
+
+def test_conv2d_args_translate():
+    x = rng0.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    layer = k2.Conv2D(4, 3, strides=(2, 2), padding="same",
+                      use_bias=False)
+    out, params = _run(layer, x)
+    assert out.shape == (2, 4, 4, 4)
+    assert "bias" not in params
+
+    with pytest.raises(ValueError, match="channels-last"):
+        k2.Conv2D(4, 3, data_format="channels_first")
+
+
+def test_pooling_and_dropout_names():
+    x = rng0.normal(size=(2, 10, 5)).astype(np.float32)
+    out, _ = _run(k2.MaxPooling1D(pool_size=2, strides=2), x)
+    assert out.shape == (2, 5, 5)
+    out, _ = _run(k2.AveragePooling1D(pool_size=5, strides=5), x)
+    assert out.shape == (2, 2, 5)
+    out, _ = _run(k2.GlobalAveragePooling1D(), x)
+    assert out.shape == (2, 5)
+
+    d = k2.Dropout(rate=0.3)
+    assert d.p == pytest.approx(0.3)
+
+
+def test_functional_merges():
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    out = k2.maximum([a, b])
+    from analytics_zoo_tpu.pipeline.api.keras2 import Model
+
+    m = Model([a, b], out)
+    xa = rng0.normal(size=(3, 4)).astype(np.float32)
+    xb = rng0.normal(size=(3, 4)).astype(np.float32)
+    pred = np.asarray(m.predict([xa, xb], batch_size=3))
+    np.testing.assert_allclose(pred, np.maximum(xa, xb), atol=1e-6)
+
+    out = k2.average([a, b])
+    m = Model([a, b], out)
+    pred = np.asarray(m.predict([xa, xb], batch_size=3))
+    np.testing.assert_allclose(pred, (xa + xb) / 2, atol=1e-6)
+
+
+def test_keras2_sequential_trains():
+    x = rng0.normal(size=(64, 8)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int64)
+
+    m = Sequential()
+    m.add(k2.Dense(16, activation="relu", input_shape=(8,)))
+    m.add(k2.Dropout(0.1))
+    m.add(k2.Dense(2))
+    m.add(k2.Softmax())
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=32, nb_epoch=60)
+    res = m.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.8, res

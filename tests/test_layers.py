@@ -12,7 +12,10 @@ import jax.numpy as jnp
 def apply_layer(layer, x, params=None, rng=None, training=False):
     layer.ensure_built(tuple(np.shape(x))[1:])
     if params is None:
-        params = layer.init_params(rng or jax.random.PRNGKey(0))
+        # PRNG keys are arrays — `rng or default` truthiness would raise
+        params = layer.init_params(
+            rng if rng is not None else jax.random.PRNGKey(0)
+        )
     state = layer.init_state()
     out, _ = layer.apply(params, jnp.asarray(x), state=state or None,
                          training=training, rng=rng)

@@ -1,0 +1,181 @@
+"""TorchNet / TFNet / Net facade tests (reference pyzoo test suites for
+torch_net and tfnet; SURVEY.md §2.1 TFNet/TorchNet rows)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+rng0 = np.random.default_rng(0)
+
+
+def test_torchnet_forward_matches_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    mod = torch.nn.Sequential(
+        torch.nn.Linear(6, 4), torch.nn.ReLU(), torch.nn.Linear(4, 3)
+    )
+    net = TorchNet.from_pytorch(mod, input_shape=(6,))
+    x = rng0.normal(size=(5, 6)).astype(np.float32)
+
+    net.ensure_built((6,))
+    out, _ = net.apply({}, jnp.asarray(x))
+    with torch.no_grad():
+        ref = mod(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+    assert net.compute_output_shape((5, 6)) == (5, 3)
+
+
+def test_torchnet_input_gradient():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    mod = torch.nn.Linear(4, 2)
+    net = TorchNet.from_pytorch(mod, input_shape=(4,))
+    net.ensure_built((4,))
+    x = rng0.normal(size=(3, 4)).astype(np.float32)
+
+    def f(xx):
+        return jnp.sum(net.call({}, xx) ** 2)
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    (mod(xt) ** 2).sum().backward()
+    np.testing.assert_allclose(g, xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_torchnet_in_sequential_predict():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    mod = torch.nn.Linear(5, 4)
+    m = Sequential()
+    m.add(TorchNet.from_pytorch(mod, input_shape=(5,)))
+    m.add(Dense(2))
+    x = rng0.normal(size=(8, 5)).astype(np.float32)
+    out = np.asarray(m.predict(x, batch_size=8))
+    assert out.shape == (8, 2)
+
+
+def test_torchnet_save_load(tmp_path):
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import Net, TorchNet
+
+    mod = torch.nn.Linear(3, 2)
+    net = TorchNet.from_pytorch(mod, input_shape=(3,))
+    p = str(tmp_path / "m.pt")
+    net.save(p)
+
+    net2 = Net.load_torch(p, input_shape=(3,))
+    x = rng0.normal(size=(2, 3)).astype(np.float32)
+    net.ensure_built((3,))
+    net2.ensure_built((3,))
+    a, _ = net.apply({}, jnp.asarray(x))
+    b, _ = net2.apply({}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_torch_criterion_trains_direction():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchCriterion
+
+    crit = TorchCriterion.from_pytorch(torch.nn.MSELoss())
+    y_true = jnp.asarray(rng0.normal(size=(4, 3)).astype(np.float32))
+    y_pred = jnp.asarray(rng0.normal(size=(4, 3)).astype(np.float32))
+
+    val = crit(y_true, y_pred)
+    ref = float(np.mean((np.asarray(y_true) - np.asarray(y_pred)) ** 2))
+    assert float(val) == pytest.approx(ref, rel=1e-5)
+
+    g = jax.grad(lambda p: crit(y_true, p))(y_pred)
+    ref_g = 2.0 / y_pred.size * (np.asarray(y_pred) - np.asarray(y_true))
+    np.testing.assert_allclose(np.asarray(g), ref_g, rtol=1e-4, atol=1e-6)
+
+
+def test_import_state_dict():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.net import import_state_dict
+
+    mod = torch.nn.Linear(4, 3)
+    m = Sequential()
+    m.add(Dense(3, input_shape=(4,)))
+    m.build_params()
+    (dense_name,) = list(m.params)
+
+    import_state_dict(
+        m, mod.state_dict(),
+        [(f"{dense_name}/kernel", "weight", lambda a: a.T),
+         (f"{dense_name}/bias", "bias", None)],
+    )
+    x = rng0.normal(size=(2, 4)).astype(np.float32)
+    out = np.asarray(m.predict(x, batch_size=2))
+    with torch.no_grad():
+        ref = mod(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tf():
+    return pytest.importorskip("tensorflow")
+
+
+def test_tfnet_from_keras_and_gradient(tf):
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+
+    km = tf.keras.Sequential([
+        tf.keras.layers.Dense(4, activation="relu"),
+        tf.keras.layers.Dense(2),
+    ])
+    km.build((None, 6))
+    net = TFNet.from_keras(km, input_shape=(6,))
+    net.ensure_built((6,))
+
+    x = rng0.normal(size=(3, 6)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    ref = km(x, training=False).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    g = np.asarray(jax.grad(
+        lambda xx: jnp.sum(net.call({}, xx) ** 2)
+    )(jnp.asarray(x)))
+    xt = tf.convert_to_tensor(x)
+    with tf.GradientTape() as tape:
+        tape.watch(xt)
+        y = tf.reduce_sum(km(xt) ** 2)
+    ref_g = tape.gradient(y, xt).numpy()
+    np.testing.assert_allclose(g, ref_g, rtol=1e-4, atol=1e-5)
+
+
+def test_tfnet_saved_model_roundtrip(tf, tmp_path):
+    from analytics_zoo_tpu.pipeline.api.net import Net
+
+    km = tf.keras.Sequential([tf.keras.layers.Dense(3)])
+    km.build((None, 5))
+    d = str(tmp_path / "sm")
+
+    @tf.function(input_signature=[tf.TensorSpec([None, 5], tf.float32)])
+    def serve(x):
+        return km(x)
+
+    tf.saved_model.save(km, d, signatures=serve)
+
+    net = Net.load_tf(d, input_shape=(5,))
+    net.ensure_built((5,))
+    x = rng0.normal(size=(2, 5)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), km(x).numpy(),
+                               rtol=1e-5, atol=1e-5)

@@ -8,17 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-def apply_layer(layer, x, params=None, rng=None, training=False):
-    """Like tests.test_layers.apply_layer but rng-safe (PRNG keys are
-    arrays, so no `rng or default` truthiness)."""
-    layer.ensure_built(tuple(np.shape(x))[1:])
-    if params is None:
-        params = layer.init_params(jax.random.PRNGKey(0))
-    state = layer.init_state()
-    out, _ = layer.apply(params, jnp.asarray(x), state=state or None,
-                         training=training, rng=rng)
-    return np.asarray(out), params
-
+from tests.test_layers import apply_layer  # noqa: E402
 
 rng0 = np.random.default_rng(0)
 
@@ -434,3 +424,39 @@ def test_sparse_dense_traced_dense_shape_raises():
 
     with pytest.raises(TypeError, match="static"):
         f(jnp.asarray([2, 6]))
+
+
+def test_lrn2d_even_n_caffe_window():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LRN2D
+
+    x = rng0.normal(size=(1, 2, 2, 6)).astype(np.float32)
+    layer = LRN2D(alpha=0.1, k=1.0, beta=0.5, n=4)
+    out, _ = apply_layer(layer, x)
+
+    # caffe/BigDL convention: window for channel i is [i-(n-1)//2, i+n//2]
+    ref = np.empty_like(x)
+    for c in range(6):
+        lo, hi = max(0, c - 1), min(6, c + 3)
+        s = (x[..., lo:hi] ** 2).sum(-1)
+        ref[..., c] = x[..., c] / (1.0 + 0.1 / 4 * s) ** 0.5
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_dense_rejects_zero_backward_start():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseDense
+
+    with pytest.raises(ValueError, match="1-based"):
+        SparseDense(3, backward_start=0)
+
+
+def test_config_roundtrip_args_recorded():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        ResizeBilinear, ShareConvolution2D,
+    )
+
+    cfg = ResizeBilinear(11, 5, align_corners=True).get_config()
+    assert cfg["align_corners"] is True
+    cfg = ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=2,
+                             propagate_back=False).get_config()
+    assert cfg["pad_h"] == 1 and cfg["pad_w"] == 2
+    assert cfg["propagate_back"] is False
