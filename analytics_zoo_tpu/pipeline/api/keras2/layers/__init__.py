@@ -27,6 +27,14 @@ def _check_channels_last(data_format):
         )
 
 
+def _check_zero_bias(bias_initializer):
+    if bias_initializer not in (None, "zero", "zeros"):
+        raise ValueError(
+            "only zero bias initialization is supported (the keras-1 "
+            f"implementation zero-inits bias); got {bias_initializer!r}"
+        )
+
+
 class Dense(k1.Dense):
     """keras2 Dense: ``units``/``use_bias``/``kernel_initializer``
     (reference keras2/layers/Dense.scala)."""
@@ -35,7 +43,7 @@ class Dense(k1.Dense):
                  kernel_initializer="glorot_uniform",
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
-        del bias_initializer  # keras-1 impl zero-inits bias
+        _check_zero_bias(bias_initializer)
         super().__init__(units, init=kernel_initializer,
                          activation=activation, bias=use_bias,
                          input_shape=input_shape, name=name, **kwargs)
@@ -70,7 +78,7 @@ class Conv1D(k1.Convolution1D):
                  kernel_initializer="glorot_uniform",
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
-        del bias_initializer
+        _check_zero_bias(bias_initializer)
         super().__init__(filters, kernel_size, subsample_length=strides,
                          border_mode=padding, activation=activation,
                          bias=use_bias, init=kernel_initializer,
@@ -86,7 +94,7 @@ class Conv2D(k1.Convolution2D):
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
         _check_channels_last(data_format)
-        del bias_initializer
+        _check_zero_bias(bias_initializer)
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size, kernel_size)
         super().__init__(filters, kernel_size[0], kernel_size[1],
@@ -142,7 +150,10 @@ def _global_pool(base):
             _check_channels_last(data_format)
             super().__init__(input_shape=input_shape, name=name, **kwargs)
 
+    # both names must point at the module-level alias or pickle (used by
+    # KerasNet.save) cannot resolve the factory-local class
     _G.__name__ = base.__name__
+    _G.__qualname__ = base.__name__
     return _G
 
 

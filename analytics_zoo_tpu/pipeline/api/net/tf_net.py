@@ -45,7 +45,10 @@ class TFNet(Layer):
                  name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         self.tf_fn = tf_fn
-        self._out_shape = tuple(output_shape) if output_shape else None
+        self._fixed_out_shape = (
+            tuple(output_shape) if output_shape else None
+        )
+        self._out_shapes: dict = {}  # per-input-shape cache
 
     @classmethod
     def from_frozen(cls, graph_def_path, input_name, output_name, **kwargs):
@@ -92,13 +95,17 @@ class TFNet(Layer):
         return cls(lambda x: keras_model(x, training=False), **kwargs)
 
     def _infer_out_shape(self, input_shape):
-        if self._out_shape is None:
+        if self._fixed_out_shape is not None:
+            return self._fixed_out_shape
+        key = tuple(int(s) for s in input_shape)
+        out = self._out_shapes.get(key)
+        if out is None:  # shape-dependent graphs get a probe per shape
             tf = _tf()
-            x = tf.zeros((1,) + tuple(int(s) for s in input_shape),
-                         tf.float32)
-            y = self.tf_fn(x)
-            self._out_shape = tuple(int(s) for s in y.shape[1:])
-        return self._out_shape
+            y = self.tf_fn(tf.zeros((1,) + key, tf.float32))
+            out = self._out_shapes[key] = tuple(
+                int(s) for s in y.shape[1:]
+            )
+        return out
 
     def build(self, input_shape):
         self._infer_out_shape(input_shape)

@@ -51,7 +51,10 @@ class TorchNet(Layer):
         self.module = module.eval()
         for p in self.module.parameters():
             p.requires_grad_(False)
-        self._out_shape = output_shape  # per-sample shape, no batch dim
+        self._fixed_out_shape = (
+            tuple(output_shape) if output_shape is not None else None
+        )
+        self._out_shapes: dict = {}  # per-input-shape cache
         self._torch = torch
 
     # -- constructors matching the reference surface -----------------------
@@ -78,13 +81,17 @@ class TorchNet(Layer):
 
     # -- shape inference ---------------------------------------------------
     def _infer_out_shape(self, input_shape):
-        if self._out_shape is not None:
-            return tuple(self._out_shape)
-        x = self._torch.zeros((1,) + tuple(int(s) for s in input_shape))
-        with self._torch.no_grad():
-            y = self.module(x)
-        self._out_shape = tuple(y.shape[1:])
-        return self._out_shape
+        if self._fixed_out_shape is not None:
+            return self._fixed_out_shape
+        key = tuple(int(s) for s in input_shape)
+        out = self._out_shapes.get(key)
+        if out is None:  # shape-dependent graphs (e.g. fully-conv) get a
+            #              fresh probe per input shape
+            x = self._torch.zeros((1,) + key)
+            with self._torch.no_grad():
+                y = self.module(x)
+            out = self._out_shapes[key] = tuple(y.shape[1:])
+        return out
 
     def build(self, input_shape):
         self._infer_out_shape(input_shape)
@@ -115,8 +122,14 @@ class TorchNet(Layer):
         def torch_bwd(x, g):
             def bwd_host(xh, gh):
                 xt = _to_torch(xh).requires_grad_(True)
-                y = module(xt)
-                y.backward(_to_torch(gh))
+                try:
+                    y = module(xt)
+                    y.backward(_to_torch(gh))
+                except RuntimeError:
+                    return np.zeros_like(xh)
+                if xt.grad is None:  # no grad path to the input — zero
+                    #                  gradInput like TFNet.scala:278
+                    return np.zeros_like(xh)
                 return xt.grad.numpy()
 
             gx = jax.pure_callback(
@@ -132,8 +145,9 @@ class TorchCriterion(Layer):
     """A torch loss as a zoo objective (reference TorchCriterion.scala;
     python wrapper torch_criterion.py traces ``loss_fn(input, label)``).
 
-    Callable as ``crit(y_true, y_pred)`` returning per-sample losses, so it
-    plugs into ``compile(loss=TorchCriterion.from_pytorch(...))``.
+    Callable as ``crit(y_true, y_pred)`` returning the scalar batch loss —
+    non-reducing torch losses (``reduction='none'``) are mean-reduced on the
+    host — so it plugs into ``compile(loss=TorchCriterion.from_pytorch(...))``.
     """
 
     def __init__(self, loss_fn, name=None):
@@ -155,6 +169,8 @@ class TorchCriterion(Layer):
             def host(ph, th):
                 with torch.no_grad():
                     val = loss_fn(_to_torch(ph), _to_torch(th))
+                    if val.dim() > 0:  # reduction='none' losses
+                        val = val.mean()
                 return np.asarray(val.numpy(), dtype=ph.dtype).reshape(())
 
             return jax.pure_callback(
@@ -170,6 +186,8 @@ class TorchCriterion(Layer):
             def host(ph, th, gh):
                 pt = _to_torch(ph).requires_grad_(True)
                 val = loss_fn(pt, _to_torch(th))
+                if val.dim() > 0:
+                    val = val.mean()
                 val.backward()
                 return (pt.grad * float(gh)).numpy()
 
@@ -200,14 +218,13 @@ def import_state_dict(model, state_dict, mapping):
     OIHW→HWIO).  Returns the updated params.
     """
     params, _ = model.build_params()
-    flat = dict(params)
     for entry in mapping:
         zoo_path, torch_key = entry[0], entry[1]
         transform = entry[2] if len(entry) > 2 else None
         arr = state_dict[torch_key].detach().cpu().numpy()
         if transform is not None:
             arr = transform(arr)
-        node = flat
+        node = params
         *parents, leaf = zoo_path.split("/")
         for p in parents:
             node = node[p]

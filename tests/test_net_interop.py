@@ -179,3 +179,100 @@ def test_tfnet_saved_model_roundtrip(tf, tmp_path):
     out, _ = net.apply({}, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(out), km(x).numpy(),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_torchnet_shape_dependent_output():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    # fully-convolutional: output spatial size tracks input spatial size
+    mod = torch.nn.Conv2d(1, 2, 3, padding=1)
+
+    class NHWC(torch.nn.Module):
+        def forward(self, x):
+            return mod(x.permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+
+    net = TorchNet.from_pytorch(NHWC())
+    net.ensure_built((8, 8, 1))
+    a, _ = net.apply({}, jnp.zeros((2, 8, 8, 1), jnp.float32))
+    b, _ = net.apply({}, jnp.zeros((2, 16, 16, 1), jnp.float32))
+    assert np.asarray(a).shape == (2, 8, 8, 2)
+    assert np.asarray(b).shape == (2, 16, 16, 2)
+
+
+def test_torchnet_no_grad_path_zero_gradinput():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    class Detached(torch.nn.Module):
+        def forward(self, x):
+            return x.detach() * 2.0
+
+    net = TorchNet.from_pytorch(Detached(), input_shape=(4,))
+    net.ensure_built((4,))
+    x = jnp.ones((2, 4), jnp.float32)
+    g = jax.grad(lambda xx: jnp.sum(net.call({}, xx)))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.zeros((2, 4)))
+
+
+def test_torch_criterion_reduction_none():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchCriterion
+
+    crit = TorchCriterion.from_pytorch(
+        torch.nn.MSELoss(reduction="none")
+    )
+    y_true = jnp.asarray(rng0.normal(size=(4, 3)).astype(np.float32))
+    y_pred = jnp.asarray(rng0.normal(size=(4, 3)).astype(np.float32))
+    val = float(crit(y_true, y_pred))
+    ref = float(np.mean((np.asarray(y_true) - np.asarray(y_pred)) ** 2))
+    assert val == pytest.approx(ref, rel=1e-5)
+
+
+def test_import_state_dict_rejects_nothing_silently():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.net import import_state_dict
+
+    mod = torch.nn.Linear(4, 3)
+    m = Sequential()
+    m.add(Dense(3, input_shape=(4,)))
+    m.build_params()
+    (dense_name,) = list(m.params)
+    before = np.asarray(m.params[dense_name]["bias"]).copy()
+    import_state_dict(m, mod.state_dict(),
+                      [(f"{dense_name}/bias", "bias", None)])
+    after = np.asarray(m.params[dense_name]["bias"])
+    np.testing.assert_allclose(after, mod.bias.detach().numpy(), atol=1e-6)
+    assert not np.allclose(before, after)
+
+
+def test_keras2_global_pool_model_pickles(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras2 import Sequential, layers as k2
+
+    m = Sequential()
+    m.add(k2.Conv2D(2, 3, input_shape=(6, 6, 1)))
+    m.add(k2.GlobalAveragePooling2D())
+    x = rng0.normal(size=(2, 6, 6, 1)).astype(np.float32)
+    ref = np.asarray(m.predict(x, batch_size=2))
+
+    p = str(tmp_path / "m.zoo")
+    m.save(p)
+    from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+    m2 = KerasNet.load(p)
+    np.testing.assert_allclose(
+        np.asarray(m2.predict(x, batch_size=2)), ref, atol=1e-6
+    )
+
+
+def test_keras2_rejects_nonzero_bias_init():
+    from analytics_zoo_tpu.pipeline.api.keras2 import layers as k2
+
+    with pytest.raises(ValueError, match="zero bias"):
+        k2.Dense(4, bias_initializer="ones")
