@@ -27,8 +27,14 @@ def _check_channels_last(data_format):
         )
 
 
-def _check_zero_bias(bias_initializer):
-    if bias_initializer not in (None, "zero", "zeros"):
+def _check_zero_bias(bias_initializer, use_bias=True):
+    if not use_bias:
+        return  # no bias exists; any initializer is vacuously fine
+    if bias_initializer is None:
+        return
+    name = (bias_initializer if isinstance(bias_initializer, str)
+            else type(bias_initializer).__name__)
+    if name.lower() not in ("zero", "zeros"):
         raise ValueError(
             "only zero bias initialization is supported (the keras-1 "
             f"implementation zero-inits bias); got {bias_initializer!r}"
@@ -43,7 +49,7 @@ class Dense(k1.Dense):
                  kernel_initializer="glorot_uniform",
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
-        _check_zero_bias(bias_initializer)
+        _check_zero_bias(bias_initializer, use_bias)
         super().__init__(units, init=kernel_initializer,
                          activation=activation, bias=use_bias,
                          input_shape=input_shape, name=name, **kwargs)
@@ -78,7 +84,7 @@ class Conv1D(k1.Convolution1D):
                  kernel_initializer="glorot_uniform",
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
-        _check_zero_bias(bias_initializer)
+        _check_zero_bias(bias_initializer, use_bias)
         super().__init__(filters, kernel_size, subsample_length=strides,
                          border_mode=padding, activation=activation,
                          bias=use_bias, init=kernel_initializer,
@@ -94,7 +100,7 @@ class Conv2D(k1.Convolution2D):
                  bias_initializer="zero", input_shape=None, name=None,
                  **kwargs):
         _check_channels_last(data_format)
-        _check_zero_bias(bias_initializer)
+        _check_zero_bias(bias_initializer, use_bias)
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size, kernel_size)
         super().__init__(filters, kernel_size[0], kernel_size[1],

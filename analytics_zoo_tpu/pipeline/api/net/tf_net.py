@@ -46,7 +46,7 @@ class TFNet(Layer):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         self.tf_fn = tf_fn
         self._fixed_out_shape = (
-            tuple(output_shape) if output_shape else None
+            tuple(output_shape) if output_shape is not None else None
         )
         self._out_shapes: dict = {}  # per-input-shape cache
 

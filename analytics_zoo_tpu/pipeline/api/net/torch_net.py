@@ -122,13 +122,13 @@ class TorchNet(Layer):
         def torch_bwd(x, g):
             def bwd_host(xh, gh):
                 xt = _to_torch(xh).requires_grad_(True)
-                try:
-                    y = module(xt)
-                    y.backward(_to_torch(gh))
-                except RuntimeError:
+                y = module(xt)
+                if not y.requires_grad:  # no grad path to the input —
+                    #   zero gradInput like TFNet.scala:278; genuine
+                    #   autograd errors still propagate
                     return np.zeros_like(xh)
-                if xt.grad is None:  # no grad path to the input — zero
-                    #                  gradInput like TFNet.scala:278
+                y.backward(_to_torch(gh))
+                if xt.grad is None:
                     return np.zeros_like(xh)
                 return xt.grad.numpy()
 
@@ -202,8 +202,14 @@ class TorchCriterion(Layer):
 
     def mean(self, y_true, y_pred, sample_weight=None):
         """Objective protocol used by the Estimator train step; torch
-        criterions already reduce to a scalar mean."""
-        del sample_weight
+        criterions reduce to a scalar on the host, so per-sample weighting
+        cannot be applied — reject it loudly rather than ignore it."""
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "TorchCriterion reduces to a scalar inside torch; "
+                "sample_weight is not supported — use a native objective "
+                "or fold the weights into the torch loss itself"
+            )
         return self.__call__(y_true, y_pred)
 
 

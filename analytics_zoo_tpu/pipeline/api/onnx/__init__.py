@@ -1,0 +1,135 @@
+"""ONNX model loader.
+
+Reference: pyzoo/zoo/pipeline/api/onnx/onnx_loader.py + mapper/*.py (44 op
+mappers building a zoo keras graph from an onnx ModelProto).
+
+TPU re-design: the graph is interpreted once at trace time into a single
+jit-compiled XLA program (:class:`OnnxNet` is an ordinary zoo Layer), with
+float initializers exposed as trainable params so imported models can be
+fine-tuned.  The protobuf is parsed by the self-contained wire codec in
+:mod:`.proto` — the ``onnx`` package is not required.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+from analytics_zoo_tpu.pipeline.api.onnx.mapper import MAPPERS
+from analytics_zoo_tpu.pipeline.api.onnx import proto
+from analytics_zoo_tpu.pipeline.api.onnx.proto import Model, decode_model
+
+
+class _Fixed:
+    """Picklable initializer returning a captured array."""
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr)
+
+    def __call__(self, rng, shape, dtype):
+        return jnp.asarray(self.arr, dtype)
+
+
+class OnnxNet(Layer):
+    """An ONNX graph as a zoo Layer (reference onnx_loader.py OnnxLoader).
+
+    Float initializers become trainable params (set ``trainable=False`` to
+    freeze them into state); integer initializers (shapes, axes, indices)
+    stay static so shape-consuming ops jit cleanly.  ONNX layouts (NCHW
+    convs) are preserved — XLA picks the TPU-internal layout itself.
+    """
+
+    def __init__(self, model: Model, trainable=True, name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.graph = model.graph
+        self.opset = model.opset
+        self.trainable = trainable
+        self._static = {"__opset__": model.opset}  # + int initializers
+        self._learn = {}    # float initializers: params/state
+        for iname, arr in self.graph.initializers.items():
+            if np.issubdtype(arr.dtype, np.floating):
+                self._learn[iname] = arr
+            else:
+                self._static[iname] = arr
+        init_names = set(self.graph.initializers)
+        self.input_names = [vi.name for vi in self.graph.inputs
+                            if vi.name not in init_names]
+        self.output_names = [vi.name for vi in self.graph.outputs]
+        unsupported = sorted({
+            n.op_type for n in self.graph.nodes
+            if n.op_type not in MAPPERS
+        })
+        if unsupported:
+            raise NotImplementedError(
+                f"ONNX ops without mappers: {unsupported} "
+                f"(supported: {sorted(MAPPERS)})"
+            )
+        # single-input graphs with a static shape drop straight into
+        # Sequential without an explicit input_shape
+        if self._input_shape is None and len(self.input_names) == 1:
+            vi = next(v for v in self.graph.inputs
+                      if v.name == self.input_names[0])
+            if vi.shape and all(d is not None for d in vi.shape[1:]):
+                self._input_shape = tuple(vi.shape[1:])
+
+    def build(self, input_shape):
+        for iname, arr in self._learn.items():
+            self.add_weight(iname, arr.shape, _Fixed(arr),
+                            trainable=self.trainable)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        assert len(xs) == len(self.input_names), (
+            f"expected inputs {self.input_names}, got {len(xs)} arrays"
+        )
+        env: dict = dict(zip(self.input_names, xs))
+        env.update(self._static)
+        weights = params if self.trainable else (state or {})
+        for name in self._learn:
+            env[name] = weights[name]
+
+        for node in self.graph.nodes:
+            fn = MAPPERS[node.op_type]
+            args = [env[i] if i else None for i in node.inputs]
+            out = fn(node.attrs, self._static, *args)
+            if isinstance(out, (list, tuple)):
+                for oname, o in zip(node.outputs, out):
+                    env[oname] = o
+            else:
+                env[node.outputs[0]] = out
+
+        outs = [env[o] for o in self.output_names]
+        result = outs if len(outs) > 1 else outs[0]
+        if self.stateful:  # protocol: stateful call returns (out, state)
+            return result, state
+        return result
+
+    @property
+    def stateful(self):
+        return not self.trainable
+
+    def init_state(self):
+        if self.trainable:
+            return super().init_state()
+        return {k: jnp.asarray(v) for k, v in self._learn.items()}
+
+    def compute_output_shape(self, input_shape):
+        vi = self.graph.outputs[0]
+        if vi.shape:
+            return tuple(vi.shape)
+        raise ValueError("onnx graph output shape unknown")
+
+
+def load_onnx(path_or_bytes, trainable=True) -> OnnxNet:
+    """Load an ONNX model file/bytes into an :class:`OnnxNet` (reference
+    onnx_loader.py ``OnnxLoader.load_model`` entry)."""
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    return OnnxNet(decode_model(data), trainable=trainable)
+
+
+__all__ = ["OnnxNet", "load_onnx", "proto", "MAPPERS"]

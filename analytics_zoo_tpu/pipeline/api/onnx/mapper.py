@@ -1,0 +1,571 @@
+"""ONNX op → jax mappers.
+
+Reference: pyzoo/zoo/pipeline/api/onnx/mapper/*.py — 44 OperatorMapper
+subclasses converting ONNX nodes to zoo keras layers.  Here each mapper is
+a pure function ``fn(attrs, consts, *args) -> output(s)`` over jnp arrays:
+the whole graph stays one jit-compiled XLA program, and ONNX's NCHW conv
+layout is expressed directly via conv dimension_numbers (XLA re-lays out
+for the TPU; no transposes inserted by hand).
+
+``consts`` maps input names to *static* numpy values (initializers and
+Constant outputs) for ops whose ONNX inputs are really attributes
+(Reshape shape, Slice starts/ends, Pad pads...).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAPPERS: dict = {}
+
+
+def register(*op_types):
+    def deco(fn):
+        for op in op_types:
+            MAPPERS[op] = fn
+        return fn
+    return deco
+
+
+def _pair(v, n=2, default=1):
+    if v is None:
+        return (default,) * n
+    v = list(v)
+    return tuple(v[:n]) if len(v) >= n else tuple(v) * n
+
+
+def _conv_padding(attrs, spatial_rank, in_sizes=None, kernel=None,
+                  strides=None, dilations=None):
+    pads = attrs.get("pads")
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        # explicit per-dim pads so SAME_LOWER's extra-pad-at-the-start
+        # convention is honored (jax 'SAME' always pads at the end)
+        out = []
+        strides = strides or (1,) * spatial_rank
+        dilations = dilations or (1,) * spatial_rank
+        for size, k, s, d in zip(in_sizes, kernel, strides, dilations):
+            eff = (k - 1) * d + 1
+            n_out = -(-size // s)  # ceil
+            total = max(0, (n_out - 1) * s + eff - size)
+            small, big = total // 2, total - total // 2
+            out.append((big, small) if auto == "SAME_LOWER"
+                       else (small, big))
+        return out
+    if pads is None:
+        return [(0, 0)] * spatial_rank
+    # onnx pads = [x1_begin, x2_begin, ..., x1_end, x2_end, ...]
+    return [(int(pads[i]), int(pads[i + spatial_rank]))
+            for i in range(spatial_rank)]
+
+
+# ---------------------------------------------------------------------------
+# math / activations
+# ---------------------------------------------------------------------------
+
+@register("Add")
+def _add(attrs, consts, a, b):
+    return a + b
+
+
+@register("Sub")
+def _sub(attrs, consts, a, b):
+    return a - b
+
+
+@register("Mul")
+def _mul(attrs, consts, a, b):
+    return a * b
+
+
+@register("Div")
+def _div(attrs, consts, a, b):
+    return a / b
+
+
+@register("Pow")
+def _pow(attrs, consts, a, b):
+    return jnp.power(a, b)
+
+
+@register("Neg")
+def _neg(attrs, consts, a):
+    return -a
+
+
+@register("Abs")
+def _abs(attrs, consts, a):
+    return jnp.abs(a)
+
+
+@register("Exp")
+def _exp(attrs, consts, a):
+    return jnp.exp(a)
+
+
+@register("Log")
+def _log(attrs, consts, a):
+    return jnp.log(a)
+
+
+@register("Sqrt")
+def _sqrt(attrs, consts, a):
+    return jnp.sqrt(a)
+
+
+@register("Reciprocal")
+def _recip(attrs, consts, a):
+    return 1.0 / a
+
+
+@register("Relu")
+def _relu(attrs, consts, a):
+    return jax.nn.relu(a)
+
+
+@register("LeakyRelu")
+def _leaky(attrs, consts, a):
+    return jnp.where(a >= 0, a, attrs.get("alpha", 0.01) * a)
+
+
+@register("Elu")
+def _elu(attrs, consts, a):
+    alpha = attrs.get("alpha", 1.0)
+    return jnp.where(a >= 0, a, alpha * jnp.expm1(a))
+
+
+@register("Sigmoid")
+def _sigmoid(attrs, consts, a):
+    return jax.nn.sigmoid(a)
+
+
+@register("HardSigmoid")
+def _hard_sigmoid(attrs, consts, a):
+    alpha = attrs.get("alpha", 0.2)
+    beta = attrs.get("beta", 0.5)
+    return jnp.clip(alpha * a + beta, 0.0, 1.0)
+
+
+@register("Tanh")
+def _tanh(attrs, consts, a):
+    return jnp.tanh(a)
+
+
+def _softmax_like(fn):
+    def mapper(attrs, consts, a):
+        opset = consts.get("__opset__", 13)
+        if opset >= 13:
+            return fn(a, axis=attrs.get("axis", -1))
+        # pre-13: coerce to 2D at `axis` (default 1), softmax the trailing
+        # flattened block, restore the shape
+        axis = attrs.get("axis", 1)
+        axis = axis % a.ndim
+        lead = int(np.prod(a.shape[:axis])) if axis else 1
+        flat = a.reshape(lead, -1)
+        return fn(flat, axis=-1).reshape(a.shape)
+    return mapper
+
+
+MAPPERS["Softmax"] = _softmax_like(jax.nn.softmax)
+MAPPERS["LogSoftmax"] = _softmax_like(jax.nn.log_softmax)
+
+
+@register("Softplus")
+def _softplus(attrs, consts, a):
+    return jax.nn.softplus(a)
+
+
+@register("Clip")
+def _clip(attrs, consts, a, *bounds):
+    lo = bounds[0] if len(bounds) > 0 else attrs.get("min")
+    hi = bounds[1] if len(bounds) > 1 else attrs.get("max")
+    return jnp.clip(a, lo, hi)
+
+
+@register("Erf")
+def _erf(attrs, consts, a):
+    return jax.scipy.special.erf(a)
+
+
+@register("Max")
+def _max(attrs, consts, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@register("Min")
+def _min(attrs, consts, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.minimum(out, x)
+    return out
+
+
+@register("Sum")
+def _sum(attrs, consts, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+
+@register("MatMul")
+def _matmul(attrs, consts, a, b):
+    return a @ b
+
+
+@register("Gemm")
+def _gemm(attrs, consts, a, b, c=None):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    y = alpha * (a @ b)
+    if c is not None:
+        y = y + beta * c
+    return y
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling (NCHW, per ONNX spec)
+# ---------------------------------------------------------------------------
+
+@register("Conv")
+def _conv(attrs, consts, x, w, b=None):
+    rank = x.ndim - 2
+    strides = _pair(attrs.get("strides"), rank)
+    dilations = _pair(attrs.get("dilations"), rank)
+    groups = int(attrs.get("group", 1))
+    dn = {1: ("NCH", "OIH", "NCH"),
+          2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}[rank]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=_conv_padding(attrs, rank, x.shape[2:], w.shape[2:],
+                              strides, dilations),
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * rank)
+    return y
+
+
+@register("ConvTranspose")
+def _conv_transpose(attrs, consts, x, w, b=None):
+    rank = x.ndim - 2
+    if int(attrs.get("group", 1)) != 1:
+        raise NotImplementedError("ConvTranspose: group > 1")
+    if attrs.get("output_shape") is not None:
+        raise NotImplementedError(
+            "ConvTranspose: explicit output_shape (use pads/output_padding)"
+        )
+    strides = _pair(attrs.get("strides"), rank)
+    dilations = _pair(attrs.get("dilations"), rank)
+    out_pad = _pair(attrs.get("output_padding"), rank, default=0)
+    pads = _conv_padding(attrs, rank, x.shape[2:], w.shape[2:], strides,
+                         dilations)
+    # onnx deconv pads trim the output; conv_transpose takes them as
+    # reduced input-side padding.  output_padding extends the end.  The
+    # onnx kernel is (in, out, *k) correlation-oriented: flip the spatial
+    # dims and run a plain (non-transpose_kernel) IO conv_transpose —
+    # verified element-exact against torch conv_transpose2d.
+    k = [(ki - 1) * d + 1 for ki, d in zip(w.shape[2:], dilations)]
+    padding = [(ki - 1 - lo, ki - 1 - hi + op)
+               for ki, (lo, hi), op in zip(k, pads, out_pad)]
+    dn = {2: ("NCHW", "IOHW", "NCHW")}[rank]
+    w_flipped = jnp.flip(w, axis=tuple(range(2, w.ndim)))
+    y = lax.conv_transpose(
+        x, w_flipped, strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        transpose_kernel=False,
+    )
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * rank)
+    return y
+
+
+def _pool(x, attrs, reducer, init, is_avg=False):
+    rank = x.ndim - 2
+    k = tuple(attrs["kernel_shape"])
+    strides = _pair(attrs.get("strides"), rank)
+    dilations = _pair(attrs.get("dilations"), rank)
+    pads = _conv_padding(attrs, rank, x.shape[2:], k, strides, dilations)
+    if attrs.get("ceil_mode", 0):
+        # extend the end padding so reduce_window emits the ceil-size output
+        new = []
+        for size, ki, s, d, (lo, hi) in zip(x.shape[2:], k, strides,
+                                            dilations, pads):
+            eff = (ki - 1) * d + 1
+            n_ceil = -(-(size + lo + hi - eff) // s) + 1
+            needed = (n_ceil - 1) * s + eff - (size + lo + hi)
+            new.append((lo, hi + max(0, needed)))
+        pads = new
+    full_pads = [(0, 0), (0, 0)] + list(pads)
+    window = (1, 1) + k
+    strd = (1, 1) + strides
+    dil = (1, 1) + dilations
+    y = lax.reduce_window(x, init, reducer, window, strd, full_pads,
+                          window_dilation=dil)
+    if is_avg:
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strd,
+                                full_pads, window_dilation=dil)
+        if attrs.get("count_include_pad", 0):
+            cnt = jnp.full_like(cnt, float(np.prod(k)))
+        y = y / cnt
+    return y
+
+
+@register("MaxPool")
+def _maxpool(attrs, consts, x):
+    return _pool(x, attrs, lax.max, -jnp.inf)
+
+
+@register("AveragePool")
+def _avgpool(attrs, consts, x):
+    return _pool(x, attrs, lax.add, 0.0, is_avg=True)
+
+
+@register("GlobalAveragePool")
+def _gap(attrs, consts, x):
+    axes = tuple(range(2, x.ndim))
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+@register("GlobalMaxPool")
+def _gmp(attrs, consts, x):
+    axes = tuple(range(2, x.ndim))
+    return jnp.max(x, axis=axes, keepdims=True)
+
+
+@register("BatchNormalization")
+def _batchnorm(attrs, consts, x, scale, bias, mean, var):
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var + eps)
+    return (x - mean.reshape(shape)) * (scale * inv).reshape(shape) \
+        + bias.reshape(shape)
+
+
+@register("InstanceNormalization")
+def _instancenorm(attrs, consts, x, scale, bias):
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) * lax.rsqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@register("LRN")
+def _lrn(attrs, consts, x):
+    size = int(attrs["size"])
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    k = attrs.get("bias", 1.0)
+    lo = (size - 1) // 2
+    sq = jnp.square(x)
+    window = lax.reduce_window(
+        sq, 0.0, lax.add,
+        (1, size) + (1,) * (x.ndim - 2),
+        (1,) * x.ndim,
+        [(0, 0), (lo, size - 1 - lo)] + [(0, 0)] * (x.ndim - 2),
+    )
+    return x / jnp.power(k + alpha / size * window, beta)
+
+
+@register("Dropout", "Identity")
+def _identity(attrs, consts, x, *rest):
+    return x
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+@register("Reshape")
+def _reshape(attrs, consts, x, shape=None):
+    if shape is None:
+        shape_vals = attrs.get("shape")  # opset 1
+    elif isinstance(shape, np.ndarray):
+        shape_vals = [int(s) for s in shape]
+    else:
+        raise ValueError(
+            "Reshape: the shape input must be a graph constant "
+            "(initializer/Constant output) — dynamic shapes can't be jitted"
+        )
+    out_shape = [x.shape[i] if s == 0 else int(s)
+                 for i, s in enumerate(shape_vals)]
+    return jnp.reshape(x, out_shape)
+
+
+@register("Flatten")
+def _flatten(attrs, consts, x):
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register("Transpose")
+def _transpose(attrs, consts, x):
+    perm = attrs.get("perm")
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, perm)
+
+
+@register("Concat")
+def _concat(attrs, consts, *xs):
+    return jnp.concatenate(xs, axis=attrs.get("axis", 0))
+
+
+@register("Squeeze")
+def _squeeze(attrs, consts, x, axes=None):
+    ax = attrs.get("axes")
+    if isinstance(axes, np.ndarray):
+        ax = [int(a) for a in axes]
+    return jnp.squeeze(x, axis=tuple(ax) if ax else None)
+
+
+@register("Unsqueeze")
+def _unsqueeze(attrs, consts, x, axes=None):
+    ax = attrs.get("axes")
+    if isinstance(axes, np.ndarray):
+        ax = [int(a) for a in axes]
+    for a in sorted(ax):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register("Gather")
+def _gather(attrs, consts, x, indices):
+    return jnp.take(x, indices.astype(jnp.int32),
+                    axis=attrs.get("axis", 0))
+
+
+@register("Slice")
+def _slice(attrs, consts, x, *args):
+    if args:  # opset >= 10: starts/ends/axes/steps as const inputs
+        vals = [None if a is None else [int(v) for v in np.asarray(a)]
+                for a in args]
+        starts, ends = vals[0], vals[1]
+        axes = vals[2] if len(vals) > 2 and vals[2] is not None \
+            else list(range(len(starts)))
+        steps = vals[3] if len(vals) > 3 and vals[3] is not None \
+            else [1] * len(starts)
+    else:  # opset 1: attributes
+        starts = attrs["starts"]
+        ends = attrs["ends"]
+        axes = attrs.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    idx = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        idx[a] = slice(s, None if e >= x.shape[a] and st > 0 else e, st)
+    return x[tuple(idx)]
+
+
+@register("Split")
+def _split(attrs, consts, x, split=None):
+    axis = attrs.get("axis", 0)
+    parts = attrs.get("split")
+    if isinstance(split, np.ndarray):
+        parts = [int(s) for s in split]
+    if parts is None:
+        raise ValueError("Split: missing split sizes")
+    bounds = np.cumsum(parts)[:-1]
+    return list(jnp.split(x, bounds, axis=axis))
+
+
+@register("Pad")
+def _pad(attrs, consts, x, pads=None, value=None):
+    p = attrs.get("pads")
+    if isinstance(pads, np.ndarray):
+        p = [int(v) for v in pads]
+    mode = attrs.get("mode", "constant")
+    half = len(p) // 2
+    widths = [(p[i], p[i + half]) for i in range(half)]
+    cval = float(np.asarray(value)) if value is not None \
+        else attrs.get("value", 0.0)
+    if mode == "constant":
+        return jnp.pad(x, widths, constant_values=cval)
+    return jnp.pad(x, widths, mode={"reflect": "reflect",
+                                    "edge": "edge"}[mode])
+
+
+@register("Shape")
+def _shape(attrs, consts, x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register("Cast")
+def _cast(attrs, consts, x):
+    from analytics_zoo_tpu.pipeline.api.onnx.proto import _DTYPES
+
+    return x.astype(_DTYPES[int(attrs["to"])])
+
+
+@register("Expand")
+def _expand(attrs, consts, x, shape):
+    target = [int(s) for s in np.asarray(shape)]
+    # onnx Expand: numpy-style right-aligned broadcast; either side may
+    # have more dims, and target dims of 1 keep the input size
+    ndim = max(x.ndim, len(target))
+    xs = (1,) * (ndim - x.ndim) + tuple(x.shape)
+    ts = [1] * (ndim - len(target)) + target
+    out = [max(t, s) for t, s in zip(ts, xs)]
+    return jnp.broadcast_to(x, out)
+
+
+@register("Tile")
+def _tile(attrs, consts, x, repeats):
+    return jnp.tile(x, [int(r) for r in np.asarray(repeats)])
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(fn):
+    def mapper(attrs, consts, x, axes_in=None):
+        axes = attrs.get("axes")
+        if isinstance(axes_in, np.ndarray):
+            axes = [int(a) for a in axes_in]
+        keep = bool(attrs.get("keepdims", 1))
+        ax = tuple(axes) if axes else None
+        return fn(x, axis=ax, keepdims=keep)
+    return mapper
+
+
+MAPPERS["ReduceMean"] = _reduce(jnp.mean)
+MAPPERS["ReduceSum"] = _reduce(jnp.sum)
+MAPPERS["ReduceMax"] = _reduce(jnp.max)
+MAPPERS["ReduceMin"] = _reduce(jnp.min)
+MAPPERS["ReduceProd"] = _reduce(jnp.prod)
+
+
+@register("ArgMax")
+def _argmax(attrs, consts, x):
+    axis = attrs.get("axis", 0)
+    keep = bool(attrs.get("keepdims", 1))
+    out = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keep else out
+
+
+@register("Constant")
+def _constant(attrs, consts):
+    # returns numpy (not jnp) so the interpreter keeps it static and
+    # shape-consuming ops (Reshape/Slice...) can read concrete values
+    return np.asarray(attrs["value"])

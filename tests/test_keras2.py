@@ -88,3 +88,17 @@ def test_keras2_sequential_trains():
     m.fit(x, y, batch_size=32, nb_epoch=60)
     res = m.evaluate(x, y, batch_size=32)
     assert res["accuracy"] > 0.8, res
+
+
+def test_bias_initializer_validation_rules():
+    # use_bias=False makes any bias_initializer vacuously acceptable
+    k2.Dense(4, use_bias=False, bias_initializer="ones")
+    # Zeros-like spellings are accepted
+    k2.Dense(4, bias_initializer="Zeros")
+
+    class Zeros:
+        pass
+
+    k2.Dense(4, bias_initializer=Zeros())
+    with pytest.raises(ValueError, match="zero bias"):
+        k2.Conv2D(4, 3, bias_initializer="ones")

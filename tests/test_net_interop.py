@@ -276,3 +276,32 @@ def test_keras2_rejects_nonzero_bias_init():
 
     with pytest.raises(ValueError, match="zero bias"):
         k2.Dense(4, bias_initializer="ones")
+
+
+def test_torch_criterion_rejects_sample_weight():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.net import TorchCriterion
+
+    crit = TorchCriterion.from_pytorch(torch.nn.MSELoss())
+    y = jnp.zeros((2, 3))
+    with pytest.raises(NotImplementedError, match="sample_weight"):
+        crit.mean(y, y, sample_weight=jnp.ones((2,)))
+
+
+def test_tfnet_scalar_output_shape_hint():
+    tf = pytest.importorskip("tensorflow")
+
+    from analytics_zoo_tpu.pipeline.api.net import TFNet
+
+    calls = []
+
+    def fn(x):
+        calls.append(x.shape)
+        return tf.reduce_sum(x, axis=list(range(1, len(x.shape))))
+
+    net = TFNet(fn, output_shape=(), input_shape=(4,))
+    net.ensure_built((4,))
+    assert calls == []  # explicit () hint suppresses the probe
+    out, _ = net.apply({}, jnp.ones((3, 4), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [4.0, 4.0, 4.0])

@@ -1,0 +1,405 @@
+"""ONNX loader tests (reference pyzoo/test/zoo/pipeline/onnx mapper suite).
+
+The ``onnx`` package is unavailable, so models are fabricated with the
+in-repo wire encoder (which doubles as a codec round-trip test) and mapper
+outputs are oracle-checked against torch functional ops.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.onnx import OnnxNet, load_onnx
+from analytics_zoo_tpu.pipeline.api.onnx.proto import (
+    FLOAT, INT64, Graph, Model, Node, ValueInfo, decode_model, encode_model,
+)
+
+rng0 = np.random.default_rng(0)
+
+
+def make_model(nodes, inputs, outputs, initializers):
+    g = Graph(name="g", nodes=nodes, initializers=initializers,
+              inputs=[ValueInfo(n, s, FLOAT) for n, s in inputs],
+              outputs=[ValueInfo(n, s, FLOAT) for n, s in outputs])
+    return encode_model(Model(graph=g))
+
+
+def test_proto_roundtrip():
+    w = rng0.normal(size=(4, 3)).astype(np.float32)
+    shape = np.asarray([1, -1], dtype=np.int64)
+    data = make_model(
+        nodes=[
+            Node(op_type="MatMul", inputs=["x", "w"], outputs=["y"]),
+            Node(op_type="Relu", inputs=["y"], outputs=["z"],
+                 attrs={}),
+        ],
+        inputs=[("x", (None, 4))],
+        outputs=[("z", (None, 3))],
+        initializers={"w": w, "shape": shape},
+    )
+    m = decode_model(data)
+    assert [n.op_type for n in m.graph.nodes] == ["MatMul", "Relu"]
+    np.testing.assert_allclose(m.graph.initializers["w"], w)
+    np.testing.assert_array_equal(m.graph.initializers["shape"], shape)
+    assert m.graph.inputs[0].name == "x"
+    assert m.graph.inputs[0].shape == (None, 4)
+    assert m.graph.outputs[0].name == "z"
+
+
+def _run(net_bytes, *xs, trainable=True):
+    net = load_onnx(net_bytes, trainable=trainable)
+    net.ensure_built(tuple(np.shape(xs[0]))[1:])
+    params = net.init_params(jax.random.PRNGKey(0))
+    state = net.init_state()
+    arrs = [jnp.asarray(x) for x in xs]
+    out, _ = net.apply(params, arrs if len(arrs) > 1 else arrs[0],
+                       state=state or None)
+    return out, net, params
+
+
+def test_mlp_gemm_relu_softmax():
+    import torch
+
+    w1 = rng0.normal(size=(6, 8)).astype(np.float32)
+    b1 = rng0.normal(size=(8,)).astype(np.float32)
+    w2 = rng0.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng0.normal(size=(3,)).astype(np.float32)
+    data = make_model(
+        nodes=[
+            Node(op_type="Gemm", inputs=["x", "w1", "b1"], outputs=["h"]),
+            Node(op_type="Relu", inputs=["h"], outputs=["hr"]),
+            Node(op_type="Gemm", inputs=["hr", "w2", "b2"], outputs=["l"]),
+            Node(op_type="Softmax", inputs=["l"], outputs=["p"],
+                 attrs={"axis": -1}),
+        ],
+        inputs=[("x", (None, 6))],
+        outputs=[("p", (None, 3))],
+        initializers={"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+    )
+    x = rng0.normal(size=(5, 6)).astype(np.float32)
+    out, net, params = _run(data, x)
+
+    t = torch.from_numpy
+    ref = torch.softmax(
+        torch.relu(t(x) @ t(w1) + t(b1)) @ t(w2) + t(b2), dim=-1
+    ).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+    # float initializers are trainable params
+    assert set(params) == {"w1", "b1", "w2", "b2"}
+
+
+def test_convnet_nchw_vs_torch():
+    import torch
+    import torch.nn.functional as F
+
+    w = rng0.normal(size=(4, 3, 3, 3)).astype(np.float32) * 0.2
+    b = rng0.normal(size=(4,)).astype(np.float32)
+    scale = rng0.uniform(0.5, 1.5, size=(4,)).astype(np.float32)
+    bias = rng0.normal(size=(4,)).astype(np.float32)
+    mean = rng0.normal(size=(4,)).astype(np.float32) * 0.1
+    var = rng0.uniform(0.5, 1.5, size=(4,)).astype(np.float32)
+    reshape = np.asarray([0, -1], dtype=np.int64)
+
+    data = make_model(
+        nodes=[
+            Node(op_type="Conv", inputs=["x", "w", "b"], outputs=["c"],
+                 attrs={"kernel_shape": [3, 3], "strides": [1, 1],
+                        "pads": [1, 1, 1, 1]}),
+            Node(op_type="BatchNormalization",
+                 inputs=["c", "scale", "bias", "mean", "var"],
+                 outputs=["bn"], attrs={"epsilon": 1e-5}),
+            Node(op_type="Relu", inputs=["bn"], outputs=["r"]),
+            Node(op_type="MaxPool", inputs=["r"], outputs=["mp"],
+                 attrs={"kernel_shape": [2, 2], "strides": [2, 2]}),
+            Node(op_type="GlobalAveragePool", inputs=["mp"],
+                 outputs=["gap"]),
+            Node(op_type="Reshape", inputs=["gap", "rs"], outputs=["f"]),
+        ],
+        inputs=[("x", (None, 3, 8, 8))],
+        outputs=[("f", (None, 4))],
+        initializers={"w": w, "b": b, "scale": scale, "bias": bias,
+                      "mean": mean, "var": var, "rs": reshape},
+    )
+    x = rng0.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, net, params = _run(data, x)
+
+    t = torch.from_numpy
+    y = F.conv2d(t(x), t(w), t(b), padding=1)
+    y = F.batch_norm(y, t(mean), t(var), t(scale), t(bias), eps=1e-5)
+    y = F.max_pool2d(torch.relu(y), 2, 2)
+    ref = y.mean((2, 3)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+    # int64 reshape initializer stays static, not a param
+    assert "rs" not in params
+
+
+def test_elementwise_and_reduce_ops():
+    import torch
+
+    x = rng0.normal(size=(3, 4)).astype(np.float32)
+    y = rng0.normal(size=(3, 4)).astype(np.float32)
+    data = make_model(
+        nodes=[
+            Node(op_type="Add", inputs=["x", "y"], outputs=["s"]),
+            Node(op_type="Sigmoid", inputs=["s"], outputs=["sg"]),
+            Node(op_type="Mul", inputs=["sg", "x"], outputs=["m"]),
+            Node(op_type="ReduceMean", inputs=["m"], outputs=["r"],
+                 attrs={"axes": [1], "keepdims": 0}),
+        ],
+        inputs=[("x", (3, 4)), ("y", (3, 4))],
+        outputs=[("r", (3,))],
+        initializers={},
+    )
+    out, _, _ = _run(data, x, y)
+    t = torch.from_numpy
+    ref = (torch.sigmoid(t(x) + t(y)) * t(x)).mean(1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_concat_slice_transpose_pad():
+    x = rng0.normal(size=(2, 3, 4)).astype(np.float32)
+    data = make_model(
+        nodes=[
+            Node(op_type="Transpose", inputs=["x"], outputs=["t"],
+                 attrs={"perm": [0, 2, 1]}),
+            Node(op_type="Concat", inputs=["t", "t"], outputs=["c"],
+                 attrs={"axis": 2}),
+            Node(op_type="Slice", inputs=["c"], outputs=["s"],
+                 attrs={"starts": [1], "ends": [5], "axes": [2]}),
+            Node(op_type="Pad", inputs=["s"], outputs=["p"],
+                 attrs={"pads": [0, 0, 0, 0, 0, 1], "mode": "constant",
+                        "value": 9.0}),
+        ],
+        inputs=[("x", (2, 3, 4))],
+        outputs=[("p", (2, 4, 5))],
+        initializers={},
+    )
+    out, _, _ = _run(data, x)
+    ref = np.transpose(x, (0, 2, 1))
+    ref = np.concatenate([ref, ref], axis=2)[:, :, 1:5]
+    ref = np.pad(ref, ((0, 0), (0, 0), (0, 1)), constant_values=9.0)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_constant_and_gather_and_split():
+    x = rng0.normal(size=(2, 6)).astype(np.float32)
+    idx = np.asarray([2, 0], dtype=np.int64)
+    data = make_model(
+        nodes=[
+            Node(op_type="Constant", inputs=[], outputs=["k"],
+                 attrs={"value": np.asarray(2.0, dtype=np.float32)}),
+            Node(op_type="Mul", inputs=["x", "k"], outputs=["m"]),
+            Node(op_type="Split", inputs=["m"], outputs=["a", "b"],
+                 attrs={"axis": 1, "split": [3, 3]}),
+            Node(op_type="Gather", inputs=["a", "gidx"], outputs=["g"],
+                 attrs={"axis": 1}),
+        ],
+        inputs=[("x", (2, 6))],
+        outputs=[("g", (2, 2)), ("b", (2, 3))],
+        initializers={"gidx": idx},
+    )
+    net = load_onnx(data)
+    net.ensure_built((6,))
+    params = net.init_params(jax.random.PRNGKey(0))
+    out, _ = net.apply(params, jnp.asarray(x))
+    g, b = out
+    np.testing.assert_allclose(np.asarray(g), (2 * x)[:, :3][:, [2, 0]],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(b), (2 * x)[:, 3:], atol=1e-6)
+
+
+def test_onnx_net_finetunes_in_sequential():
+    rng = np.random.default_rng(42)  # own stream: order-independent data
+    w = (rng.normal(size=(4, 2)) * 0.5).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    data = make_model(
+        nodes=[
+            Node(op_type="Gemm", inputs=["x", "w", "b"], outputs=["l"]),
+            Node(op_type="Softmax", inputs=["l"], outputs=["p"],
+                 attrs={"axis": -1}),
+        ],
+        inputs=[("x", (None, 4))],
+        outputs=[("p", (None, 2))],
+        initializers={"w": w, "b": b},
+    )
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int64)
+    m = Sequential()
+    m.add(load_onnx(data))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=32, nb_epoch=250)
+    res = m.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.85, res
+
+
+def test_frozen_onnx_net_state():
+    w = rng0.normal(size=(3, 2)).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    data = make_model(
+        nodes=[Node(op_type="Gemm", inputs=["x", "w", "b"],
+                    outputs=["y"])],
+        inputs=[("x", (None, 3))],
+        outputs=[("y", (None, 2))],
+        initializers={"w": w, "b": b},
+    )
+    net = load_onnx(data, trainable=False)
+    net.ensure_built((3,))
+    params = net.init_params(jax.random.PRNGKey(0))
+    assert params == {}
+    state = net.init_state()
+    x = rng0.normal(size=(2, 3)).astype(np.float32)
+    out, _ = net.apply(params, jnp.asarray(x), state=state)
+    np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_unsupported_op_reports_cleanly():
+    data = make_model(
+        nodes=[Node(op_type="FancyCustomOp", inputs=["x"],
+                    outputs=["y"])],
+        inputs=[("x", (1, 2))],
+        outputs=[("y", (1, 2))],
+        initializers={},
+    )
+    with pytest.raises(NotImplementedError, match="FancyCustomOp"):
+        load_onnx(data)
+
+
+def test_net_facade_load_onnx(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.net import Net
+
+    w = rng0.normal(size=(3, 2)).astype(np.float32)
+    data = make_model(
+        nodes=[Node(op_type="MatMul", inputs=["x", "w"], outputs=["y"])],
+        inputs=[("x", (None, 3))],
+        outputs=[("y", (None, 2))],
+        initializers={"w": w},
+    )
+    p = tmp_path / "m.onnx"
+    p.write_bytes(data)
+    net = Net.load_onnx(str(p))
+    assert isinstance(net, OnnxNet)
+    net.ensure_built((3,))
+    params = net.init_params(jax.random.PRNGKey(0))
+    x = rng0.normal(size=(2, 3)).astype(np.float32)
+    out, _ = net.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_maxpool_ceil_mode_vs_torch():
+    import torch
+    import torch.nn.functional as F
+
+    x = rng0.normal(size=(1, 2, 7, 7)).astype(np.float32)
+    data = make_model(
+        nodes=[Node(op_type="MaxPool", inputs=["x"], outputs=["y"],
+                    attrs={"kernel_shape": [3, 3], "strides": [2, 2],
+                           "ceil_mode": 1})],
+        inputs=[("x", (1, 2, 7, 7))],
+        outputs=[("y", (1, 2, 4, 4))],
+        initializers={},
+    )
+    out, _, _ = _run(data, x)
+    ref = F.max_pool2d(torch.from_numpy(x), 3, 2, ceil_mode=True).numpy()
+    assert np.asarray(out).shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_conv_same_lower_shifts_padding():
+    x = np.zeros((1, 1, 4, 4), dtype=np.float32)
+    x[0, 0, 0, 0] = 1.0
+    w = np.ones((1, 1, 2, 2), dtype=np.float32)
+
+    def run(auto_pad):
+        data = make_model(
+            nodes=[Node(op_type="Conv", inputs=["x", "w"], outputs=["y"],
+                        attrs={"kernel_shape": [2, 2],
+                               "auto_pad": auto_pad})],
+            inputs=[("x", (1, 1, 4, 4))],
+            outputs=[("y", (1, 1, 4, 4))],
+            initializers={"w": w},
+        )
+        out, _, _ = _run(data, x)
+        return np.asarray(out)[0, 0]
+
+    upper = run("SAME_UPPER")   # pad at end: windows start at x[i, j]
+    lower = run("SAME_LOWER")   # pad at start: windows end at x[i, j]
+    assert upper.shape == lower.shape == (4, 4)
+    assert not np.allclose(upper, lower)
+    # with the impulse at x[0,0]: SAME_UPPER's out[1,1] window is
+    # x[1:3,1:3] (misses it); SAME_LOWER's out[1,1] window is x[0:2,0:2]
+    assert upper[1, 1] == 0.0 and lower[1, 1] == 1.0
+
+
+def test_conv_transpose_output_padding_vs_torch():
+    import torch
+    import torch.nn.functional as F
+
+    x = rng0.normal(size=(1, 3, 5, 5)).astype(np.float32)
+    w = (rng0.normal(size=(3, 2, 3, 3)) * 0.3).astype(np.float32)
+    data = make_model(
+        nodes=[Node(op_type="ConvTranspose", inputs=["x", "w"],
+                    outputs=["y"],
+                    attrs={"kernel_shape": [3, 3], "strides": [2, 2],
+                           "pads": [1, 1, 1, 1],
+                           "output_padding": [1, 1]})],
+        inputs=[("x", (1, 3, 5, 5))],
+        outputs=[("y", (1, 2, 10, 10))],
+        initializers={"w": w},
+    )
+    out, _, _ = _run(data, x)
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=2, padding=1, output_padding=1).numpy()
+    assert np.asarray(out).shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_expand_right_aligned_broadcast():
+    x = rng0.normal(size=(2, 3, 4)).astype(np.float32)
+    shape = np.asarray([4], dtype=np.int64)
+    data = make_model(
+        nodes=[Node(op_type="Expand", inputs=["x", "s"], outputs=["y"])],
+        inputs=[("x", (2, 3, 4))],
+        outputs=[("y", (2, 3, 4))],
+        initializers={"s": shape},
+    )
+    out, _, _ = _run(data, x)
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-6)
+
+
+def test_pre13_softmax_coerce_2d():
+    from analytics_zoo_tpu.pipeline.api.onnx.proto import (
+        Graph as G, Model as M, ValueInfo as VI, encode_model as enc,
+    )
+
+    x = rng0.normal(size=(2, 3, 4)).astype(np.float32)
+    g = G(name="g",
+          nodes=[Node(op_type="Softmax", inputs=["x"], outputs=["y"])],
+          inputs=[VI("x", (2, 3, 4), FLOAT)],
+          outputs=[VI("y", (2, 3, 4), FLOAT)])
+    data = enc(M(graph=g, opset=9))
+    out, _, _ = _run(data, x)
+    flat = x.reshape(2, -1)
+    e = np.exp(flat - flat.max(-1, keepdims=True))
+    ref = (e / e.sum(-1, keepdims=True)).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_proto3_omitted_scalar_attr_defaults():
+    from analytics_zoo_tpu.pipeline.api.onnx.proto import (
+        ATTR_INT, _decode_attribute, _put_bytes, _put_varint,
+    )
+
+    # fabricate an AttributeProto with name + type=INT but NO value field,
+    # as proto3 writers do for zero values
+    buf = bytearray()
+    _put_bytes(buf, 1, b"axis")
+    _put_varint(buf, 20, ATTR_INT)
+    a = _decode_attribute(bytes(buf))
+    assert a.name == "axis" and a.value == 0
