@@ -1,0 +1,524 @@
+"""Caffe model loader: prototxt + caffemodel → a jit-compiled zoo layer.
+
+Reference: models/caffe/CaffeLoader.scala:63-671 (+ LayerConverter /
+V1LayerConverter) — converts caffe NetParameter protos into a BigDL graph
+with copied weights.
+
+TPU re-design: like the ONNX loader, the network is interpreted once at
+trace time into a single XLA program (:class:`CaffeNet`), keeping caffe's
+NCHW layout (XLA re-lays out internally).  The prototxt is parsed with a
+small protobuf *text-format* parser and the caffemodel with the generic
+wire-format reader shared with :mod:`..pipeline.api.onnx.proto` — no caffe
+or protobuf runtime required.  Field numbers follow the public caffe.proto
+(frozen by protobuf compatibility rules).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+from analytics_zoo_tpu.pipeline.api.onnx.proto import (
+    _iter_fields,
+    _read_varint,
+)
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(
+    r"""[A-Za-z_][A-Za-z0-9_]*|"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*'"""
+    r"""|[-+]?[0-9.][0-9.eE+-]*|[{}:]""",
+)
+
+
+def _tokenize(text):
+    # strip comments
+    text = re.sub(r"#[^\n]*", "", text)
+    return _TOKEN.findall(text)
+
+
+def _parse_value(tok):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok  # enum identifier / bool
+
+
+def _parse_message(tokens, pos):
+    """Parse `field: value` / `field { ... }` pairs until '}' or EOF.
+    Repeated fields accumulate into lists."""
+    msg: dict = {}
+    n = len(tokens)
+    while pos < n and tokens[pos] != "}":
+        key = tokens[pos]
+        pos += 1
+        if pos < n and tokens[pos] == ":":
+            pos += 1
+            val = _parse_value(tokens[pos])
+            pos += 1
+        elif pos < n and tokens[pos] == "{":
+            val, pos = _parse_message(tokens, pos + 1)
+            assert tokens[pos] == "}", "unbalanced braces in prototxt"
+            pos += 1
+        else:
+            raise ValueError(f"prototxt parse error near {key!r}")
+        if key in msg:
+            if not isinstance(msg[key], list):
+                msg[key] = [msg[key]]
+            msg[key].append(val)
+        else:
+            msg[key] = val
+    return msg, pos
+
+
+def parse_prototxt(text: str) -> dict:
+    tokens = _tokenize(text)
+    msg, pos = _parse_message(tokens, 0)
+    return msg
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# caffemodel (binary NetParameter) — only blobs are needed; topology comes
+# from the prototxt
+# ---------------------------------------------------------------------------
+
+def _decode_blob(buf) -> np.ndarray:
+    import struct
+
+    dims, data, legacy = [], [], {}
+    for fnum, wtype, val in _iter_fields(buf):
+        if fnum == 7:  # shape: BlobShape{ dim=1 }
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    if w2 == 2:  # packed
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            dims.append(d)
+                    else:
+                        dims.append(v2)
+        elif fnum == 5:  # data: repeated float (packed)
+            if wtype == 2:
+                data.append(np.frombuffer(val, dtype=np.float32))
+            else:
+                data.append(np.asarray(
+                    [struct.unpack("<f", struct.pack("<i", val))[0]],
+                    dtype=np.float32,
+                ))
+        elif fnum in (1, 2, 3, 4):  # legacy num/channels/height/width
+            legacy[fnum] = val
+    arr = (np.concatenate(data) if data
+           else np.zeros(0, dtype=np.float32))
+    legacy_format = not dims and bool(legacy)
+    if legacy_format:
+        dims = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    if dims and int(np.prod(dims)) == arr.size:
+        arr = arr.reshape(dims)
+    # squeeze ONLY the legacy num/channels/height/width (1,1,H,W) padding
+    # on FC/bias blobs — a modern 4D blob with num_output=1 (shape
+    # (1,C,kh,kw) via the `shape` field) must stay 4D
+    if legacy_format and arr.ndim == 4 and arr.shape[0] == 1 \
+            and arr.shape[1] == 1:
+        arr = arr[0, 0]
+    return arr
+
+
+def parse_caffemodel(data: bytes) -> dict:
+    """name -> [blob arrays] for every layer carrying weights.  Handles both
+    `layer` (field 100, LayerParameter: name=1, blobs=7) and legacy
+    `layers` (field 2, V1LayerParameter: name=4, blobs=6) messages
+    (CaffeLoader supports both via LayerConverter/V1LayerConverter)."""
+    out: dict = {}
+    for fnum, _, val in _iter_fields(memoryview(data)):
+        if fnum not in (100, 2):
+            continue
+        name_field = 1 if fnum == 100 else 4
+        blob_field = 7 if fnum == 100 else 6
+        name, blobs = "", []
+        for f2, _, v2 in _iter_fields(val):
+            if f2 == name_field and isinstance(v2, bytes):
+                name = v2.decode("utf-8", "replace")
+            elif f2 == blob_field:
+                blobs.append(_decode_blob(v2))
+        if name and blobs:
+            out[name] = blobs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer execution
+# ---------------------------------------------------------------------------
+
+def _ntup(param, base, h_key, w_key, default):
+    """caffe's kernel/stride/pad trio: either repeated `base` or explicit
+    `_h`/`_w` values."""
+    h = param.get(h_key)
+    w = param.get(w_key)
+    if h is not None or w is not None:
+        return (int(h or default), int(w or default))
+    v = _as_list(param.get(base))
+    if not v:
+        return (default, default)
+    if len(v) == 1:
+        return (int(v[0]), int(v[0]))
+    return (int(v[0]), int(v[1]))
+
+
+class CaffeNet(Layer):
+    """A caffe network as a zoo Layer (reference CaffeLoader.scala).
+
+    Supported layer types mirror the reference's converter set:
+    Input/Data, Convolution, InnerProduct, Pooling (MAX/AVE, caffe ceil
+    rounding), ReLU, PReLU, Sigmoid, TanH, ELU, AbsVal, Power, Exp, Log,
+    LRN (across-channels), BatchNorm, Scale, Bias, Concat, Eltwise,
+    Softmax, Dropout (identity at inference), Flatten, Reshape, Split.
+    Weights loaded from the caffemodel become trainable params.
+    """
+
+    def __init__(self, net_def: dict, blobs: dict | None = None,
+                 trainable=True, name=None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.net_def = net_def
+        self.trainable = trainable
+        raw_layers = _as_list(net_def.get("layer")) \
+            or _as_list(net_def.get("layers"))
+        # drop train-only layers (phase TRAIN, loss/accuracy heads)
+        self.layers = []
+        for ly in raw_layers:
+            t = str(ly.get("type", ""))
+            include = ly.get("include", {})
+            phase = include.get("phase") if isinstance(include, dict) \
+                else None
+            if phase == "TRAIN" or t in (
+                "SoftmaxWithLoss", "Accuracy", "EuclideanLoss",
+                "SigmoidCrossEntropyLoss", "HingeLoss", "Data",
+                "ImageData", "HDF5Data",
+            ):
+                continue
+            self.layers.append(ly)
+        self._blobs = blobs or {}
+        self._handler_check()
+
+        # network inputs: explicit `input:` fields or Input layers
+        self.input_names = [str(v) for v in _as_list(net_def.get("input"))]
+        self._input_shapes = {}
+        shapes = _as_list(net_def.get("input_shape"))
+        for iname, shp in zip(self.input_names, shapes):
+            self._input_shapes[iname] = tuple(
+                int(d) for d in _as_list(shp.get("dim"))
+            )
+        for ly in self.layers:
+            if str(ly.get("type")) == "Input":
+                top = str(ly["top"])
+                self.input_names.append(top)
+                shp = ly.get("input_param", {}).get("shape", {})
+                if shp:
+                    self._input_shapes[top] = tuple(
+                        int(d) for d in _as_list(shp.get("dim"))
+                    )
+        if not self.input_names:
+            raise ValueError("caffe net has no inputs (input: or Input)")
+        if len(self.input_names) == 1:
+            shp = self._input_shapes.get(self.input_names[0])
+            if shp and self._input_shape is None:
+                self._input_shape = tuple(shp[1:])
+
+        # caffe has no explicit outputs; the conventional outputs are the
+        # tops never consumed as bottoms (fixed by net_def — precompute)
+        consumed, produced = set(), []
+        for ly in self.layers:
+            consumed.update(str(b) for b in _as_list(ly.get("bottom")))
+            for top in _as_list(ly.get("top")):
+                produced.append(str(top))
+        self.output_names = [t for t in dict.fromkeys(produced)
+                             if t not in consumed] \
+            or produced[-1:]
+
+    _HANDLED = {
+        "Input", "Convolution", "InnerProduct", "Pooling", "ReLU",
+        "PReLU", "Sigmoid", "TanH", "ELU", "AbsVal", "Power", "Exp",
+        "Log", "LRN", "BatchNorm", "Scale", "Bias", "Concat", "Eltwise",
+        "Softmax", "Dropout", "Flatten", "Reshape", "Split",
+    }
+
+    def _handler_check(self):
+        missing = sorted({
+            str(ly.get("type")) for ly in self.layers
+            if str(ly.get("type")) not in self._HANDLED
+        })
+        if missing:
+            raise NotImplementedError(
+                f"caffe layer types without converters: {missing} "
+                f"(supported: {sorted(self._HANDLED)})"
+            )
+
+    # -- weights -----------------------------------------------------------
+    def build(self, input_shape):
+        from analytics_zoo_tpu.pipeline.api.onnx import _Fixed
+
+        for ly in self.layers:
+            lname = str(ly.get("name", ""))
+            for bi, arr in enumerate(self._blobs.get(lname, [])):
+                self.add_weight(f"{lname}/blob{bi}", arr.shape,
+                                _Fixed(arr), trainable=self.trainable)
+
+    def _w(self, weights, ly, idx, default=None):
+        lname = str(ly.get("name", ""))
+        key = f"{lname}/blob{idx}"
+        if key in weights:
+            return weights[key]
+        return default
+
+    # -- forward -----------------------------------------------------------
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        env = dict(zip(self.input_names, xs))
+        weights = params if self.trainable else (state or {})
+
+        for ly in self.layers:
+            t = str(ly.get("type"))
+            if t == "Input":
+                continue
+            bottoms = [env[str(b)] for b in _as_list(ly.get("bottom"))]
+            tops = [str(v) for v in _as_list(ly.get("top"))]
+            out = self._apply_layer(t, ly, bottoms, weights)
+            if t == "Split":
+                for top in tops:
+                    env[top] = out
+            else:
+                env[tops[0]] = out
+
+        result = [env[o] for o in self.output_names if o in env]
+        result = result if len(result) > 1 else result[0]
+        if self.stateful:
+            return result, state
+        return result
+
+    def _apply_layer(self, t, ly, bottoms, weights):
+        x = bottoms[0] if bottoms else None
+        if t == "Convolution":
+            p = ly.get("convolution_param", {})
+            k = _ntup(p, "kernel_size", "kernel_h", "kernel_w", 1)
+            s = _ntup(p, "stride", "stride_h", "stride_w", 1)
+            pad = _ntup(p, "pad", "pad_h", "pad_w", 0)
+            dil = int(_as_list(p.get("dilation"))[0]) \
+                if p.get("dilation") is not None else 1
+            group = int(p.get("group", 1))
+            w = self._w(weights, ly, 0)
+            y = lax.conv_general_dilated(
+                x, w, window_strides=s,
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                rhs_dilation=(dil, dil),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=group,
+            )
+            b = self._w(weights, ly, 1)
+            if b is not None and p.get("bias_term", True) is not False:
+                y = y + b.reshape(1, -1, 1, 1)
+            return y
+        if t == "InnerProduct":
+            p = ly.get("inner_product_param", {})
+            w = self._w(weights, ly, 0)  # (out, in)
+            xf = x.reshape(x.shape[0], -1)
+            y = xf @ w.T
+            b = self._w(weights, ly, 1)
+            if b is not None and p.get("bias_term", True) is not False:
+                y = y + b
+            return y
+        if t == "Pooling":
+            p = ly.get("pooling_param", {})
+            if p.get("global_pooling") in (True, "true", 1):
+                op = p.get("pool", "MAX")
+                fn = jnp.max if op in ("MAX", 0) else jnp.mean
+                return fn(x, axis=(2, 3), keepdims=True)
+            k = _ntup(p, "kernel_size", "kernel_h", "kernel_w", 1)
+            s = _ntup(p, "stride", "stride_h", "stride_w", 1)
+            pad = _ntup(p, "pad", "pad_h", "pad_w", 0)
+            # caffe rounds pooling output UP, then drops a window that
+            # would start entirely inside the padding
+            n_out, extra = [], []
+            for size, ki, st, pd in zip(x.shape[2:], k, s, pad):
+                n = -(-(size + 2 * pd - ki) // st) + 1
+                if pd and (n - 1) * st >= size + pd:
+                    n -= 1
+                n_out.append(n)
+                extra.append(max(0, (n - 1) * st + ki - (size + 2 * pd)))
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            if p.get("pool", "MAX") in ("MAX", 0):
+                # -inf padding: padded cells never win the max (caffe
+                # clips MAX windows to the real image)
+                full = [(0, 0), (0, 0)] + [
+                    (pd, pd + ex) for pd, ex in zip(pad, extra)
+                ]
+                return lax.reduce_window(x, -jnp.inf, lax.max, window,
+                                         strides, full)
+            # AVE: caffe sums real cells but divides by the window extent
+            # clipped to the padded canvas [−pad, size+pad) — pad cells
+            # count in the denominator, the ceil extension does not.
+            xp = jnp.pad(x, [(0, 0), (0, 0)] + [(pd, pd) for pd in pad])
+            full = [(0, 0), (0, 0)] + [(0, ex) for ex in extra]
+            y = lax.reduce_window(xp, 0.0, lax.add, window, strides, full)
+            cnt = lax.reduce_window(jnp.ones_like(xp), 0.0, lax.add,
+                                    window, strides, full)
+            return y / cnt
+        if t == "ReLU":
+            slope = ly.get("relu_param", {}).get("negative_slope", 0.0)
+            if slope:
+                return jnp.where(x >= 0, x, slope * x)
+            return jax.nn.relu(x)
+        if t == "PReLU":
+            a = self._w(weights, ly, 0)
+            return jnp.where(x >= 0, x, a.reshape(1, -1, 1, 1) * x)
+        if t == "Sigmoid":
+            return jax.nn.sigmoid(x)
+        if t == "TanH":
+            return jnp.tanh(x)
+        if t == "ELU":
+            alpha = ly.get("elu_param", {}).get("alpha", 1.0)
+            return jnp.where(x >= 0, x, alpha * jnp.expm1(x))
+        if t == "AbsVal":
+            return jnp.abs(x)
+        if t == "Power":
+            p = ly.get("power_param", {})
+            return jnp.power(
+                p.get("shift", 0.0) + p.get("scale", 1.0) * x,
+                p.get("power", 1.0),
+            )
+        if t == "Exp":
+            p = ly.get("exp_param", {})
+            base = p.get("base", -1.0)
+            y = p.get("scale", 1.0) * x + p.get("shift", 0.0)
+            return jnp.exp(y) if base == -1.0 else jnp.power(base, y)
+        if t == "Log":
+            p = ly.get("log_param", {})
+            base = p.get("base", -1.0)
+            y = p.get("scale", 1.0) * x + p.get("shift", 0.0)
+            out = jnp.log(y)
+            return out if base == -1.0 else out / np.log(base)
+        if t == "LRN":
+            p = ly.get("lrn_param", {})
+            size = int(p.get("local_size", 5))
+            alpha = p.get("alpha", 1.0)
+            beta = p.get("beta", 0.75)
+            kk = p.get("k", 1.0)
+            lo = (size - 1) // 2
+            sq = jnp.square(x)
+            win = lax.reduce_window(
+                sq, 0.0, lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+                [(0, 0), (lo, size - 1 - lo), (0, 0), (0, 0)],
+            )
+            return x / jnp.power(kk + alpha / size * win, beta)
+        if t == "BatchNorm":
+            p = ly.get("batch_norm_param", {})
+            eps = p.get("eps", 1e-5)
+            mean = self._w(weights, ly, 0)
+            var = self._w(weights, ly, 1)
+            factor = self._w(weights, ly, 2)
+            if factor is not None:
+                f = factor.reshape(())
+                scale = jnp.where(f == 0, 0.0, 1.0 / f)
+                mean = mean * scale
+                var = var * scale
+            shape = (1, -1, 1, 1)
+            return (x - mean.reshape(shape)) \
+                * lax.rsqrt(var.reshape(shape) + eps)
+        if t == "Scale":
+            p = ly.get("scale_param", {})
+            gamma = self._w(weights, ly, 0)
+            # per-channel affine over axis 1, broadcast over trailing dims
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            y = x * gamma.reshape(shape)
+            beta = self._w(weights, ly, 1)
+            if beta is not None and p.get("bias_term", False) \
+                    is not False:
+                y = y + beta.reshape(shape)
+            return y
+        if t == "Bias":
+            b = self._w(weights, ly, 0)
+            return x + (b.reshape(1, -1, 1, 1) if x.ndim == 4 else b)
+        if t == "Concat":
+            p = ly.get("concat_param", {})
+            axis = int(p.get("axis", p.get("concat_dim", 1)))
+            return jnp.concatenate(bottoms, axis=axis)
+        if t == "Eltwise":
+            p = ly.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            if op in ("PROD", 0):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = out * b
+                return out
+            if op in ("MAX", 2):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = jnp.maximum(out, b)
+                return out
+            coeff = [float(c) for c in _as_list(p.get("coeff"))] \
+                or [1.0] * len(bottoms)
+            out = coeff[0] * bottoms[0]
+            for c, b in zip(coeff[1:], bottoms[1:]):
+                out = out + c * b
+            return out
+        if t == "Softmax":
+            axis = int(ly.get("softmax_param", {}).get("axis", 1))
+            return jax.nn.softmax(x, axis=axis)
+        if t == "Dropout":
+            return x  # inference: identity (reference drops these too)
+        if t == "Flatten":
+            return x.reshape(x.shape[0], -1)
+        if t == "Reshape":
+            shp = ly.get("reshape_param", {}).get("shape", {})
+            dims = [int(d) for d in _as_list(shp.get("dim"))]
+            out = [x.shape[i] if d == 0 else d
+                   for i, d in enumerate(dims)]
+            return jnp.reshape(x, out)
+        if t == "Split":
+            return x
+        raise NotImplementedError(t)  # pragma: no cover
+
+    @property
+    def stateful(self):
+        return not self.trainable
+
+    def init_state(self):
+        if self.trainable:
+            return super().init_state()
+        state = {}
+        for ly in self.layers:
+            lname = str(ly.get("name", ""))
+            for bi, arr in enumerate(self._blobs.get(lname, [])):
+                state[f"{lname}/blob{bi}"] = jnp.asarray(arr)
+        return state
+
+
+def load_caffe(def_path, model_path=None, trainable=True) -> CaffeNet:
+    """Reference ``Net.loadCaffe(defPath, modelPath)`` →
+    CaffeLoader.loadCaffe (CaffeLoader.scala:63)."""
+    with open(def_path, "r", encoding="utf-8") as f:
+        net_def = parse_prototxt(f.read())
+    blobs = {}
+    if model_path is not None:
+        with open(model_path, "rb") as f:
+            blobs = parse_caffemodel(f.read())
+    return CaffeNet(net_def, blobs, trainable=trainable)

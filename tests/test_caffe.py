@@ -1,0 +1,260 @@
+"""Caffe loader tests (reference CaffeLoaderSpec / models/caffe converters).
+
+caffemodel binaries are fabricated with the shared protobuf wire writer;
+layer math is oracle-checked against torch functional ops.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.caffe import (
+    CaffeNet, load_caffe, parse_caffemodel, parse_prototxt,
+)
+from analytics_zoo_tpu.pipeline.api.onnx.proto import (
+    _put_bytes, _put_varint,
+)
+
+rng0 = np.random.default_rng(0)
+
+
+# -- caffemodel fabrication -------------------------------------------------
+
+def encode_blob(arr):
+    out = bytearray()
+    shape = bytearray()
+    for d in arr.shape:
+        _put_varint(shape, 1, d)
+    _put_bytes(out, 7, bytes(shape))
+    _put_bytes(out, 5, np.ascontiguousarray(
+        arr, dtype=np.float32).tobytes())
+    return bytes(out)
+
+
+def encode_caffemodel(layer_blobs):
+    """layer_blobs: {layer_name: [np arrays]} → NetParameter bytes."""
+    out = bytearray()
+    _put_bytes(out, 1, b"net")
+    for name, blobs in layer_blobs.items():
+        layer = bytearray()
+        _put_bytes(layer, 1, name.encode())
+        _put_bytes(layer, 2, b"Convolution")  # type (unused by parser)
+        for arr in blobs:
+            _put_bytes(layer, 7, encode_blob(arr))
+        _put_bytes(out, 100, bytes(layer))
+    return bytes(out)
+
+
+PROTOTXT = """
+name: "TestNet"  # a comment
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer {
+  name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1"
+  batch_norm_param { eps: 1e-5 }
+}
+layer {
+  name: "scale1" type: "Scale" bottom: "bn1" top: "scale1"
+  scale_param { bias_term: true }
+}
+layer { name: "relu1" type: "ReLU" bottom: "scale1" top: "scale1" }
+layer {
+  name: "pool1" type: "Pooling" bottom: "scale1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc" type: "InnerProduct" bottom: "pool1" top: "fc"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+def test_parse_prototxt():
+    net = parse_prototxt(PROTOTXT)
+    assert net["name"] == "TestNet"
+    assert net["input"] == "data"
+    assert net["input_shape"]["dim"] == [1, 3, 8, 8]
+    layers = net["layer"]
+    assert [ly["type"] for ly in layers] == [
+        "Convolution", "BatchNorm", "Scale", "ReLU", "Pooling",
+        "InnerProduct", "Softmax",
+    ]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+    assert layers[4]["pooling_param"]["pool"] == "MAX"
+
+
+def test_caffemodel_roundtrip():
+    w = rng0.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng0.normal(size=(4,)).astype(np.float32)
+    data = encode_caffemodel({"conv1": [w, b]})
+    blobs = parse_caffemodel(data)
+    assert set(blobs) == {"conv1"}
+    np.testing.assert_allclose(blobs["conv1"][0], w)
+    np.testing.assert_allclose(blobs["conv1"][1], b)
+
+
+def _make_blobs():
+    w = (rng0.normal(size=(4, 3, 3, 3)) * 0.3).astype(np.float32)
+    b = rng0.normal(size=(4,)).astype(np.float32)
+    mean = (rng0.normal(size=(4,)) * 0.1).astype(np.float32)
+    var = rng0.uniform(0.5, 1.5, size=(4,)).astype(np.float32)
+    factor = np.asarray([1.0], dtype=np.float32)
+    gamma = rng0.uniform(0.5, 1.5, size=(4,)).astype(np.float32)
+    beta = rng0.normal(size=(4,)).astype(np.float32)
+    fcw = (rng0.normal(size=(5, 4 * 4 * 4)) * 0.1).astype(np.float32)
+    fcb = rng0.normal(size=(5,)).astype(np.float32)
+    return {
+        "conv1": [w, b],
+        "bn1": [mean, var, factor],
+        "scale1": [gamma, beta],
+        "fc": [fcw, fcb],
+    }
+
+
+def test_caffe_net_vs_torch(tmp_path):
+    import torch
+    import torch.nn.functional as F
+
+    blobs = _make_blobs()
+    proto = tmp_path / "net.prototxt"
+    proto.write_text(PROTOTXT)
+    model = tmp_path / "net.caffemodel"
+    model.write_bytes(encode_caffemodel(blobs))
+
+    net = load_caffe(str(proto), str(model))
+    net.ensure_built((3, 8, 8))
+    params = net.init_params(jax.random.PRNGKey(0))
+    x = rng0.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, _ = net.apply(params, jnp.asarray(x))
+
+    t = torch.from_numpy
+    y = F.conv2d(t(x), t(blobs["conv1"][0]), t(blobs["conv1"][1]),
+                 padding=1)
+    y = (y - t(blobs["bn1"][0]).view(1, -1, 1, 1)) \
+        / torch.sqrt(t(blobs["bn1"][1]).view(1, -1, 1, 1) + 1e-5)
+    y = y * t(blobs["scale1"][0]).view(1, -1, 1, 1) \
+        + t(blobs["scale1"][1]).view(1, -1, 1, 1)
+    y = F.max_pool2d(torch.relu(y), 2, 2)
+    y = y.flatten(1) @ t(blobs["fc"][0]).T + t(blobs["fc"][1])
+    ref = torch.softmax(y, dim=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                               atol=1e-5)
+    # weights became trainable params
+    assert any(k.startswith("conv1/") for k in params)
+
+
+def test_caffe_pooling_ceil_rounding():
+    import torch
+    import torch.nn.functional as F
+
+    # caffe pools round UP: 7 -> ceil((7-3)/2)+1 = 3 (torch default floors)
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 7 dim: 7 }
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+        pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((1, 7, 7))
+    x = rng0.normal(size=(1, 1, 7, 7)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    ref = F.max_pool2d(torch.from_numpy(x), 3, 2, ceil_mode=True).numpy()
+    assert np.asarray(out).shape == ref.shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_caffe_eltwise_concat_split():
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "split" type: "Split" bottom: "data" top: "a" top: "b" }
+layer { name: "sum" type: "Eltwise" bottom: "a" bottom: "b" top: "s"
+        eltwise_param { operation: SUM coeff: 1.0 coeff: 2.0 } }
+layer { name: "cat" type: "Concat" bottom: "s" bottom: "a" top: "c" }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((2, 4, 4))
+    x = rng0.normal(size=(1, 2, 4, 4)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    ref = np.concatenate([3 * x, x], axis=1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_caffe_train_only_layers_dropped():
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "flat" type: "Flatten" bottom: "data" top: "flat" }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "flat" top: "loss" }
+layer { name: "drop" type: "Dropout" bottom: "flat" top: "flat"
+        include { phase: TRAIN } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    assert [str(l["type"]) for l in net.layers] == ["Flatten"]
+
+
+def test_caffe_unsupported_type_raises():
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "x" type: "SPPLayer" bottom: "data" top: "x" }
+"""
+    with pytest.raises(NotImplementedError, match="SPPLayer"):
+        CaffeNet(parse_prototxt(proto))
+
+
+def test_net_facade_load_caffe(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.net import Net
+
+    proto = tmp_path / "n.prototxt"
+    proto.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "p" type: "Power" bottom: "data" top: "p"
+        power_param { power: 2.0 scale: 1.0 shift: 0.0 } }
+""")
+    net = Net.load_caffe(str(proto))
+    net.ensure_built((1, 4, 4))
+    x = rng0.normal(size=(1, 1, 4, 4)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x ** 2, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_caffe_net_finetunes():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+    rng = np.random.default_rng(11)
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 8 }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+        inner_product_param { num_output: 2 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+    blobs = {"fc": [
+        (rng.normal(size=(2, 8)) * 0.3).astype(np.float32),
+        np.zeros(2, dtype=np.float32),
+    ]}
+    net = CaffeNet(parse_prototxt(proto), blobs)
+    net._input_shape = (8,)
+    m = Sequential()
+    m.add(net)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int64)
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=32, nb_epoch=250)
+    res = m.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.85, res
