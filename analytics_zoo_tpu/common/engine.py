@@ -22,6 +22,7 @@ import threading
 from typing import Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -50,7 +51,9 @@ class ZooContext:
     mesh: Mesh
     platform: str
     seed: int = 0
-    # matmul/conv accumulation dtype policy; bfloat16 keeps the MXU fed.
+    # Forward/backward math dtype (params-in-compute); None = full f32.
+    # Master params, optimizer state and loss stay f32 — the standard TPU
+    # mixed-precision recipe that keeps the MXU at bf16 rate.
     compute_dtype: object = None
     _step_rng: jax.Array | None = None
 
@@ -97,6 +100,44 @@ class ZooContext:
         return out
 
 
+def cast_floats(tree, dtype):
+    """Cast floating-point leaves of a pytree to ``dtype`` (None = no-op).
+
+    The mixed-precision primitive: integer leaves (labels, token ids) pass
+    through untouched.
+    """
+    if dtype is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.result_type(a), jnp.floating) else a,
+        tree,
+    )
+
+
+def _resolve_compute_dtype(spec, platform: str):
+    """Resolve the compute dtype policy.
+
+    Precedence: explicit arg/conf > ZOO_COMPUTE_DTYPE env > platform default
+    (bfloat16 on TPU — the MXU's native rate; f32 elsewhere so CPU-mesh tests
+    stay bit-accurate vs oracles).
+    """
+    if spec is None:
+        spec = os.environ.get("ZOO_COMPUTE_DTYPE")
+    if spec is None:
+        return jnp.bfloat16 if platform == "tpu" else None
+    if spec in (jnp.bfloat16, jnp.float16, jnp.float32):
+        return None if spec == jnp.float32 else spec
+    s = str(spec).lower()
+    if s in ("float32", "f32", "fp32", "none", ""):
+        return None
+    if s in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if s in ("float16", "f16", "fp16"):
+        return jnp.float16
+    raise ValueError(f"unknown compute_dtype {spec!r}")
+
+
 _LOCK = threading.Lock()
 _CONTEXT: ZooContext | None = None
 
@@ -132,6 +173,7 @@ def init_zoo_context(
     mesh_axes: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
     seed: int = 0,
     platform: str | None = None,
+    compute_dtype=None,
 ) -> ZooContext:
     """Initialise (or re-initialise) the global runtime context.
 
@@ -155,6 +197,7 @@ def init_zoo_context(
     seed = int(conf.get("seed", seed))
     mesh_shape = conf.get("mesh_shape", mesh_shape)
     platform = conf.get("platform", platform)
+    compute_dtype = conf.get("compute_dtype", compute_dtype)
 
     devices = jax.devices(platform) if platform else jax.devices()
     axes = tuple(mesh_axes)
@@ -163,7 +206,9 @@ def init_zoo_context(
     dev_array = np.asarray(devices[:n_used]).reshape([shape[a] for a in axes])
     mesh = Mesh(dev_array, axes)
     ctx = ZooContext(
-        mesh=mesh, platform=devices[0].platform, seed=seed
+        mesh=mesh, platform=devices[0].platform, seed=seed,
+        compute_dtype=_resolve_compute_dtype(
+            compute_dtype, devices[0].platform),
     )
     with _LOCK:
         _CONTEXT = ctx

@@ -21,6 +21,7 @@ so long-context training never materializes the (L, L) matrix.  On CPU
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
 import jax
@@ -45,7 +46,17 @@ def _attention_reference(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                      interpret=False):
+    """Streaming forward: K/V blocks are a GRID dimension.
+
+    grid = (b, h, n_q, n_k) with the key-block index innermost; Pallas's
+    pipeline DMAs exactly one (block_k, d) K and V tile into VMEM per grid
+    step (double-buffered against compute), so VMEM holds O(block_q·d +
+    block_k·d) — never the whole (lk, d) K/V — and max sequence length is
+    bounded by HBM, not VMEM.  Softmax running stats (m, l) and the output
+    accumulator persist across the ki steps in VMEM scratch.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -54,29 +65,42 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
     offset = lk - lq  # end-aligned causal diagonal
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
+    n_q = pl.cdiv(lq, block_q)
     n_k = pl.cdiv(lk, block_k)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
-        # q_ref: (block_q, d); k_ref/v_ref: (lk, d) resident in VMEM
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
         qi = pl.program_id(2)
-        qb = q_ref[0, 0].astype(jnp.float32)
-        m = jnp.full((block_q, 1), _NEG, jnp.float32)
-        l = jnp.zeros((block_q, 1), jnp.float32)
-        acc = jnp.zeros((block_q, d), jnp.float32)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, 1), 0)
+        ki = pl.program_id(3)
 
-        def body(ki, carry):
-            m, l, acc = carry
-            kb = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-                jnp.float32)
-            vb = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-                jnp.float32)
+        @pl.when(ki == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _NEG)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        q_start = qi * block_q
+        k_start = ki * block_k
+
+        def compute():
+            qb = q_ref[0, 0].astype(jnp.float32)
+            kb = k_ref[0, 0].astype(jnp.float32)
+            vb = v_ref[0, 0].astype(jnp.float32)
+            # Zero padded key rows (lk % block_k != 0): OOB block reads are
+            # unspecified, and a NaN there would poison p @ v even with
+            # p == 0 at those columns (0 * NaN = NaN).
+            k_live = (
+                k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, 1), 0) < lk
+            )
+            kb = jnp.where(k_live, kb, 0.0)
+            vb = jnp.where(k_live, vb, 0.0)
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             # mask padded key rows (lk % block_k != 0) and, if causal, the
             # end-aligned upper triangle
@@ -84,49 +108,67 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
             if causal:
                 live = live & (q_pos + offset >= k_pos)
             s = jnp.where(live, s, _NEG)
+            m, l = m_ref[...], l_ref[...]
             new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m - new_m)
             p = jnp.where(live, jnp.exp(s - new_m), 0.0)
-            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc = acc * alpha + jax.lax.dot_general(
+            m_ref[...] = new_m
+            l_ref[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            return new_m, l, acc
 
         if causal:
-            # skip key blocks entirely after this query block's diagonal
-            n_live = jax.lax.div(
-                (qi + 1) * block_q + offset + block_k - 1, block_k
-            )
-            n_live = jnp.clip(n_live, 0, n_k)
+            # Skip compute for key blocks fully above this query block's
+            # diagonal (their DMA is still pipelined, but no MXU work).
+            pl.when(k_start <= q_start + block_q - 1 + offset)(compute)
         else:
-            n_live = n_k
-        m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
-        o_ref[0, 0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+            compute()
 
-    grid = (b, h, pl.cdiv(lq, block_q))
+        @pl.when(ki == n_k - 1)
+        def _emit():
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.maximum(l_ref[...], 1e-20)
+            ).astype(o_ref.dtype)
+
+    grid = (b, h, n_q, n_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
     )(q, k, v)
 
 
 def _pallas_available() -> bool:
     return jax.default_backend() == "tpu"
+
+
+_warned_fallback = False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -139,7 +181,16 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
             return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
                                      block_k)
         except Exception:
-            pass
+            # Do NOT silently degrade to the O(L²) path on TPU: warn loudly
+            # (once) with the actual kernel error so a broken kernel is
+            # visible in logs and benchmarks.
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                logging.getLogger("analytics_zoo_tpu").exception(
+                    "Pallas flash-attention kernel failed on TPU; falling "
+                    "back to the O(L^2) jnp path. THIS IS A PERFORMANCE BUG."
+                )
     return _attention_reference(q, k, v, causal, scale)
 
 

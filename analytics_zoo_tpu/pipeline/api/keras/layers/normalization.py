@@ -51,21 +51,29 @@ class BatchNormalization(Layer):
     def call(self, params, inputs, state=None, training=False, rng=None):
         axes = tuple(range(inputs.ndim - 1))
         state = state or self.init_state()
+        # Batch statistics in f32 regardless of the compute dtype: bf16
+        # mean/var over large batches loses precision and would pollute the
+        # (f32) running stats.
+        x32 = inputs.astype(jnp.float32)
         if training:
             # Sharded batch ⇒ these are global-mesh reductions (sync BN).
-            mean = jnp.mean(inputs, axis=axes)
-            var = jnp.var(inputs, axis=axes)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             m = self.momentum
             new_state = {
-                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
-                "moving_var": m * state["moving_var"] + (1 - m) * var,
+                "moving_mean": m * jnp.asarray(state["moving_mean"],
+                                               jnp.float32)
+                + (1 - m) * mean,
+                "moving_var": m * jnp.asarray(state["moving_var"],
+                                              jnp.float32)
+                + (1 - m) * var,
             }
         else:
-            mean, var = state["moving_mean"], state["moving_var"]
+            mean = jnp.asarray(state["moving_mean"], jnp.float32)
+            var = jnp.asarray(state["moving_var"], jnp.float32)
             new_state = state
-        y = (inputs - mean) * jnp.reciprocal(
-            jnp.sqrt(var + self.epsilon)
-        )
+        y = ((x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+             ).astype(inputs.dtype)
         if self.scale:
             y = y * params["gamma"]
         if self.center:
@@ -91,9 +99,11 @@ class LayerNormalization(Layer):
         self.add_weight("beta", (d,), "zero")
 
     def call(self, params, inputs, state=None, training=False, rng=None):
-        mean = jnp.mean(inputs, axis=-1, keepdims=True)
-        var = jnp.var(inputs, axis=-1, keepdims=True)
-        y = (inputs - mean) * jax_rsqrt(var + self.epsilon)
+        x32 = inputs.astype(jnp.float32)  # stats in f32 under bf16 compute
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = ((x32 - mean) * jax_rsqrt(var + self.epsilon)).astype(
+            inputs.dtype)
         return y * params["gamma"] + params["beta"]
 
 

@@ -52,6 +52,7 @@ class KerasNet(_ContainerBase):
         self._checkpoint = None   # (path, over_write)
         self._grad_clip = None    # ("l2norm", v) | ("const", lo, hi)
         self._estimator = None
+        self._predict_fn = None   # cached jitted forward (shape-keyed by jit)
 
     # ------------------------------------------------------------------
     # parameter materialization
@@ -169,9 +170,25 @@ class KerasNet(_ContainerBase):
         fs = FeatureSet.of(x)
         n = fs.num_samples
 
-        fwd = jax.jit(
-            lambda p, s, xb: self.forward(p, xb, state=s, training=False)[0]
-        )
+        cached = getattr(self, "_predict_fn", None)
+        if cached is None or cached[0] is not ctx.compute_dtype:
+            # Cached so repeated predict() calls hit jit's shape-keyed
+            # compile cache instead of rebuilding a fresh function object
+            # (and paying full compilation) every call.  Keyed by compute
+            # dtype; invalidated by Sequential.add().  Model state stays f32
+            # (BN running stats must not be rounded).
+            from analytics_zoo_tpu.common.engine import cast_floats
+            dtype = ctx.compute_dtype
+
+            def _fwd(p, s, xb):
+                out, _ = self.forward(
+                    cast_floats(p, dtype), cast_floats(xb, dtype),
+                    state=s, training=False)
+                return cast_floats(out, jnp.float32)
+
+            cached = (ctx.compute_dtype, jax.jit(_fwd))
+            self._predict_fn = cached
+        fwd = cached[1]
         outs = []
         for batch in fs.batches(batch_size, shuffle=False, drop_last=False,
                                 pad_to_batch=ctx.data_parallel_size):
@@ -228,6 +245,7 @@ class KerasNet(_ContainerBase):
             raise IOError(f"{path} exists and over_write=False")
         est, self._estimator = self._estimator, None
         compiled, self._compiled = self._compiled, None
+        pfn, self._predict_fn = getattr(self, "_predict_fn", None), None
         try:
             weights = (
                 jax.tree_util.tree_map(np.asarray, (self.params, self.state))
@@ -242,6 +260,7 @@ class KerasNet(_ContainerBase):
                 self.params, self.state = params, state
         finally:
             self._estimator, self._compiled = est, compiled
+            self._predict_fn = pfn
 
     @staticmethod
     def load(path) -> "KerasNet":
@@ -320,6 +339,7 @@ class Sequential(KerasNet):
         self._layers.append(layer)
         canonicalize_names(self._layers)
         self.params = None  # invalidate materialized params
+        self._predict_fn = None  # a cached jitted forward is stale now
         return self
 
     def build(self, input_shape):

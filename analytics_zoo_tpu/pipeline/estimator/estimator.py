@@ -32,6 +32,8 @@ import dataclasses
 import logging
 import os
 import pickle
+import queue
+import threading
 import time
 from functools import partial
 from typing import Any, Sequence
@@ -41,7 +43,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from analytics_zoo_tpu.common.engine import ZooContext, get_zoo_context
+from analytics_zoo_tpu.common.engine import (
+    ZooContext,
+    cast_floats,
+    get_zoo_context,
+)
 from analytics_zoo_tpu.common.triggers import (
     EveryEpoch,
     MaxEpoch,
@@ -70,6 +76,63 @@ def _clip_grads(grads, grad_clip):
         scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
         return jax.tree_util.tree_map(lambda g: g * scale, grads)
     raise ValueError(f"unknown grad clip {grad_clip!r}")
+
+
+class _DeviceFeeder:
+    """Double-buffered host→device infeed.
+
+    A background thread assembles the next host batch and dispatches its
+    (async) ``device_put`` while the devices run the current step — the
+    host/device overlap SURVEY.md §7 names hard-part #1.  Plays the role of
+    the reference's per-partition RDD iterators keeping executors fed
+    (FeatureSet.scala:240-289), minus the Spark scheduling gap between
+    iterations.
+    """
+
+    _END = object()
+
+    def __init__(self, batches, shard_fn, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+
+        def run():
+            try:
+                for b in batches:
+                    item = shard_fn(b)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                self._err = e
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(self._END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="zoo-infeed")
+        self._thread.start()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._END:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
+
+    def stop(self):
+        self._stop.set()
 
 
 @dataclasses.dataclass
@@ -160,6 +223,8 @@ class Estimator:
         self.epoch = 1
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._loss_buffer: list[tuple[int, Any]] = []
+        self._opt_state = None  # persists across fit() calls
         self.history: list[dict] = []
 
     # ------------------------------------------------------------------
@@ -168,19 +233,35 @@ class Estimator:
     def _build_train_step(self):
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
+        compute_dtype = self.ctx.compute_dtype
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, opt_state, state, rng, batch):
+        def train_step(params, opt_state, state, seed, step, batch):
+            # RNG derived in-graph: no per-step host-side key splitting.
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
             def loss_of(p):
+                # Params-in-compute mixed precision: master params stay f32
+                # (the differentiation variable); the cast is inside the
+                # graph so its vjp returns f32 grads.  Loss math is f32.
+                pc = cast_floats(p, compute_dtype)
+                xc = cast_floats(batch["x"], compute_dtype)
                 preds, new_state = model.forward(
-                    p, batch["x"], state=state, training=True, rng=rng
+                    pc, xc, state=state, training=True, rng=rng
                 )
+                preds = cast_floats(preds, jnp.float32)
                 l = loss_fn.mean(batch.get("y"), preds, batch.get("w"))
                 return l, new_state
 
             (l, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params)
+            if compute_dtype is not None:
+                # Keep state dtypes stable across steps (donation and the
+                # next trace both require it).
+                new_state = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype), new_state, state
+                )
             # With the batch sharded over the `data` axis and params
             # replicated, XLA partitions this program SPMD and inserts the
             # gradient all-reduce (reduce-scatter + all-gather over ICI) —
@@ -194,11 +275,17 @@ class Estimator:
 
     def _build_eval_step(self):
         model, loss_fn, metrics = self.model, self.loss, self.metrics
+        compute_dtype = self.ctx.compute_dtype
 
         @jax.jit
         def eval_step(params, state, batch):
-            preds, _ = model.forward(params, batch["x"], state=state,
-                                     training=False)
+            # State stays f32: BN running stats must not be rounded to bf16
+            # (the layers upcast internally where needed).
+            preds, _ = model.forward(
+                cast_floats(params, compute_dtype),
+                cast_floats(batch["x"], compute_dtype),
+                state=state, training=False)
+            preds = cast_floats(preds, jnp.float32)
             n_valid = batch.get("n_valid")
             mask = None
             if n_valid is not None:
@@ -239,7 +326,13 @@ class Estimator:
                 f"data-parallel size ({dp})"
             )
         if end_trigger is None:
-            end_trigger = MaxEpoch(nb_epoch if nb_epoch is not None else 10)
+            # Keras semantics: each fit() call trains nb_epoch MORE epochs
+            # (relative to the in-process counter).  Checkpoint resume in a
+            # fresh process still continues to the absolute target, matching
+            # the reference's getFinishedEpoch continuation
+            # (Topology.scala:373-386).
+            end_trigger = MaxEpoch(
+                self.epoch - 1 + (nb_epoch if nb_epoch is not None else 10))
         if checkpoint_trigger is None and self._ckpt is not None:
             checkpoint_trigger = EveryEpoch()
         if validation_set is not None and validation_trigger is None:
@@ -247,7 +340,11 @@ class Estimator:
         seed = ctx.seed if seed is None else seed
 
         params, state = self.model.build_params()
-        opt_state = self.optimizer.init(params)
+        # Keras continuation semantics: a second fit() on the same estimator
+        # keeps optimizer moments and the LR-schedule step count (they live
+        # in opt_state), not just the weights.
+        opt_state = (self._opt_state if self._opt_state is not None
+                     else self.optimizer.init(params))
         repl = ctx.replicated()
         params, opt_state, state = jax.device_put(
             (params, opt_state, state), repl
@@ -291,6 +388,10 @@ class Estimator:
                 retries += 1
                 if self._ckpt is None or retries > RETRY_TIMES:
                     raise
+                # Drop device scalars produced by the failed attempt: their
+                # conversion would re-raise the device error, and their steps
+                # will be replayed from the checkpoint anyway.
+                self._loss_buffer = []
                 logger.exception(
                     "training failed; retry %d/%d from latest checkpoint",
                     retries, RETRY_TIMES,
@@ -311,6 +412,7 @@ class Estimator:
 
         self.model.params = params
         self.model.state = state
+        self._opt_state = opt_state
         return self
 
     def _train_loop(self, params, opt_state, state, step_fn, train_set,
@@ -321,6 +423,7 @@ class Estimator:
         tstate = TrainingState(epoch=start_epoch,
                                iteration=self.global_step)
         epoch = start_epoch
+        seed_arr = np.asarray(seed & 0x7FFFFFFF, np.int32)
         while not end_trigger(tstate):
             epoch_t0 = time.perf_counter()
             n_records = 0
@@ -330,29 +433,31 @@ class Estimator:
             )
             loss_dev = None
             bi = start_batch
-            for batch in batch_iter:
-                sharded = ctx.shard_batch(batch)
-                rng = jax.random.fold_in(
-                    jax.random.PRNGKey(seed), self.global_step
-                )
-                params, opt_state, state, loss_dev = step_fn(
-                    params, opt_state, state, rng, sharded
-                )
-                self.global_step += 1
-                bi += 1
-                n_records += batch_size
-                tstate.iteration = self.global_step
-                tstate.epoch_finished = False
-                fired = self._on_iteration(
-                    tstate, loss_dev, params, opt_state, state,
-                    checkpoint_trigger, validation_set, validation_trigger,
-                    epoch, bi, seed, batch_size,
-                )
-                params, opt_state, state = fired
-            # epoch boundary
+            feeder = _DeviceFeeder(batch_iter, ctx.shard_batch)
+            try:
+                for sharded in feeder:
+                    params, opt_state, state, loss_dev = step_fn(
+                        params, opt_state, state, seed_arr,
+                        np.asarray(self.global_step, np.int32), sharded
+                    )
+                    self.global_step += 1
+                    bi += 1
+                    n_records += batch_size
+                    tstate.iteration = self.global_step
+                    tstate.epoch_finished = False
+                    fired = self._on_iteration(
+                        tstate, loss_dev, params, opt_state, state,
+                        checkpoint_trigger, validation_set,
+                        validation_trigger, epoch, bi, seed, batch_size,
+                    )
+                    params, opt_state, state = fired
+            finally:
+                feeder.stop()
+            # epoch boundary (the only unconditional host sync per epoch)
             dt = time.perf_counter() - epoch_t0
             if loss_dev is not None:
                 tstate.loss = float(loss_dev)
+            self._flush_loss_buffer()
             throughput = n_records / max(dt, 1e-9)
             logger.info(
                 "epoch %d done: loss=%.4f, %.1f records/s, step=%d",
@@ -379,18 +484,35 @@ class Estimator:
         self.epoch = epoch
         return params, opt_state, state
 
+    def _flush_loss_buffer(self):
+        """Convert buffered device loss scalars and write them to TB.
+
+        Values are flushed well after their step was dispatched, so the
+        float() conversions read already-computed results instead of forcing
+        a device round-trip per iteration.
+        """
+        if not self._loss_buffer:
+            return
+        buf, self._loss_buffer = self._loss_buffer, []
+        last = None
+        for it, ld in buf:
+            last = float(ld)
+            if self._writers:
+                self._writers[0].add_scalar("Loss", last, it)
+        return last
+
     def _on_iteration(self, tstate, loss_dev, params, opt_state, state,
                       checkpoint_trigger, validation_set,
                       validation_trigger, epoch, next_batch, seed,
                       batch_size):
-        if loss_dev is not None and (
-            self._writers or tstate.iteration % 50 == 0
-        ):
-            tstate.loss = float(loss_dev)
+        if loss_dev is not None:
+            # Keep the raw device scalar (no sync); loss-based triggers
+            # comparing against it only pay the sync when actually used.
+            tstate.loss = loss_dev
             if self._writers:
-                self._writers[0].add_scalar(
-                    "Loss", tstate.loss, tstate.iteration
-                )
+                self._loss_buffer.append((tstate.iteration, loss_dev))
+                if len(self._loss_buffer) >= 50:
+                    self._flush_loss_buffer()
         if validation_set is not None and validation_trigger is not None \
                 and validation_trigger(tstate):
             # NOTE: do NOT attach the live buffers to the model here — the

@@ -6,51 +6,153 @@ scaling factors on Xeon clusters, docs/docs/wp-bigdl.md); the BASELINE.json
 north star is ">= A100-class images/sec/chip".  vs_baseline is therefore
 reported against a 2500 img/s A100 figure (public MLPerf-era ResNet-50
 mixed-precision single-A100 training throughput ballpark).
+
+TPU backend init in this image is flaky (the axon plugin can hang or raise
+UNAVAILABLE — BENCH_r01.json).  The harness therefore probes backend init in
+a SUBPROCESS with a hard timeout, retries with backoff, and only then
+initialises jax in-process on the platform the probe proved alive.  On final
+TPU failure it falls back to a CPU run so a number always lands, with the
+failure diagnostics embedded in the JSON line.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 A100_IMAGES_PER_SEC = 2500.0
 
+# ResNet-50 training FLOPs per image at 224x224: ~4.09 GFLOP forward,
+# ~3x forward for fwd+bwd (standard accounting).
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
+
+# Peak bf16 matmul FLOP/s per chip by device_kind substring (public specs).
+TPU_PEAK_FLOPS = {
+    "v6": 918e12,  # Trillium
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+
+
+def probe_backend(timeout: float) -> tuple[bool, str]:
+    """Try `jax.devices()` in a subprocess with a hard timeout.
+
+    Returns (ok, detail).  A subprocess is the only reliable guard: the axon
+    plugin can hang inside C++ without releasing the GIL, so an in-process
+    watchdog thread could detect but never cancel it.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return False, (tail[-1] if tail else f"probe rc={r.returncode}")
+    return True, r.stdout.strip()
+
+
+def resolve_platform(attempts: int = 3, timeout: float = 150.0):
+    """Probe TPU init with retry+backoff; fall back to CPU.
+
+    Returns (platform, diagnostics list).
+    """
+    diags = []
+    for i in range(attempts):
+        ok, detail = probe_backend(timeout)
+        if ok:
+            diags.append(f"attempt {i + 1}: ok ({detail})")
+            return detail.split()[0], diags
+        diags.append(f"attempt {i + 1}: {detail}")
+        time.sleep(min(10.0 * (2 ** i), 60.0))
+    return "cpu", diags
+
+
+def peak_flops_for(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
 
 def main():
+    platform, diags = resolve_platform()
+    fell_back = platform == "cpu"
+    if fell_back:
+        # Force-CPU the same way the test harness does; the axon plugin
+        # ignores JAX_PLATFORMS, only the config knob is honored.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if fell_back:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
 
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.models.resnet import ResNet
 
     ctx = init_zoo_context(seed=0)
-    model = ResNet.image_net(50, classes=1000, input_shape=(224, 224, 3))
+    on_tpu = ctx.platform == "tpu"
+    # CPU fallback: shrink so a diagnostic number lands in minutes.
+    img = 224 if on_tpu else 64
+    per_chip_batch = 256 if on_tpu else 16
+    steps = 30 if on_tpu else 5
+    model = ResNet.image_net(50, classes=1000, input_shape=(img, img, 3))
     model.compile(
-        optimizer=ResNet.imagenet_optimizer(batch_size=128,
-                                            steps_per_epoch=100),
+        optimizer=ResNet.imagenet_optimizer(
+            batch_size=per_chip_batch, steps_per_epoch=100),
         loss="sparse_categorical_crossentropy",
     )
 
-    batch = 128 * max(ctx.data_parallel_size, 1)
-    steps = 20
+    batch = per_chip_batch * max(ctx.data_parallel_size, 1)
     n = batch * steps
-    x = np.random.default_rng(0).normal(size=(n, 224, 224, 3)).astype(
+    x = np.random.default_rng(0).normal(size=(n, img, img, 3)).astype(
         np.float32)
     y = np.random.default_rng(1).integers(0, 1000, size=(n,)).astype(
         np.int32)
 
-    # warmup epoch (includes compile)
+    # warmup (includes compile)
     model.fit(x[:batch * 2], y[:batch * 2], batch_size=batch, nb_epoch=1)
     t0 = time.perf_counter()
     model.fit(x, y, batch_size=batch, nb_epoch=1)
     dt = time.perf_counter() - t0
     ips = n / dt
     per_chip = ips / max(ctx.data_parallel_size, 1)
-    print(json.dumps({
+
+    out = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 3),
-    }))
+        "platform": ctx.platform,
+        "devices": ctx.num_devices,
+        "per_chip_batch": per_chip_batch,
+        "image_size": img,
+        "steps_timed": steps,
+    }
+    if on_tpu:
+        peak = peak_flops_for(jax.devices()[0].device_kind)
+        if peak:
+            out["mfu"] = round(
+                per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak, 4)
+            out["device_kind"] = jax.devices()[0].device_kind
+    if fell_back:
+        out["note"] = "TPU backend unavailable; CPU fallback at reduced size"
+        out["tpu_init_diagnostics"] = diags
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,107 @@
+"""ResNet on CIFAR-10.
+
+Reference: zoo/.../examples/resnet/TrainCIFAR10.scala (warmup + step-decay
+LR schedule) and resnet/TrainImageNet.scala:36-120.
+
+Reads the CIFAR-10 python pickle batches from --data-dir if present
+(cifar-10-batches-py/); otherwise a procedural 10-class stand-in.
+
+Usage:
+    python examples/resnet/train_cifar10.py --depth 20 --epochs 10
+    python examples/resnet/train_cifar10.py --data-dir /data/cifar10
+"""
+
+import argparse
+import os
+import pickle
+
+import numpy as np
+
+
+def load_cifar10(data_dir):
+    d = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        d = data_dir
+
+    def load_batch(name):
+        with open(os.path.join(d, name), "rb") as f:
+            blob = pickle.load(f, encoding="bytes")
+        x = blob[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.asarray(blob[b"labels"], np.int32)
+
+    parts = [load_batch(f"data_batch_{i}") for i in range(1, 6)]
+    xtr = np.concatenate([p[0] for p in parts])
+    ytr = np.concatenate([p[1] for p in parts])
+    xte, yte = load_batch("test_batch")
+    return (xtr, ytr), (xte, yte)
+
+
+def synthetic_cifar(n_train=4096, n_test=1024, seed=0):
+    """Class = dominant color patch position/hue; learnable by a small
+    ResNet within a few epochs."""
+    rng = np.random.default_rng(seed)
+
+    def make(n):
+        y = rng.integers(0, 10, n).astype(np.int32)
+        x = rng.normal(64, 24, (n, 32, 32, 3)).clip(0, 255)
+        for i, c in enumerate(y):
+            r, col = divmod(int(c), 5)
+            x[i, 4 + r * 14:16 + r * 14, 2 + col * 6:8 + col * 6, c % 3] = 240
+        return x.astype(np.uint8), y
+
+    return make(n_train), make(n_test)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--depth", type=int, default=20,
+                    help="resnet depth (20/32/44/56 basic-block)")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--n-train", type=int, default=4096)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD,
+        warmup_epoch_decay,
+    )
+
+    init_zoo_context("resnet cifar10 example")
+    if args.data_dir:
+        (xtr, ytr), (xte, yte) = load_cifar10(args.data_dir)
+    else:
+        (xtr, ytr), (xte, yte) = synthetic_cifar(args.n_train)
+
+    mean = np.asarray([125.3, 123.0, 113.9], np.float32)
+    std = np.asarray([63.0, 62.1, 66.7], np.float32)
+
+    def prep(x):
+        return (x.astype(np.float32) - mean) / std
+
+    steps = len(xtr) // args.batch_size
+    model = ResNet.cifar(depth=args.depth)
+    # TrainImageNet.scala LR recipe: linear warmup then epoch-step decay.
+    schedule = warmup_epoch_decay(
+        warmup_steps=steps, steps_per_epoch=steps,
+        boundaries_epochs=(args.epochs // 2, 3 * args.epochs // 4),
+        decay=0.1,
+    )
+    model.compile(
+        optimizer=SGD(lr=args.lr, momentum=0.9, weight_decay=1e-4,
+                      schedule=schedule),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    model.fit(prep(xtr), ytr.astype(np.int32), batch_size=args.batch_size,
+              nb_epoch=args.epochs)
+    results = model.evaluate(prep(xte), yte.astype(np.int32),
+                             batch_size=args.batch_size)
+    print({k: round(float(v), 4) for k, v in results.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""ResNet-50 ImageNet training — the flagship benchmark config.
+
+Reference: zoo/.../examples/resnet/TrainImageNet.scala:36-120 (warmup +
+epoch-decay SGD) and the vnni Perf harness
+(examples/vnni/bigdl/Perf.scala:53-66) that prints images/sec.
+
+`bench.py` at the repo root invokes :func:`run` — this example IS the
+benchmark.  With --data-dir it trains on an ImageNet-layout folder tree
+(shards built via FeatureSet.from_shards); without, synthetic data measures
+pure training throughput.
+
+Usage:
+    python examples/resnet/train_imagenet.py --steps 30 --batch-size 256
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def run(image_size=224, per_chip_batch=256, steps=30, classes=1000,
+        depth=50, data_dir=None, warmup_batches=2):
+    """Train ResNet-`depth` for `steps` steps; returns (img/s, ctx)."""
+    from analytics_zoo_tpu import get_zoo_context, init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+
+    ctx = init_zoo_context("resnet imagenet")
+    model = ResNet.image_net(depth, classes=classes,
+                             input_shape=(image_size, image_size, 3))
+    model.compile(
+        optimizer=ResNet.imagenet_optimizer(batch_size=per_chip_batch,
+                                            steps_per_epoch=5004),
+        loss="sparse_categorical_crossentropy",
+    )
+    batch = per_chip_batch * max(ctx.data_parallel_size, 1)
+    if data_dir:
+        import glob
+
+        from analytics_zoo_tpu.feature.dataset import FeatureSet
+        train_set = FeatureSet.from_shards(
+            sorted(glob.glob(f"{data_dir}/*.npz")))
+        n = train_set.num_samples // batch * batch
+        model.fit(train_set, batch_size=batch, nb_epoch=1)  # warm + compile
+        t0 = time.perf_counter()
+        model.fit(train_set, batch_size=batch, nb_epoch=1)
+        return n / (time.perf_counter() - t0), ctx
+
+    n = batch * steps
+    x = np.random.default_rng(0).normal(
+        size=(n, image_size, image_size, 3)).astype(np.float32)
+    y = np.random.default_rng(1).integers(
+        0, classes, size=(n,)).astype(np.int32)
+    # warmup (includes XLA compile)
+    model.fit(x[:batch * warmup_batches], y[:batch * warmup_batches],
+              batch_size=batch, nb_epoch=1)
+    t0 = time.perf_counter()
+    model.fit(x, y, batch_size=batch, nb_epoch=1)
+    dt = time.perf_counter() - t0
+    return n / dt, ctx
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default=None,
+                    help="dir of .npz shards (default: synthetic)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=256,
+                    help="per-chip batch size")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--depth", type=int, default=50)
+    args = ap.parse_args()
+
+    ips, ctx = run(image_size=args.image_size,
+                   per_chip_batch=args.batch_size, steps=args.steps,
+                   depth=args.depth, data_dir=args.data_dir)
+    per_chip = ips / max(ctx.data_parallel_size, 1)
+    print(f"throughput: {ips:.1f} img/s total, {per_chip:.1f} img/s/chip "
+          f"({ctx.num_devices} {ctx.platform} device(s))")
+
+
+if __name__ == "__main__":
+    main()

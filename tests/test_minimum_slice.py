@@ -165,3 +165,28 @@ def test_summary_runs(zoo_ctx):
     model.add(Dense(4))
     text = model.summary()
     assert "Total params" in text
+
+
+def test_consecutive_fits_both_train(zoo_ctx):
+    """Each fit() call must train nb_epoch MORE epochs (Keras semantics).
+    Regression: MaxEpoch was absolute, so a second fit(nb_epoch=1) trained
+    zero steps — which would have silently voided warm-up + timed benchmark
+    patterns (bench.py)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    model = Sequential()
+    model.add(Dense(2, activation="softmax", input_shape=(8,)))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+    est = model._estimator
+    steps_after_first = est.global_step
+    assert steps_after_first == 4
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+    assert est.global_step == 2 * steps_after_first, (
+        "second fit() trained zero steps")
