@@ -48,6 +48,13 @@ def _unwrap(xs):
 class FeatureSet:
     """Base: iterate shuffled minibatches with exact, resumable state."""
 
+    # Optional jittable fn batch_dict -> batch_dict, applied ON DEVICE inside
+    # the compiled train/eval step (see Estimator).  Lets the host ship
+    # compact dtypes (uint8 images) and do normalization/augmentation on the
+    # TPU where it fuses into the step — the host→device link, not the MXU,
+    # is the scarce resource (SURVEY.md §7 hard-part #1).
+    device_transform: Callable | None = None
+
     # ------------------------------------------------------------------
     # constructors (mirror FeatureSet.rdd / .array factories)
     # ------------------------------------------------------------------
@@ -83,6 +90,16 @@ class FeatureSet:
         """Attach a per-record transform (reference ``-> transformer``,
         FeatureSet.scala:82-84)."""
         return TransformedFeatureSet(self, preprocessing)
+
+    def transform_on_device(self, fn: Callable) -> "FeatureSet":
+        """Attach a jittable per-batch transform run inside the compiled
+        step (composes with any transform already attached)."""
+        prev = self.device_transform
+        if prev is None:
+            self.device_transform = fn
+        else:
+            self.device_transform = lambda b, _p=prev, _f=fn: _f(_p(b))
+        return self
 
     def batches(self, batch_size: int, shuffle: bool = True,
                 seed: int = 0, epoch: int = 0, drop_last: bool = True,
@@ -271,6 +288,16 @@ class TransformedFeatureSet(FeatureSet):
     def __init__(self, base: FeatureSet, preprocessing: Preprocessing):
         self.base = base
         self.preprocessing = preprocessing
+
+    @property
+    def device_transform(self):
+        """Delegates to the base so transforms attached to either level —
+        even after this wrapper was built — are seen by the estimator."""
+        return self.base.device_transform
+
+    @device_transform.setter
+    def device_transform(self, fn):
+        self.base.device_transform = fn
 
     @property
     def num_samples(self):

@@ -230,7 +230,7 @@ class Estimator:
     # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
-    def _build_train_step(self):
+    def _build_train_step(self, device_transform=None):
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
         compute_dtype = self.ctx.compute_dtype
@@ -239,6 +239,10 @@ class Estimator:
         def train_step(params, opt_state, state, seed, step, batch):
             # RNG derived in-graph: no per-step host-side key splitting.
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            if device_transform is not None:
+                # On-device preprocessing (uint8 decode/normalize/augment):
+                # fuses into the step, so the host link ships compact dtypes.
+                batch = device_transform(batch)
 
             def loss_of(p):
                 # Params-in-compute mixed precision: master params stay f32
@@ -273,12 +277,14 @@ class Estimator:
 
         return train_step
 
-    def _build_eval_step(self):
+    def _build_eval_step(self, device_transform=None):
         model, loss_fn, metrics = self.model, self.loss, self.metrics
         compute_dtype = self.ctx.compute_dtype
 
         @jax.jit
         def eval_step(params, state, batch):
+            if device_transform is not None:
+                batch = device_transform(batch)
             # State stays f32: BN running stats must not be rounded to bf16
             # (the layers upcast internally where needed).
             preds, _ = model.forward(
@@ -349,9 +355,10 @@ class Estimator:
         params, opt_state, state = jax.device_put(
             (params, opt_state, state), repl
         )
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
-        step_fn = self._train_step_fn
+        dev_tf = getattr(train_set, "device_transform", None)
+        if self._train_step_fn is None or self._train_step_fn[0] is not dev_tf:
+            self._train_step_fn = (dev_tf, self._build_train_step(dev_tf))
+        step_fn = self._train_step_fn[1]
 
         start_epoch, start_batch = self.epoch, 0
         # resume from checkpoint if present (Topology.scala:1220-1242)
@@ -541,6 +548,47 @@ class Estimator:
         return params, opt_state, state
 
     # ------------------------------------------------------------------
+    # pure-device step timing (the bench decomposition hook)
+    # ------------------------------------------------------------------
+    def measure_pure_step(self, batch: dict, n_steps: int = 20,
+                          device_transform=None) -> float:
+        """Time the compiled train step on a device-resident batch.
+
+        Returns seconds/step.  Uses FRESH device buffers (host round-trip
+        copies) so the step's donation can never delete the live
+        model/optimizer arrays, and a throwaway warm step so compile and
+        transfer cost are excluded.  This is the "pure step" half of the
+        bench's e2e-vs-compute decomposition; the difference to e2e is the
+        infeed the feeder failed to hide.
+        """
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        ctx = self.ctx
+        if self._train_step_fn is None \
+                or self._train_step_fn[0] is not device_transform:
+            self._train_step_fn = (
+                device_transform, self._build_train_step(device_transform))
+        step_fn = self._train_step_fn[1]
+        params, state = self.model.build_params()
+        host = jax.tree_util.tree_map(np.asarray, (params, state))
+        params, state = jax.device_put(host, ctx.replicated())
+        opt_state = jax.device_put(self.optimizer.init(params),
+                                   ctx.replicated())
+        sharded = ctx.shard_batch(batch)
+        seed_arr = np.asarray(0, np.int32)
+        params, opt_state, state, loss = step_fn(
+            params, opt_state, state, seed_arr, np.asarray(0, np.int32),
+            sharded)
+        loss.block_until_ready()
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            params, opt_state, state, loss = step_fn(
+                params, opt_state, state, seed_arr,
+                np.asarray(i + 1, np.int32), sharded)
+        loss.block_until_ready()
+        return (time.perf_counter() - t0) / n_steps
+
+    # ------------------------------------------------------------------
     # evaluate (Estimator.scala:157-176; KerasNet.evaluate)
     # ------------------------------------------------------------------
     def evaluate(self, val_set: FeatureSet, batch_size: int = 32) -> dict:
@@ -550,14 +598,15 @@ class Estimator:
     def _evaluate_with(self, params, state, val_set: FeatureSet,
                        batch_size: int = 32) -> dict:
         ctx = self.ctx
-        if self._eval_step_fn is None:
-            self._eval_step_fn = self._build_eval_step()
+        dev_tf = getattr(val_set, "device_transform", None)
+        if self._eval_step_fn is None or self._eval_step_fn[0] is not dev_tf:
+            self._eval_step_fn = (dev_tf, self._build_eval_step(dev_tf))
         accum = None
         for batch in val_set.batches(batch_size, shuffle=False,
                                      drop_last=False,
                                      pad_to_batch=ctx.data_parallel_size):
             sharded = ctx.shard_batch(batch)
-            stats = self._eval_step_fn(params, state, sharded)
+            stats = self._eval_step_fn[1](params, state, sharded)
             host = [[np.asarray(s) for s in group] for group in stats]
             if accum is None:
                 accum = host

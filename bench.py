@@ -3,16 +3,30 @@
 Headline (BASELINE.md): ResNet-50 ImageNet training throughput,
 images/sec/chip.  The reference publishes no absolute numbers (its story is
 scaling factors on Xeon clusters, docs/docs/wp-bigdl.md); the BASELINE.json
-north star is ">= A100-class images/sec/chip".  vs_baseline is therefore
-reported against a 2500 img/s A100 figure (public MLPerf-era ResNet-50
-mixed-precision single-A100 training throughput ballpark).
+north star is ">= A100-class images/sec/chip", so vs_baseline is reported
+against a 2500 img/s A100 figure.
+
+The measurement itself lives in examples/resnet/train_imagenet.run() — the
+example IS the bench (the role of the reference's Perf.scala harness,
+examples/vnni/bigdl/Perf.scala:53-66).  It reports the end-to-end number
+AND the decomposition the end-to-end number hides:
+
+- value / *_e2e: wall-clock fit() throughput (host batch assembly + uint8
+  H2D infeed + compiled step);
+- pure_step_*: the jitted train step on a device-resident batch — the
+  framework's compute celling;
+- infeed_fraction: how much of e2e the infeed fails to hide.  On this
+  harness's tunneled TPU the host→device link measures ~0.15 GB/s (vs tens
+  of GB/s on a real TPU VM), so infeed dominates e2e here; pure_step is the
+  portable number.
+- compiles_timed: XLA compilations during the timed epoch (0 = no
+  per-step retracing).
 
 TPU backend init in this image is flaky (the axon plugin can hang or raise
-UNAVAILABLE — BENCH_r01.json).  The harness therefore probes backend init in
-a SUBPROCESS with a hard timeout, retries with backoff, and only then
-initialises jax in-process on the platform the probe proved alive.  On final
-TPU failure it falls back to a CPU run so a number always lands, with the
-failure diagnostics embedded in the JSON line.
+UNAVAILABLE — BENCH_r01.json).  The harness probes backend init in a
+SUBPROCESS with a hard timeout, retries with backoff, and only then
+initialises jax in-process.  On final TPU failure it falls back to a CPU run
+so a number always lands, with the diagnostics embedded in the JSON line.
 """
 
 import json
@@ -28,15 +42,18 @@ A100_IMAGES_PER_SEC = 2500.0
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
 
 # Peak bf16 matmul FLOP/s per chip by device_kind substring (public specs).
-TPU_PEAK_FLOPS = {
-    "v6": 918e12,  # Trillium
-    "v5p": 459e12,
-    "v5e": 197e12,
-    "v5": 459e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
+# Ordered most-specific first: "TPU v5 lite" (the v5e device_kind string)
+# must match the 197 TF v5e entry, never the 459 TF v5p one.
+TPU_PEAK_FLOPS = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),  # Trillium
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
 PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
 
@@ -44,9 +61,9 @@ PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
 def probe_backend(timeout: float) -> tuple[bool, str]:
     """Try `jax.devices()` in a subprocess with a hard timeout.
 
-    Returns (ok, detail).  A subprocess is the only reliable guard: the axon
-    plugin can hang inside C++ without releasing the GIL, so an in-process
-    watchdog thread could detect but never cancel it.
+    A subprocess is the only reliable guard: the axon plugin can hang inside
+    C++ without releasing the GIL, so an in-process watchdog thread could
+    detect but never cancel it.
     """
     try:
         r = subprocess.run(
@@ -63,10 +80,7 @@ def probe_backend(timeout: float) -> tuple[bool, str]:
 
 
 def resolve_platform(attempts: int = 3, timeout: float = 150.0):
-    """Probe TPU init with retry+backoff; fall back to CPU.
-
-    Returns (platform, diagnostics list).
-    """
+    """Probe TPU init with retry+backoff; fall back to CPU."""
     diags = []
     for i in range(attempts):
         ok, detail = probe_backend(timeout)
@@ -80,7 +94,7 @@ def resolve_platform(attempts: int = 3, timeout: float = 150.0):
 
 def peak_flops_for(device_kind: str) -> float | None:
     kind = device_kind.lower()
-    for key, val in TPU_PEAK_FLOPS.items():
+    for key, val in TPU_PEAK_FLOPS:
         if key in kind:
             return val
     return None
@@ -99,56 +113,55 @@ def main():
     if fell_back:
         jax.config.update("jax_platforms", "cpu")
 
-    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from examples.resnet.train_imagenet import run
 
-    from analytics_zoo_tpu import init_zoo_context
-    from analytics_zoo_tpu.models.resnet import ResNet
-
-    ctx = init_zoo_context(seed=0)
-    on_tpu = ctx.platform == "tpu"
+    # Re-check the ACTUAL in-process platform: the probe subprocess can
+    # succeed while in-process init lands on CPU (flaky plugin).  Sizing
+    # from the probe alone would run TPU-scale ResNet on CPU for hours.
+    actual = jax.devices()[0].platform
+    if actual != "tpu" and not fell_back:
+        fell_back = True
+        diags.append(f"in-process platform is {actual!r} despite probe ok")
+    on_tpu = not fell_back
     # CPU fallback: shrink so a diagnostic number lands in minutes.
-    img = 224 if on_tpu else 64
-    per_chip_batch = 256 if on_tpu else 16
-    steps = 30 if on_tpu else 5
-    model = ResNet.image_net(50, classes=1000, input_shape=(img, img, 3))
-    model.compile(
-        optimizer=ResNet.imagenet_optimizer(
-            batch_size=per_chip_batch, steps_per_epoch=100),
-        loss="sparse_categorical_crossentropy",
+    r = run(
+        image_size=224 if on_tpu else 64,
+        per_chip_batch=256 if on_tpu else 16,
+        steps=30 if on_tpu else 5,
     )
-
-    batch = per_chip_batch * max(ctx.data_parallel_size, 1)
-    n = batch * steps
-    x = np.random.default_rng(0).normal(size=(n, img, img, 3)).astype(
-        np.float32)
-    y = np.random.default_rng(1).integers(0, 1000, size=(n,)).astype(
-        np.int32)
-
-    # warmup (includes compile)
-    model.fit(x[:batch * 2], y[:batch * 2], batch_size=batch, nb_epoch=1)
-    t0 = time.perf_counter()
-    model.fit(x, y, batch_size=batch, nb_epoch=1)
-    dt = time.perf_counter() - t0
-    ips = n / dt
-    per_chip = ips / max(ctx.data_parallel_size, 1)
+    ctx = r["ctx"]
+    dp = max(ctx.data_parallel_size, 1)
+    per_chip = r["e2e_ips"] / dp
+    pure_per_chip = r["pure_ips"] / dp
 
     out = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 3),
+        "pure_step_images_per_sec_per_chip": round(pure_per_chip, 1),
+        "pure_step_ms": round(r["pure_step_ms"], 1),
+        "pure_step_vs_baseline": round(pure_per_chip / A100_IMAGES_PER_SEC,
+                                       3),
+        "infeed_fraction": round(r["infeed_fraction"], 3),
+        "compiles_timed": r["compiles_timed"],
         "platform": ctx.platform,
         "devices": ctx.num_devices,
-        "per_chip_batch": per_chip_batch,
-        "image_size": img,
-        "steps_timed": steps,
+        "per_chip_batch": r["batch"] // dp,
+        "image_size": r["image_size"],
+        "steps_timed": r["steps_timed"],
     }
-    if on_tpu:
-        peak = peak_flops_for(jax.devices()[0].device_kind)
+    if on_tpu and ctx.platform == "tpu":
+        kind = jax.devices()[0].device_kind
+        peak = peak_flops_for(kind)
         if peak:
-            out["mfu"] = round(
+            out["mfu_e2e"] = round(
                 per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak, 4)
-            out["device_kind"] = jax.devices()[0].device_kind
+            out["mfu_pure_step"] = round(
+                pure_per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak, 4)
+            out["device_kind"] = kind
+            out["peak_flops_assumed"] = peak
     if fell_back:
         out["note"] = "TPU backend unavailable; CPU fallback at reduced size"
         out["tpu_init_diagnostics"] = diags

@@ -1,0 +1,63 @@
+"""Per-shape conv microbench: times each representative ResNet-50 conv
+shape (fwd only) with L reps inside ONE dispatch (scan), subtracting the
+tunnel's fixed ~70ms fetch latency.  Prints JSON lines."""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+FETCH_S = 0.070
+
+SHAPES = [
+    # (name, H, Cin, Cout, k, stride)
+    ("stem7x7", 224, 3, 64, 7, 2),
+    ("c1_64_56", 56, 64, 64, 1, 1),
+    ("c3_64_56", 56, 64, 64, 3, 1),
+    ("c1_64_256_56", 56, 64, 256, 1, 1),
+    ("c3_128_28", 28, 128, 128, 3, 1),
+    ("c1_512_128_28", 28, 512, 128, 1, 1),
+    ("c3_256_14", 14, 256, 256, 3, 1),
+    ("c3_512_7", 7, 512, 512, 3, 1),
+    ("c1_2048_512_7", 7, 2048, 512, 1, 1),
+]
+
+
+def time_shape(name, H, cin, cout, k, stride, L=30):
+    key = jax.random.PRNGKey(0)
+    x = (jax.random.normal(key, (B, H, H, cin)) * 0.1).astype(jnp.bfloat16)
+    w = (jax.random.normal(key, (k, k, cin, cout)) * 0.1).astype(jnp.bfloat16)
+
+    @jax.jit
+    def f(x, w):
+        def body(xc, _):
+            y = lax.conv_general_dilated(
+                xc, w, (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # Serialize iterations: next input depends on this output, so
+            # the conv cannot be hoisted out of the loop (loop-invariant
+            # code motion elided an earlier version of this probe).
+            s = jnp.tanh(jnp.sum(y.astype(jnp.float32))) * jnp.bfloat16(1e-6)
+            return xc + s.astype(xc.dtype), ()
+        xe, _ = jax.lax.scan(body, x, None, length=L)
+        return jnp.sum(xe.astype(jnp.float32))
+
+    float(f(x, w))  # warm/compile
+    t0 = time.perf_counter()
+    float(f(x, w))
+    dt = (time.perf_counter() - t0 - FETCH_S) / L
+    Ho = H // stride
+    flops = 2 * B * Ho * Ho * k * k * cin * cout
+    print(json.dumps({
+        "shape": name, "ms": round(dt * 1e3, 3),
+        "tflops": round(flops / dt / 1e12, 1),
+        "gflop": round(flops / 1e9, 1)}), flush=True)
+
+
+for s in SHAPES:
+    time_shape(*s)

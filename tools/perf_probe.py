@@ -1,0 +1,155 @@
+"""TPU perf probe — separates the candidate costs behind the e2e step time.
+
+Prints one JSON line per measurement:
+  dispatch_us        — round-trip latency of a trivial jitted op (sync each)
+  dispatch_async_us  — amortized latency with 100 queued dispatches, 1 sync
+  h2d_f32_gbps       — device_put bandwidth, 150 MB float32
+  h2d_u8_gbps        — device_put bandwidth, 38 MB uint8
+  matmul_tflops      — 8192^3 bf16 matmul sustained TFLOP/s (MXU ceiling probe)
+  resnet_pure_step_ms / resnet_pure_ips — jitted train step on a
+      device-resident batch, donated buffers, N steps, one block at the end.
+
+Usage: python tools/perf_probe.py [--batch 256] [--steps 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def probe_dispatch():
+    x = jnp.zeros((8,), jnp.float32)
+    f = jax.jit(lambda a: a + 1)
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(100):
+        f(x).block_until_ready()
+    sync = (time.perf_counter() - t0) / 100
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(100):
+        y = f(y)
+    y.block_until_ready()
+    async_ = (time.perf_counter() - t0) / 100
+    emit(dispatch_us=round(sync * 1e6, 1),
+         dispatch_async_us=round(async_ * 1e6, 1))
+
+
+def probe_h2d():
+    a32 = np.random.default_rng(0).normal(size=(256, 224, 224, 3)).astype(
+        np.float32)  # ~154 MB
+    a8 = (a32 * 32 + 128).clip(0, 255).astype(np.uint8)  # ~38 MB
+    for name, arr in [("h2d_f32_gbps", a32), ("h2d_u8_gbps", a8)]:
+        jax.device_put(arr).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        n = 5
+        for _ in range(n):
+            jax.device_put(arr).block_until_ready()
+        dt = (time.perf_counter() - t0) / n
+        emit(**{name: round(arr.nbytes / dt / 1e9, 2),
+                name.replace("gbps", "ms"): round(dt * 1e3, 1)})
+
+
+def probe_matmul():
+    # Random data + a scan of `reps` chained matmuls inside ONE dispatch, a
+    # scalar checksum fetched at the end — nothing can be elided or skewed by
+    # async-dispatch accounting.
+    n = 8192
+    reps = 20
+    key = jax.random.PRNGKey(0)
+    a = (jax.random.normal(key, (n, n)) * 1e-3).astype(jnp.bfloat16)
+    b = (jax.random.normal(key, (n, n)) * 1e-3).astype(jnp.bfloat16)
+
+    @jax.jit
+    def chain(x, y):
+        def body(c, _):
+            return jnp.tanh(c @ y), ()
+        c, _ = jax.lax.scan(body, x, None, length=reps)
+        return jnp.sum(c.astype(jnp.float32))
+
+    chain(a, b).block_until_ready()
+    t0 = time.perf_counter()
+    float(chain(a, b))
+    dt = (time.perf_counter() - t0) / reps
+    emit(matmul_tflops=round(2 * n**3 / dt / 1e12, 1),
+         matmul_ms=round(dt * 1e3, 2))
+
+
+def probe_resnet(batch, steps, image=224):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+
+    ctx = init_zoo_context(seed=0)
+    net = ResNet.image_net(50, classes=1000, input_shape=(image, image, 3))
+    net.compile(optimizer=ResNet.imagenet_optimizer(
+        batch_size=batch, steps_per_epoch=100),
+        loss="sparse_categorical_crossentropy")
+    est = net._make_estimator()
+
+    params, state = est.model.build_params()
+    opt_state = est.optimizer.init(params)
+    repl = ctx.replicated()
+    params, opt_state, state = jax.device_put((params, opt_state, state), repl)
+    step_fn = est._build_train_step()
+
+    x = np.random.default_rng(0).normal(size=(batch, image, image, 3)).astype(
+        np.float32)
+    y = np.random.default_rng(1).integers(0, 1000, size=(batch,)).astype(
+        np.int32)
+    sharded = ctx.shard_batch({"x": x, "y": y})
+    seed_arr = np.asarray(0, np.int32)
+
+    t0 = time.perf_counter()
+    params, opt_state, state, loss = step_fn(
+        params, opt_state, state, seed_arr, np.asarray(0, np.int32), sharded)
+    loss.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    emit(resnet_compile_s=round(compile_s, 1), batch=batch)
+
+    # NOTE: batch is donated? donate_argnums=(0,1,2) — batch arg index 5 is
+    # not donated, safe to reuse.
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt_state, state, loss = step_fn(
+            params, opt_state, state, seed_arr,
+            np.asarray(i + 1, np.int32), sharded)
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    ips = batch / dt
+    flops = 3 * 4.09e9 * batch
+    emit(resnet_pure_step_ms=round(dt * 1e3, 1),
+         resnet_pure_ips=round(ips, 1),
+         resnet_pure_mfu=round(flops / dt / 197e12, 4))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--skip-resnet", action="store_true")
+    args = p.parse_args()
+
+    d = jax.devices()[0]
+    emit(platform=d.platform, device_kind=d.device_kind,
+         n_devices=len(jax.devices()))
+    probe_dispatch()
+    probe_h2d()
+    probe_matmul()
+    if not args.skip_resnet:
+        probe_resnet(args.batch, args.steps)
+
+
+if __name__ == "__main__":
+    main()

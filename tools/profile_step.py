@@ -1,0 +1,118 @@
+"""Capture a jax.profiler trace of the pure-device ResNet-50 train step.
+
+Writes the trace under PROFILE_r03/ and prints a JSON line with the top-k
+ops by self time parsed back out of the trace (trace_viewer json.gz).
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+
+def build_step(batch, image=224):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+
+    ctx = init_zoo_context(seed=0)
+    net = ResNet.image_net(50, classes=1000, input_shape=(image, image, 3))
+    net.compile(optimizer=ResNet.imagenet_optimizer(
+        batch_size=batch, steps_per_epoch=100),
+        loss="sparse_categorical_crossentropy")
+    est = net._make_estimator()
+    params, state = est.model.build_params()
+    opt_state = est.optimizer.init(params)
+    repl = ctx.replicated()
+    params, opt_state, state = jax.device_put((params, opt_state, state), repl)
+    step_fn = est._build_train_step()
+    x = np.random.default_rng(0).normal(size=(batch, image, image, 3)).astype(
+        np.float32)
+    y = np.random.default_rng(1).integers(0, 1000, size=(batch,)).astype(
+        np.int32)
+    sharded = ctx.shard_batch({"x": x, "y": y})
+    return step_fn, params, opt_state, state, sharded
+
+
+def summarize(trace_dir, top=25):
+    """Parse trace_viewer json.gz: aggregate event durations by name."""
+    files = glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    if not files:
+        return None
+    with gzip.open(sorted(files)[-1], "rt") as f:
+        data = json.load(f)
+    # Restrict to TPU/device tracks (pid names containing TPU or /device)
+    pid_names = {}
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
+    dur_by_name = defaultdict(float)
+    dur_by_class = defaultdict(float)
+    total = 0.0
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        pname = pid_names.get(ev.get("pid"), "")
+        if "TPU" not in pname and "tpu" not in pname and "XLA" not in pname:
+            continue
+        name = ev.get("name", "?")
+        if name.startswith("jit_") or name.isdigit():
+            continue  # umbrella / step markers, not leaf ops
+        d = ev.get("dur", 0) / 1e3  # ms
+        args = ev.get("args", {}) or {}
+        long = " ".join(str(v) for v in args.values()) + " " + name
+        if "convolution" in long or "conv" in name:
+            cls = "convolution"
+        elif any(k in long for k in ("select_and_scatter", "reduce_window")):
+            cls = "pooling"
+        elif "reduce" in long:
+            cls = "reduce/stats"
+        elif any(k in long for k in ("copy", "transpose", "bitcast")):
+            cls = "copy/layout"
+        else:
+            cls = "elementwise/other"
+        dur_by_name[name] += d
+        dur_by_class[cls] += d
+        total += d
+    ranked = sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top]
+    return {"total_ms": round(total, 1),
+            "tracks": sorted(set(pid_names.values())),
+            "by_class_ms": {k: round(v, 1)
+                            for k, v in sorted(dur_by_class.items(),
+                                               key=lambda kv: -kv[1])},
+            "top_ops": [[n, round(d, 2)] for n, d in ranked]}
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    trace_dir = os.path.join(os.path.dirname(__file__), "..", "PROFILE_r03")
+    step_fn, params, opt_state, state, sharded = build_step(batch)
+    seed_arr = np.asarray(0, np.int32)
+
+    # compile + warm
+    params, opt_state, state, loss = step_fn(
+        params, opt_state, state, seed_arr, np.asarray(0, np.int32), sharded)
+    loss.block_until_ready()
+
+    with jax.profiler.trace(trace_dir):
+        for i in range(5):
+            params, opt_state, state, loss = step_fn(
+                params, opt_state, state, seed_arr,
+                np.asarray(i + 1, np.int32), sharded)
+        loss.block_until_ready()
+
+    time.sleep(1)
+    out = summarize(trace_dir) or {"error": "no trace files found"}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
