@@ -87,7 +87,23 @@ class ZooContext:
 
         This is the per-chip host infeed replacing the reference's
         RDD-partition → task iterator feed (FeatureSet.scala:240-289).
+
+        Single-process: a plain sharded ``device_put`` of the global batch.
+        Multi-process (``jax.distributed``): each host holds only ITS slice
+        of the global batch (``process_local_batch_slice``) and the global
+        array is assembled with ``jax.make_array_from_process_local_data`` —
+        the per-partition locality the reference gets from RDD partitioning
+        (FeatureSet.scala:240-289); host 0's data never crosses hosts.
         """
+        if jax.process_count() > 1:
+            def put(x):
+                # batch_sharding(0) is replicated, so scalars (n_valid,
+                # seeds — same value on every process) and batch arrays go
+                # through the same call.
+                x = np.asarray(x)
+                return jax.make_array_from_process_local_data(
+                    self.batch_sharding(np.ndim(x)), x)
+            return jax.tree_util.tree_map(put, tree)
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x), self.batch_sharding(np.ndim(x))),
             tree,

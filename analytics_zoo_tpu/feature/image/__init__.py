@@ -1,6 +1,18 @@
 from analytics_zoo_tpu.feature.image.imageset import (  # noqa: F401
     ImageSet,
 )
+from analytics_zoo_tpu.feature.image.roi import (  # noqa: F401
+    ImageColorJitter,
+    ImageExpandRoi,
+    ImageRandomSampler,
+    ImageRoiChannelNormalize,
+    ImageRoiHFlip,
+    ImageRoiNormalize,
+    ImageRoiResize,
+    RoiFeatureSet,
+    ssd_train_set,
+    ssd_val_set,
+)
 from analytics_zoo_tpu.feature.image.transforms import (  # noqa: F401
     ImageBrightness,
     ImageCenterCrop,

@@ -33,6 +33,12 @@ from analytics_zoo_tpu.models.image.objectdetection.ssd import (
     ssd_tiny,
     ssd_vgg300,
 )
+from analytics_zoo_tpu.models.image.objectdetection.voc import (
+    VOC_CLASS_TO_IND,
+    VOC_CLASSES,
+    PascalVoc,
+    load_voc_annotation,
+)
 
 __all__ = [
     "ObjectDetector", "PASCAL_CLASSES", "pad_ground_truth",
@@ -41,4 +47,5 @@ __all__ = [
     "average_precision", "mean_average_precision", "PascalVocEvaluator",
     "PriorSpec", "SSD300_SPECS", "generate_priors",
     "ssd_vgg300", "ssd_tiny",
+    "PascalVoc", "VOC_CLASSES", "VOC_CLASS_TO_IND", "load_voc_annotation",
 ]

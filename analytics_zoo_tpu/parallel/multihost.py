@@ -64,10 +64,22 @@ def init_distributed(coordinator_address: str | None = None,
             "any other JAX usage.", e)
 
 
-def process_local_batch_slice(global_batch_size: int) -> slice:
+def process_local_batch_slice(global_batch_size: int,
+                              process_shard: tuple[int, int] | None = None
+                              ) -> slice:
     """Which slice of the global batch this host should load — the per-chip
     host infeed contract (each host feeds only its own chips, replacing the
-    reference's RDD partition locality)."""
-    per_proc = global_batch_size // jax.process_count()
-    start = per_proc * jax.process_index()
+    reference's RDD partition locality, FeatureSet.scala:240-289).
+
+    Consumed per-batch by ``FeatureSet.batches(process_shard=...)`` so each
+    host materializes only its rows; ``ZooContext.shard_batch`` then
+    reassembles the global array via
+    ``jax.make_array_from_process_local_data``.  ``process_shard`` is an
+    explicit ``(process_index, process_count)`` override for callers that
+    already know their coordinates (and for single-process tests).
+    """
+    pid, nproc = (process_shard if process_shard is not None
+                  else (jax.process_index(), jax.process_count()))
+    per_proc = global_batch_size // nproc
+    start = per_proc * pid
     return slice(start, start + per_proc)

@@ -61,6 +61,19 @@ logger = logging.getLogger("analytics_zoo_tpu")
 RETRY_TIMES = int(os.environ.get("ZOO_FAILURE_RETRY_TIMES", "5"))
 
 
+def _process_shard() -> tuple[int, int] | None:
+    """(process_index, process_count) under multi-host jax, else None.
+
+    Handed to ``FeatureSet.batches`` so each host materializes only its rows
+    of every global batch (per-partition locality, the role of the
+    reference's RDD partitioning — FeatureSet.scala:240-289); see
+    ``parallel.multihost.process_local_batch_slice``.
+    """
+    if jax.process_count() > 1:
+        return (jax.process_index(), jax.process_count())
+    return None
+
+
 def _clip_grads(grads, grad_clip):
     if grad_clip is None:
         return grads
@@ -437,6 +450,7 @@ class Estimator:
             batch_iter = train_set.batches(
                 batch_size, shuffle=True, seed=seed, epoch=epoch,
                 drop_last=True, start_batch=start_batch,
+                process_shard=_process_shard(),
             )
             loss_dev = None
             bi = start_batch
@@ -579,13 +593,15 @@ class Estimator:
         params, opt_state, state, loss = step_fn(
             params, opt_state, state, seed_arr, np.asarray(0, np.int32),
             sharded)
-        loss.block_until_ready()
+        float(loss)  # fetch-forced sync: block_until_ready can return
+        #              early on some backends (axon); a dependent-scalar
+        #              fetch cannot.
         t0 = time.perf_counter()
         for i in range(n_steps):
             params, opt_state, state, loss = step_fn(
                 params, opt_state, state, seed_arr,
                 np.asarray(i + 1, np.int32), sharded)
-        loss.block_until_ready()
+        float(loss)
         return (time.perf_counter() - t0) / n_steps
 
     # ------------------------------------------------------------------
@@ -604,7 +620,8 @@ class Estimator:
         accum = None
         for batch in val_set.batches(batch_size, shuffle=False,
                                      drop_last=False,
-                                     pad_to_batch=ctx.data_parallel_size):
+                                     pad_to_batch=ctx.data_parallel_size,
+                                     process_shard=_process_shard()):
             sharded = ctx.shard_batch(batch)
             stats = self._eval_step_fn[1](params, state, sharded)
             host = [[np.asarray(s) for s in group] for group in stats]

@@ -140,8 +140,9 @@ class FileBroker(Broker):
     hashes are single json files under ``<root>/hash/``.
     """
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, max_bytes: int = 1 << 30):
         self.root = root
+        self.max_bytes = int(max_bytes)
         os.makedirs(os.path.join(root, "hash"), exist_ok=True)
 
     def _sdir(self, stream):
@@ -206,6 +207,44 @@ class FileBroker(Broker):
                 os.remove(os.path.join(d, rid + ".json"))
             except OSError:
                 pass
+
+    _RATIO_TTL = 0.5  # seconds between spool re-scans
+
+    def memory_ratio(self):
+        """Spool bytes / max_bytes — the one broker that can actually fill a
+        disk must report pressure so the server's xtrim backpressure path
+        (server.py; semantics ClusterServing.scala:128-134) engages.
+
+        The scan walks every spool file, and OTHER processes append to the
+        spool (clients xadd from their own FileBroker instances), so an
+        in-process byte counter can't work; instead the scan result is
+        cached for ``_RATIO_TTL`` seconds to bound syscall cost per
+        serving step."""
+        now = time.monotonic()
+        cached = getattr(self, "_ratio_cache", None)
+        if cached is not None and now - cached[0] < self._RATIO_TTL:
+            return cached[1]
+        used = 0
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return 0.0
+        for name in entries:
+            if not name.startswith("stream-"):
+                continue
+            d = os.path.join(self.root, name)
+            try:
+                with os.scandir(d) as it:
+                    for e in it:
+                        try:
+                            used += e.stat().st_size
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+        ratio = min(1.0, used / max(self.max_bytes, 1))
+        self._ratio_cache = (now, ratio)
+        return ratio
 
     def hset(self, key, mapping):
         p = self._hpath(key)

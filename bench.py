@@ -16,9 +16,9 @@ AND the decomposition the end-to-end number hides:
 - pure_step_*: the jitted train step on a device-resident batch — the
   framework's compute celling;
 - infeed_fraction: how much of e2e the infeed fails to hide.  On this
-  harness's tunneled TPU the host→device link measures ~0.15 GB/s (vs tens
-  of GB/s on a real TPU VM), so infeed dominates e2e here; pure_step is the
-  portable number.
+  harness's tunneled TPU the host→device link measures ~27-35 MB/s (vs tens
+  of GB/s on a real TPU VM; see PROFILE_r03/ANALYSIS.md), so infeed
+  dominates e2e here; pure_step is the portable number.
 - compiles_timed: XLA compilations during the timed epoch (0 = no
   per-step retracing).
 

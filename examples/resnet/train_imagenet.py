@@ -124,7 +124,11 @@ def run(image_size=224, per_chip_batch=256, steps=30, classes=1000,
 
     # Pure-device step: same compiled fn on a device-resident batch
     # (fresh buffers inside the hook, so donation can't touch live state).
-    first = next(iter(train_set.batches(batch, shuffle=False, epoch=0)))
+    # Multi-host: this host materializes only its rows, like fit() does.
+    ps = ((jax.process_index(), jax.process_count())
+          if jax.process_count() > 1 else None)
+    first = next(iter(train_set.batches(batch, shuffle=False, epoch=0,
+                                        process_shard=ps)))
     pure_dt = model._estimator.measure_pure_step(
         first, n_steps=min(20, steps_run),
         device_transform=train_set.device_transform)

@@ -144,3 +144,24 @@ def test_yaml_config(tmp_path):
     assert h.top_n == 3
     assert h.data_shape == (3, 224, 224)
     assert h.broker_spec == "memory"
+
+def test_filebroker_memory_ratio_and_server_trim(tmp_path):
+    # Tiny capacity so a handful of records exceeds the trim threshold.
+    broker = FileBroker(str(tmp_path / "spool"), max_bytes=600)
+    broker._RATIO_TTL = 0.0  # the scan cache would hide same-instant adds
+    assert broker.memory_ratio() == 0.0
+    for i in range(12):
+        broker.xadd("image_stream", {"uri": f"u{i}", "image": "x" * 40})
+    assert broker.memory_ratio() >= 1.0  # spool is over capacity
+
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    before = broker.xlen("image_stream")
+    serving.step(block_ms=0)  # backpressure path must actually trim
+    assert broker.xlen("image_stream") < before
+    broker.xtrim("image_stream", 0)
+    assert broker.memory_ratio() < 1.0

@@ -50,7 +50,9 @@ def time_shape(name, H, cin, cout, k, stride, L=30):
     float(f(x, w))  # warm/compile
     t0 = time.perf_counter()
     float(f(x, w))
-    dt = (time.perf_counter() - t0 - FETCH_S) / L
+    # FETCH_S is this harness's tunnel latency; clamp so a fast machine
+    # (real TPU VM, ~1 ms fetch) can never print negative times.
+    dt = max(time.perf_counter() - t0 - FETCH_S, 1e-9) / L
     Ho = H // stride
     flops = 2 * B * Ho * Ho * k * k * cin * cout
     print(json.dumps({

@@ -9,6 +9,12 @@ Prints one JSON line per measurement:
   resnet_pure_step_ms / resnet_pure_ips — jitted train step on a
       device-resident batch, donated buffers, N steps, one block at the end.
 
+CAVEAT (axon backend): ``block_until_ready`` can return before execution
+finishes, so the h2d_* and matmul_* numbers here are OPTIMISTIC bounds on
+this harness.  Honest numbers require fetch-forced sync (a dependent scalar
+``float()``) — see PROFILE_r03/ANALYSIS.md for the corrected measurements
+(real H2D ≈ 27-35 MB/s).  resnet_pure_step is fetch-forced and reliable.
+
 Usage: python tools/perf_probe.py [--batch 256] [--steps 20]
 """
 
@@ -114,18 +120,17 @@ def probe_resnet(batch, steps, image=224):
     t0 = time.perf_counter()
     params, opt_state, state, loss = step_fn(
         params, opt_state, state, seed_arr, np.asarray(0, np.int32), sharded)
-    loss.block_until_ready()
+    float(loss)  # fetch-forced sync (block_until_ready lies on axon)
     compile_s = time.perf_counter() - t0
     emit(resnet_compile_s=round(compile_s, 1), batch=batch)
 
-    # NOTE: batch is donated? donate_argnums=(0,1,2) — batch arg index 5 is
-    # not donated, safe to reuse.
+    # batch arg (index 5) is not donated, safe to reuse across steps.
     t0 = time.perf_counter()
     for i in range(steps):
         params, opt_state, state, loss = step_fn(
             params, opt_state, state, seed_arr,
             np.asarray(i + 1, np.int32), sharded)
-    loss.block_until_ready()
+    float(loss)
     dt = (time.perf_counter() - t0) / steps
     ips = batch / dt
     flops = 3 * 4.09e9 * batch
