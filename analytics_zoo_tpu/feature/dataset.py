@@ -81,6 +81,30 @@ class FeatureSet:
         return ShardedFeatureSet(list(paths), n_slices=n_slices,
                                  loader=loader)
 
+    @staticmethod
+    def from_tfrecord(paths: Sequence[str], parse_fn: Callable | None = None,
+                      memory_type: MemoryType = "DISK_4") -> "FeatureSet":
+        """TFRecord shards -> FeatureSet (reference
+        ``TFDataset.from_tfrecord_file``, pyzoo .../net/tf_dataset.py:456-501
+        — no tensorflow needed here; see feature/tfrecord.py).
+
+        ``parse_fn(feature_map) -> (x, y)`` maps one decoded
+        tf.train.Example to arrays; default is the ImageNet JPEG+label
+        layout (``imagenet_example_parser``)."""
+        from analytics_zoo_tpu.feature.tfrecord import (
+            count_tfrecord_records,
+            imagenet_example_parser,
+            tfrecord_loader,
+        )
+
+        parse = parse_fn or imagenet_example_parser()
+        n_slices = 1
+        if memory_type.upper().startswith("DISK_"):
+            n_slices = int(memory_type.split("_")[1])
+        return ShardedFeatureSet(
+            list(paths), n_slices=n_slices, loader=tfrecord_loader(parse),
+            sizer=count_tfrecord_records)
+
     # ------------------------------------------------------------------
     @property
     def num_samples(self) -> int:
@@ -217,12 +241,17 @@ class ShardedFeatureSet(FeatureSet):
     """
 
     def __init__(self, paths: Sequence[str], n_slices: int = 4,
-                 loader: Callable | None = None):
+                 loader: Callable | None = None,
+                 sizer: Callable | None = None):
         assert paths, "no shards given"
         self.paths = list(paths)
         self.n_slices = max(1, min(int(n_slices), len(self.paths)))
         self._default_format = loader is None
         self.loader = loader or self._default_loader
+        # sizer(path) -> record count without materializing the shard
+        # (npz: zip headers; tfrecord: framing walk).  Without one, a custom
+        # loader pays a full load per shard the first time sizes are needed.
+        self.sizer = sizer
         self._cache: dict[str, dict] = {}
         self._sizes: list[int] | None = None
 
@@ -250,11 +279,13 @@ class ShardedFeatureSet(FeatureSet):
 
     def _shard_sizes(self):
         if self._sizes is None:
-            if self._default_format:
+            if self.sizer is not None:
+                self._sizes = [int(self.sizer(p)) for p in self.paths]
+            elif self._default_format:
                 self._sizes = [self._npz_first_dim(p) for p in self.paths]
             else:
-                # Custom loader: sizes require loading once (through the
-                # resident cache; remembered for this FeatureSet's lifetime).
+                # Custom loader without a sizer: sizes require loading once
+                # (through the resident cache).
                 self._sizes = [len(_as_list(self._load(p)["x"])[0])
                                for p in self.paths]
         return self._sizes

@@ -28,7 +28,7 @@ from __future__ import annotations
 import numpy as np
 
 from analytics_zoo_tpu.feature.common import Preprocessing
-from analytics_zoo_tpu.feature.dataset import FeatureSet, _batch_from_arrays
+from analytics_zoo_tpu.feature.dataset import FeatureSet
 
 __all__ = [
     "ImageRoiNormalize", "ImageColorJitter", "ImageExpandRoi",

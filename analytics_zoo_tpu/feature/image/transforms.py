@@ -63,9 +63,22 @@ class ImageResize(Preprocessing):
         h, w = img.shape[:2]
         if (h, w) == (self.h, self.w):
             return img
-        # bilinear via coordinate sampling (no cv2 dependency)
-        ys = np.linspace(0, h - 1, self.h)
-        xs = np.linspace(0, w - 1, self.w)
+        try:
+            import cv2
+
+            # The reference resizes through OpenCV (BigDL augmentation.
+            # Resize); using cv2 here IS the oracle behavior.
+            out = cv2.resize(img, (self.w, self.h),
+                             interpolation=cv2.INTER_LINEAR)
+            if out.ndim == 2 and img.ndim == 3:
+                out = out[:, :, None]  # cv2 drops singleton channels
+            return out
+        except ImportError:
+            pass
+        # numpy fallback with OpenCV's half-pixel-center convention:
+        # src = (dst + 0.5) * scale - 0.5
+        ys = np.clip((np.arange(self.h) + 0.5) * h / self.h - 0.5, 0, h - 1)
+        xs = np.clip((np.arange(self.w) + 0.5) * w / self.w - 0.5, 0, w - 1)
         y0 = np.floor(ys).astype(int)
         x0 = np.floor(xs).astype(int)
         y1 = np.minimum(y0 + 1, h - 1)
@@ -76,8 +89,8 @@ class ImageResize(Preprocessing):
         top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
         bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
         out = top * (1 - wy) + bot * wy
-        return out.astype(img.dtype) if img.dtype == np.uint8 \
-            else out.astype(np.float32)
+        return np.clip(np.rint(out), 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out.astype(np.float32)
 
 
 class ImageCenterCrop(Preprocessing):
@@ -245,3 +258,194 @@ class ImageSetToSample(Preprocessing):
         if isinstance(record, tuple):
             return record
         return (record, None)
+
+
+class ImageBytesToMat(Preprocessing):
+    """Decode encoded image bytes (JPEG/PNG) to an HWC array (reference
+    ImageBytesToMat.scala -> OpenCVMethod.fromImageBytes).  The reference
+    decodes to BGR mats; default here is RGB (the rest of this stack is
+    RGB) with ``order="BGR"`` for byte-exact reference parity."""
+
+    def __init__(self, order: str = "RGB"):
+        assert order in ("RGB", "BGR")
+        self.order = order
+
+    def transform(self, data):
+        import cv2
+
+        buf = np.frombuffer(bytes(data), np.uint8)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)  # BGR
+        if img is None:
+            raise ValueError("undecodable image bytes")
+        return img if self.order == "BGR" else img[:, :, ::-1]
+
+
+class ImagePixelBytesToMat(Preprocessing):
+    """Raw pixel bytes -> HWC uint8 array (reference
+    ImagePixelBytesToMat.scala)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.shape = (int(height), int(width), int(channels))
+
+    def transform(self, data):
+        return np.frombuffer(bytes(data), np.uint8).reshape(self.shape)
+
+
+class ImageChannelOrder(Preprocessing):
+    """Swap RGB<->BGR (reference ImageChannelOrder.scala)."""
+
+    def transform(self, img):
+        return img[:, :, ::-1]
+
+
+class ImageChannelScaledNormalizer(Preprocessing):
+    """(x - per-channel mean) * scale, one scale for all channels
+    (reference ImageChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_r: int, mean_g: int, mean_b: int, scale: float):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.scale = float(scale)
+
+    def transform(self, img):
+        return (img.astype(np.float32) - self.mean) * self.scale
+
+
+class ImageFiller(Preprocessing):
+    """Fill a (normalized-coordinate) region with a constant (reference
+    ImageFiller.scala -> augmentation.Filler; used for occlusion-style
+    augmentation)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: int = 255):
+        self.x1, self.y1 = float(start_x), float(start_y)
+        self.x2, self.y2 = float(end_x), float(end_y)
+        self.value = value
+
+    def transform(self, img):
+        h, w = img.shape[:2]
+        out = img.copy()
+        out[int(self.y1 * h):int(self.y2 * h),
+            int(self.x1 * w):int(self.x2 * w)] = self.value
+        return out
+
+
+class ImageFixedCrop(Preprocessing):
+    """Crop a fixed region, in normalized or pixel coordinates (reference
+    ImageFixedCrop.scala -> augmentation.FixedCrop)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool, is_clip: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+        self.is_clip = is_clip
+
+    def transform(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        if self.is_clip:
+            x1, x2 = max(0, x1), min(w, x2)
+            y1, y2 = max(0, y1), min(h, y2)
+        return img[int(y1):int(y2), int(x1):int(x2)]
+
+
+class ImageMirror(Preprocessing):
+    """Unconditional horizontal mirror (reference ImageMirror.scala ->
+    BigDL augmentation.Mirror; the deterministic cousin of ImageHFlip)."""
+
+    def transform(self, img):
+        return img[:, ::-1]
+
+
+class ImageRandomCropper(_RandomOp):
+    """Random (or center) crop to a fixed size with optional random mirror
+    (reference ImageRandomCropper.scala; the ImageNet training cropper)."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 mirror: bool = True, cropper_method: str = "random"):
+        super().__init__()
+        self.w, self.h = int(crop_width), int(crop_height)
+        self.mirror = mirror
+        assert cropper_method in ("random", "center")
+        self.method = cropper_method
+
+    def transform(self, img):
+        rng = self.next_rng()
+        h, w = img.shape[:2]
+        if h < self.h or w < self.w:
+            # Fail here, not as a shape mismatch in np.stack three stages
+            # later: the cropper contract is a fixed output size.
+            raise ValueError(
+                f"image {h}x{w} is smaller than crop "
+                f"{self.h}x{self.w}; resize before ImageRandomCropper")
+        if self.method == "random":
+            top = int(rng.integers(0, h - self.h + 1))
+            left = int(rng.integers(0, w - self.w + 1))
+        else:
+            top = (h - self.h) // 2
+            left = (w - self.w) // 2
+        out = img[top:top + self.h, left:left + self.w]
+        if self.mirror and rng.random() < 0.5:
+            out = out[:, ::-1]
+        return out
+
+
+class ImageRandomPreprocessing(_RandomOp):
+    """Apply an inner preprocessing with probability ``prob`` (reference
+    ImageRandomPreprocessing.scala; e.g. random expand in the SSD chain)."""
+
+    def __init__(self, inner: Preprocessing, prob: float):
+        super().__init__()
+        self.inner = inner
+        self.prob = float(prob)
+
+    def transform(self, img):
+        if self.next_rng().random() < self.prob:
+            return self.inner.transform(img)
+        return img
+
+
+class ImageRandomResize(_RandomOp):
+    """Resize the SHORT side to a random size in [min_size, max_size],
+    preserving aspect ratio (reference ImageRandomResize.scala -> BigDL
+    RandomResize; the Inception-style scale augmentation)."""
+
+    def __init__(self, min_size: int, max_size: int):
+        super().__init__()
+        self.min_size, self.max_size = int(min_size), int(max_size)
+
+    def transform(self, img):
+        size = int(self.next_rng().integers(self.min_size,
+                                            self.max_size + 1))
+        h, w = img.shape[:2]
+        if h < w:
+            nh, nw = size, max(1, round(w * size / h))
+        else:
+            nh, nw = max(1, round(h * size / w)), size
+        return ImageResize(nh, nw).transform(img)
+
+
+class ImageMatToFloats(Preprocessing):
+    """HWC array -> float32 (reference ImageMatToFloats.scala; layout stays
+    NHWC — the TPU-native layout)."""
+
+    def transform(self, img):
+        return np.asarray(img, np.float32)
+
+
+class ImageAspectScale(Preprocessing):
+    """Scale so the short side is ``min_size`` without exceeding
+    ``max_size`` on the long side (reference pipeline's aspect-preserving
+    scale used by detection eval)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000):
+        self.min_size, self.max_size = int(min_size), int(max_size)
+
+    def transform(self, img):
+        h, w = img.shape[:2]
+        short, long = min(h, w), max(h, w)
+        scale = min(self.min_size / short, self.max_size / long)
+        nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+        return ImageResize(nh, nw).transform(img)

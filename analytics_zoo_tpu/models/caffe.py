@@ -282,11 +282,18 @@ class CaffeNet(Layer):
                 self.add_weight(f"{lname}/blob{bi}", arr.shape,
                                 _Fixed(arr), trainable=self.trainable)
 
-    def _w(self, weights, ly, idx, default=None):
+    def _w(self, weights, ly, idx, default=None, required=False):
         lname = str(ly.get("name", ""))
         key = f"{lname}/blob{idx}"
         if key in weights:
             return weights[key]
+        if required:
+            # Round-1 advisor finding: without this, load_caffe with no
+            # .caffemodel crashed deep inside lax with None weights.
+            raise ValueError(
+                f"caffe layer {lname!r} ({ly.get('type')}) has no blob "
+                f"{idx}: pass model_path=<.caffemodel> to load_caffe (the "
+                "prototxt alone carries no weights)")
         return default
 
     # -- forward -----------------------------------------------------------
@@ -324,7 +331,7 @@ class CaffeNet(Layer):
             dil = int(_as_list(p.get("dilation"))[0]) \
                 if p.get("dilation") is not None else 1
             group = int(p.get("group", 1))
-            w = self._w(weights, ly, 0)
+            w = self._w(weights, ly, 0, required=True)
             y = lax.conv_general_dilated(
                 x, w, window_strides=s,
                 padding=[(pad[0], pad[0]), (pad[1], pad[1])],
@@ -338,7 +345,7 @@ class CaffeNet(Layer):
             return y
         if t == "InnerProduct":
             p = ly.get("inner_product_param", {})
-            w = self._w(weights, ly, 0)  # (out, in)
+            w = self._w(weights, ly, 0, required=True)  # (out, in)
             xf = x.reshape(x.shape[0], -1)
             y = xf @ w.T
             b = self._w(weights, ly, 1)
@@ -365,6 +372,13 @@ class CaffeNet(Layer):
                 extra.append(max(0, (n - 1) * st + ki - (size + 2 * pd)))
             window = (1, 1) + k
             strides = (1, 1) + s
+            if p.get("pool", "MAX") in ("STOCHASTIC", 2):
+                # Round-1 advisor finding: executing STOCHASTIC as AVE is
+                # silently wrong; caffe stochastic pooling has no
+                # deterministic inference equivalent here.
+                raise NotImplementedError(
+                    f"caffe STOCHASTIC pooling (layer "
+                    f"{ly.get('name')!r}) is not supported")
             if p.get("pool", "MAX") in ("MAX", 0):
                 # -inf padding: padded cells never win the max (caffe
                 # clips MAX windows to the real image)
@@ -388,7 +402,7 @@ class CaffeNet(Layer):
                 return jnp.where(x >= 0, x, slope * x)
             return jax.nn.relu(x)
         if t == "PReLU":
-            a = self._w(weights, ly, 0)
+            a = self._w(weights, ly, 0, required=True)
             return jnp.where(x >= 0, x, a.reshape(1, -1, 1, 1) * x)
         if t == "Sigmoid":
             return jax.nn.sigmoid(x)
@@ -424,6 +438,17 @@ class CaffeNet(Layer):
             kk = p.get("k", 1.0)
             lo = (size - 1) // 2
             sq = jnp.square(x)
+            region = p.get("norm_region", "ACROSS_CHANNELS")
+            if region in ("WITHIN_CHANNEL", 1):
+                # caffe WITHIN_CHANNEL: spatial size x size window per
+                # channel, denominator normalized by the window AREA
+                # (round-1 advisor finding: norm_region was ignored).
+                win = lax.reduce_window(
+                    sq, 0.0, lax.add, (1, 1, size, size), (1, 1, 1, 1),
+                    [(0, 0), (0, 0), (lo, size - 1 - lo),
+                     (lo, size - 1 - lo)],
+                )
+                return x / jnp.power(kk + alpha / (size * size) * win, beta)
             win = lax.reduce_window(
                 sq, 0.0, lax.add, (1, size, 1, 1), (1, 1, 1, 1),
                 [(0, 0), (lo, size - 1 - lo), (0, 0), (0, 0)],
@@ -432,8 +457,8 @@ class CaffeNet(Layer):
         if t == "BatchNorm":
             p = ly.get("batch_norm_param", {})
             eps = p.get("eps", 1e-5)
-            mean = self._w(weights, ly, 0)
-            var = self._w(weights, ly, 1)
+            mean = self._w(weights, ly, 0, required=True)
+            var = self._w(weights, ly, 1, required=True)
             factor = self._w(weights, ly, 2)
             if factor is not None:
                 f = factor.reshape(())
@@ -445,7 +470,7 @@ class CaffeNet(Layer):
                 * lax.rsqrt(var.reshape(shape) + eps)
         if t == "Scale":
             p = ly.get("scale_param", {})
-            gamma = self._w(weights, ly, 0)
+            gamma = self._w(weights, ly, 0, required=True)
             # per-channel affine over axis 1, broadcast over trailing dims
             shape = (1, -1) + (1,) * (x.ndim - 2)
             y = x * gamma.reshape(shape)

@@ -99,3 +99,39 @@ def tp_mlp(x, w1, b1, w2, b2, axis_name=MODEL_AXIS, activation=jax.nn.gelu):
     psum per MLP block — the canonical TP transformer feed-forward."""
     h = activation(column_parallel_dense(x, w1, b1))
     return row_parallel_dense(h, w2, b2, axis_name=axis_name)
+
+
+def ep_moe_mlp(x, gate_w, w1, b1, w2, b2, axis_name=None):
+    """Expert-parallel dense-dispatch MoE feed-forward.
+
+    Experts are SHARDED over the mesh ``expert`` axis: each shard holds
+    ``E_local`` experts' weights and computes the gated contribution of its
+    experts for EVERY token; one ``psum`` over the expert axis sums the
+    contributions (and the gate's softmax denominator).  No all_to_all /
+    token routing: tokens stay data/seq-local, weights stay expert-local —
+    the EP capability hook the reference never had (SURVEY.md §2.4).
+
+    Args (inside shard_map, all local views):
+      x: (..., D) tokens (replicated over the expert axis).
+      gate_w: (D, E_local) this shard's columns of the global gate.
+      w1: (E_local, D, F), b1: (E_local, F)
+      w2: (E_local, F, D), b2: (D,) replicated.
+    Returns: (..., D), replicated over the expert axis.
+    """
+    from analytics_zoo_tpu.common.engine import EXPERT_AXIS
+
+    axis_name = axis_name or EXPERT_AXIS
+    # numerically-stable global softmax over experts, computed shard-wise:
+    logits = x @ gate_w  # (..., E_local)
+    # max-subtraction is gradient-neutral; stop_gradient keeps autodiff out
+    # of pmax (which has no differentiation rule)
+    local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+    global_max = jax.lax.pmax(local_max, axis_name)
+    expg = jnp.exp(logits - global_max[..., None])
+    denom = jax.lax.psum(jnp.sum(expg, axis=-1), axis_name)
+    gates = expg / denom[..., None]  # (..., E_local), sums to 1 globally
+    # per-expert MLP, gated and summed over the local experts
+    h = jax.nn.gelu(jnp.einsum("...d,edf->...ef", x, w1) + b1)
+    y_e = jnp.einsum("...ef,efd->...ed", h, w2)
+    local = jnp.einsum("...ed,...e->...d", y_e, gates)
+    return jax.lax.psum(local, axis_name) + b2

@@ -431,8 +431,15 @@ class LRN2D(Layer):
 
 class ResizeBilinear(Layer):
     """Bilinear resize of NHWC images to (out_h, out_w) (reference
-    ResizeBilinear.scala).  Uses jax.image.resize; align_corners follows the
-    TF1 default (False)."""
+    ResizeBilinear.scala, which matches TF1 ``resize_bilinear``).
+
+    TF1 coordinate conventions, reproduced exactly:
+    - align_corners=False: ASYMMETRIC mapping ``src = dst * in/out``
+      (NOT half-pixel-center; jax.image.resize would be half-pixel, which
+      produces different numbers — round-1 advisor finding).
+    - align_corners=True: grid endpoints at the image corners,
+      ``src = dst * (in-1)/(out-1)``.
+    """
 
     def __init__(self, output_height, output_width, align_corners=False,
                  input_shape=None, name=None, **kw):
@@ -445,24 +452,25 @@ class ResizeBilinear(Layer):
                             align_corners=self.align_corners)
 
     def call(self, params, inputs, state=None, training=False, rng=None):
-        b, _, _, c = inputs.shape
-        if not self.align_corners:
-            # antialias=False matches the reference's TF1 resize_bilinear
-            # (and torch interpolate) semantics on downsampling.
-            return jax.image.resize(
-                inputs, (b, self.out_h, self.out_w, c), method="bilinear",
-                antialias=False,
-            )
-        # align_corners: sample grid endpoints at the image corners.
         h, w = inputs.shape[1], inputs.shape[2]
-        ys = jnp.linspace(0.0, h - 1.0, self.out_h)
-        xs = jnp.linspace(0.0, w - 1.0, self.out_w)
+
+        def coords(out_n, in_n):
+            # Per-axis, like TF1: align_corners needs out_n > 1 (the
+            # (in-1)/(out-1) mapping); a singleton axis falls back to the
+            # asymmetric mapping on THAT axis only.
+            if self.align_corners and out_n > 1:
+                return jnp.linspace(0.0, in_n - 1.0, out_n)
+            return jnp.minimum(jnp.arange(out_n) * (in_n / out_n),
+                               in_n - 1.0)
+
+        ys = coords(self.out_h, h)
+        xs = coords(self.out_w, w)
         y0 = jnp.floor(ys).astype(jnp.int32)
         x0 = jnp.floor(xs).astype(jnp.int32)
         y1 = jnp.minimum(y0 + 1, h - 1)
         x1 = jnp.minimum(x0 + 1, w - 1)
-        wy = (ys - y0)[None, :, None, None]
-        wx = (xs - x0)[None, None, :, None]
+        wy = (ys - y0)[None, :, None, None].astype(inputs.dtype)
+        wx = (xs - x0)[None, None, :, None].astype(inputs.dtype)
         gy0 = inputs[:, y0]
         gy1 = inputs[:, y1]
         top = gy0[:, :, x0] * (1 - wx) + gy0[:, :, x1] * wx

@@ -81,8 +81,19 @@ def run(image_size=224, per_chip_batch=256, steps=30, classes=1000,
     if data_dir:
         import glob
 
-        train_set = FeatureSet.from_shards(
-            sorted(glob.glob(f"{data_dir}/*.npz")))
+        tfrec = sorted(glob.glob(f"{data_dir}/*.tfrecord")
+                       + glob.glob(f"{data_dir}/train-*-of-*"))
+        if tfrec:
+            # ImageNet TFRecord layout (image/encoded + image/class/label)
+            from analytics_zoo_tpu.feature.tfrecord import (
+                imagenet_example_parser,
+            )
+            train_set = FeatureSet.from_tfrecord(
+                tfrec, imagenet_example_parser(image_size=image_size,
+                                               label_offset=-1))
+        else:
+            train_set = FeatureSet.from_shards(
+                sorted(glob.glob(f"{data_dir}/*.npz")))
     else:
         n = batch * steps
         rng = np.random.default_rng(0)

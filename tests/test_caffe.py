@@ -258,3 +258,72 @@ layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
     m.fit(x, y, batch_size=32, nb_epoch=250)
     res = m.evaluate(x, y, batch_size=32)
     assert res["accuracy"] > 0.85, res
+
+
+def test_caffe_missing_weights_raises_value_error():
+    # Round-1 advisor finding (b): no .caffemodel used to crash deep in lax
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 4 kernel_size: 3 } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((3, 8, 8))
+    params = net.init_params(__import__("jax").random.PRNGKey(0))
+    with pytest.raises(ValueError, match="model_path"):
+        net.apply(params, jnp.zeros((1, 3, 8, 8), jnp.float32))
+
+
+def test_caffe_lrn_within_channel_oracle():
+    # Round-1 advisor finding (c): norm_region was ignored
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 6 dim: 6 }
+layer { name: "l" type: "LRN" bottom: "data" top: "l"
+        lrn_param { local_size: 3 alpha: 2.0 beta: 0.5
+                    norm_region: WITHIN_CHANNEL } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((2, 6, 6))
+    x = rng0.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    # independent numpy oracle: per-channel 3x3 spatial window
+    sq = np.pad(x ** 2, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    win = sum(sq[:, :, i:i + 6, j:j + 6]
+              for i in range(3) for j in range(3))
+    expect = x / np.power(1.0 + 2.0 / 9.0 * win, 0.5)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_caffe_lrn_across_channels_still_default():
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 4 dim: 2 dim: 2 }
+layer { name: "l" type: "LRN" bottom: "data" top: "l"
+        lrn_param { local_size: 3 alpha: 1.0 beta: 0.75 } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((4, 2, 2))
+    x = rng0.normal(size=(1, 4, 2, 2)).astype(np.float32)
+    out, _ = net.apply({}, jnp.asarray(x))
+    sq = np.pad(x ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    win = sum(sq[:, i:i + 4] for i in range(3))
+    expect = x / np.power(1.0 + 1.0 / 3.0 * win, 0.75)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_caffe_stochastic_pooling_rejected():
+    # Round-1 advisor finding (d): STOCHASTIC silently executed as AVE
+    proto = """
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+        pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 } }
+"""
+    net = CaffeNet(parse_prototxt(proto))
+    net.ensure_built((1, 4, 4))
+    with pytest.raises(NotImplementedError, match="STOCHASTIC"):
+        net.apply({}, jnp.zeros((1, 1, 4, 4), jnp.float32))

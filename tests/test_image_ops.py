@@ -1,0 +1,141 @@
+"""Oracle tests for the image-op library additions (reference
+feature/image/*.scala inventory — ImageBytesToMat, ChannelOrder,
+ChannelScaledNormalizer, Filler, FixedCrop, Mirror, RandomCropper,
+RandomPreprocessing, RandomResize, MatToFloats, AspectScale)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image.transforms import (
+    ImageAspectScale,
+    ImageBytesToMat,
+    ImageChannelOrder,
+    ImageChannelScaledNormalizer,
+    ImageFiller,
+    ImageFixedCrop,
+    ImageMatToFloats,
+    ImageMirror,
+    ImagePixelBytesToMat,
+    ImageRandomCropper,
+    ImageRandomPreprocessing,
+    ImageRandomResize,
+    ImageResize,
+)
+
+
+def _img(h=24, w=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, size=(h, w, 3)).astype(np.uint8)
+
+
+def test_bytes_to_mat_jpeg_roundtrip():
+    import cv2
+
+    img = _img()
+    ok, buf = cv2.imencode(".png", img[:, :, ::-1])  # lossless
+    out = ImageBytesToMat()(buf.tobytes())
+    np.testing.assert_array_equal(out, img)
+    out_bgr = ImageBytesToMat(order="BGR")(buf.tobytes())
+    np.testing.assert_array_equal(out_bgr, img[:, :, ::-1])
+
+
+def test_bytes_to_mat_rejects_garbage():
+    with pytest.raises(ValueError):
+        ImageBytesToMat()(b"not an image")
+
+
+def test_pixel_bytes_to_mat():
+    img = _img(4, 5)
+    out = ImagePixelBytesToMat(4, 5, 3)(img.tobytes())
+    np.testing.assert_array_equal(out, img)
+
+
+def test_channel_order_swaps():
+    img = _img()
+    np.testing.assert_array_equal(ImageChannelOrder()(img),
+                                  img[:, :, ::-1])
+
+
+def test_channel_scaled_normalizer_oracle():
+    img = _img()
+    out = ImageChannelScaledNormalizer(10, 20, 30, 0.5)(img)
+    expect = (img.astype(np.float32) - [10, 20, 30]) * 0.5
+    np.testing.assert_allclose(out, expect)
+
+
+def test_filler_fills_region():
+    img = _img(10, 10)
+    out = ImageFiller(0.2, 0.2, 0.5, 0.5, value=7)(img)
+    assert (out[2:5, 2:5] == 7).all()
+    np.testing.assert_array_equal(out[6:], img[6:])  # rest untouched
+
+
+def test_fixed_crop_normalized_and_pixel():
+    img = _img(20, 40)
+    out = ImageFixedCrop(0.25, 0.5, 0.75, 1.0, normalized=True)(img)
+    np.testing.assert_array_equal(out, img[10:20, 10:30])
+    out2 = ImageFixedCrop(5, 2, 15, 12, normalized=False)(img)
+    np.testing.assert_array_equal(out2, img[2:12, 5:15])
+    # clipping keeps coordinates inside the image
+    out3 = ImageFixedCrop(-5, -5, 999, 999, normalized=False)(img)
+    np.testing.assert_array_equal(out3, img)
+
+
+def test_mirror_deterministic():
+    img = _img()
+    np.testing.assert_array_equal(ImageMirror()(img), img[:, ::-1])
+
+
+def test_random_cropper_shapes_and_center():
+    img = _img(30, 30)
+    out = ImageRandomCropper(12, 10, mirror=False)(img)
+    assert out.shape == (10, 12, 3)
+    c = ImageRandomCropper(12, 10, mirror=False, cropper_method="center")(img)
+    np.testing.assert_array_equal(c, img[10:20, 9:21])
+
+
+def test_random_preprocessing_prob_bounds():
+    img = _img()
+    always = ImageRandomPreprocessing(ImageMirror(), prob=1.0)(img)
+    np.testing.assert_array_equal(always, img[:, ::-1])
+    never = ImageRandomPreprocessing(ImageMirror(), prob=0.0)(img)
+    np.testing.assert_array_equal(never, img)
+
+
+def test_random_resize_short_side_in_range():
+    img = _img(20, 40)
+    out = ImageRandomResize(10, 14)(img)
+    short = min(out.shape[:2])
+    assert 10 <= short <= 14
+    # aspect preserved within rounding
+    assert abs(out.shape[1] / out.shape[0] - 2.0) < 0.2
+
+
+def test_mat_to_floats():
+    img = _img()
+    out = ImageMatToFloats()(img)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, img.astype(np.float32))
+
+
+def test_aspect_scale_respects_max():
+    img = _img(100, 400)
+    out = ImageAspectScale(min_size=60, max_size=120)(img)
+    assert max(out.shape[:2]) <= 120
+    assert abs(out.shape[1] / out.shape[0] - 4.0) < 0.2
+
+
+def test_resize_matches_cv2_oracle():
+    import cv2
+
+    img = _img(17, 23)
+    ours = ImageResize(9, 13)(img)
+    oracle = cv2.resize(img, (13, 9), interpolation=cv2.INTER_LINEAR)
+    # with cv2 present the op IS cv2 (reference backend): exact match
+    np.testing.assert_array_equal(ours, oracle)
+
+
+def test_random_cropper_rejects_small_input():
+    img = _img(8, 8)
+    with pytest.raises(ValueError, match="smaller than crop"):
+        ImageRandomCropper(16, 16)(img)

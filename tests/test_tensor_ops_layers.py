@@ -189,22 +189,44 @@ def test_lrn2d_vs_torch():
     )
 
 
-def test_resize_bilinear_vs_torch():
+def _tf1_resize_bilinear_oracle(x, out_h, out_w):
+    """Independent numpy implementation of TF1 resize_bilinear with
+    align_corners=False: src = dst * in/out (ASYMMETRIC — torch/cv2 use
+    half-pixel, which gives different numbers; round-1 advisor finding)."""
+    b, h, w, c = x.shape
+    out = np.empty((b, out_h, out_w, c), np.float32)
+    for i in range(out_h):
+        sy = min(i * h / out_h, h - 1)
+        y0, wy = int(np.floor(sy)), sy - int(np.floor(sy))
+        y1 = min(y0 + 1, h - 1)
+        for j in range(out_w):
+            sx = min(j * w / out_w, w - 1)
+            x0, wx = int(np.floor(sx)), sx - int(np.floor(sx))
+            x1 = min(x0 + 1, w - 1)
+            top = x[:, y0, x0] * (1 - wx) + x[:, y0, x1] * wx
+            bot = x[:, y1, x0] * (1 - wx) + x[:, y1, x1] * wx
+            out[:, i, j] = top * (1 - wy) + bot * wy
+    return out
+
+
+def test_resize_bilinear_tf1_asymmetric_oracle():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ResizeBilinear
+
+    x = rng0.normal(size=(2, 6, 8, 3)).astype(np.float32)
+    for out_h, out_w in [(3, 4), (11, 5), (6, 8)]:
+        out, _ = apply_layer(ResizeBilinear(out_h, out_w), x)
+        ref = _tf1_resize_bilinear_oracle(x, out_h, out_w)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_resize_bilinear_align_corners_vs_torch():
     import torch
 
     from analytics_zoo_tpu.pipeline.api.keras.layers import ResizeBilinear
 
     x = rng0.normal(size=(2, 6, 8, 3)).astype(np.float32)
     t = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
-
-    out, _ = apply_layer(ResizeBilinear(3, 4), x)
-    ref = torch.nn.functional.interpolate(
-        t, size=(3, 4), mode="bilinear", align_corners=False
-    ).numpy()
-    np.testing.assert_allclose(
-        out, np.transpose(ref, (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
-    )
-
+    # align_corners=True is the one convention torch and TF1 share
     out, _ = apply_layer(ResizeBilinear(11, 5, align_corners=True), x)
     ref = torch.nn.functional.interpolate(
         t, size=(11, 5), mode="bilinear", align_corners=True
@@ -460,3 +482,14 @@ def test_config_roundtrip_args_recorded():
                              propagate_back=False).get_config()
     assert cfg["pad_h"] == 1 and cfg["pad_w"] == 2
     assert cfg["propagate_back"] is False
+
+
+def test_resize_bilinear_align_corners_per_axis():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ResizeBilinear
+
+    # out_w == 1 must not drag the h-axis off the align_corners mapping:
+    # rows sampled at [0, 2, 4] for in_h=5 -> exact input rows
+    x = np.arange(5, dtype=np.float32)[None, :, None, None] * np.ones(
+        (1, 5, 3, 1), np.float32)
+    out, _ = apply_layer(ResizeBilinear(3, 1, align_corners=True), x)
+    np.testing.assert_allclose(np.asarray(out)[0, :, 0, 0], [0.0, 2.0, 4.0])
