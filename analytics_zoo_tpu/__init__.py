@@ -24,6 +24,7 @@ pyzoo/zoo/__init__.py):
 __version__ = "0.1.0"
 
 from analytics_zoo_tpu.common.engine import (  # noqa: F401
+    ZooConfig,
     ZooContext,
     get_zoo_context,
     init_zoo_context,

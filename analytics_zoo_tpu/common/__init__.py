@@ -1,4 +1,5 @@
 from analytics_zoo_tpu.common.engine import (  # noqa: F401
+    ZooConfig,
     ZooContext,
     get_zoo_context,
     init_zoo_context,

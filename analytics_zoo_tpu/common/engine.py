@@ -39,6 +39,62 @@ ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
 
 
 @dataclasses.dataclass
+class ZooConfig:
+    """Typed engine configuration — the reference's three-tier conf system
+    (packaged conf file merged into SparkConf + JVM sysprops + env vars,
+    NNContext.scala:188-237) collapsed into one dataclass with a documented
+    env tier.
+
+    Precedence: explicit ``init_zoo_context`` arguments / conf dict >
+    environment variables > dataclass defaults.
+
+    Environment tier (the reference's sysprop/env knobs):
+      ZOO_COMPUTE_DTYPE        "bf16" | "f32" | "f16" (platform default:
+                               bf16 on TPU, f32 elsewhere)
+      ZOO_FAILURE_RETRY_TIMES  retry-from-checkpoint budget (reference
+                               ``bigdl.failure.retryTimes``, default 5)
+      ZOO_PROFILE_DIR          when set, the Estimator captures ONE
+                               jax.profiler trace of ``profile_steps``
+                               train steps per fit() into this directory
+      ZOO_PROFILE_STEPS        steps per captured trace (default 5)
+      ZOO_INFEED_DEPTH         host->device feeder queue depth (default 2)
+    """
+
+    app_name: str = "analytics-zoo-tpu"
+    seed: int = 0
+    mesh_shape: Mapping[str, int] | None = None
+    mesh_axes: Sequence[str] = (DATA_AXIS, MODEL_AXIS)
+    platform: str | None = None
+    compute_dtype: object = None
+    # None = "not explicitly set": resolved env > default in __post_init__,
+    # so an explicit value always beats the environment (the documented
+    # precedence) even when it equals the default.
+    failure_retry_times: int | None = None
+    profile_dir: str | None = None
+    profile_steps: int | None = None
+    infeed_depth: int | None = None
+
+    def __post_init__(self):
+        env = os.environ
+
+        def resolve(value, env_key, default, cast=int):
+            if value is not None:
+                return value
+            if env_key in env:
+                return cast(env[env_key])
+            return default
+
+        self.failure_retry_times = resolve(
+            self.failure_retry_times, "ZOO_FAILURE_RETRY_TIMES", 5)
+        self.profile_steps = resolve(
+            self.profile_steps, "ZOO_PROFILE_STEPS", 5)
+        self.infeed_depth = resolve(
+            self.infeed_depth, "ZOO_INFEED_DEPTH", 2)
+        if self.profile_dir is None:
+            self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
+
+
+@dataclasses.dataclass
 class ZooContext:
     """Runtime context: the device mesh plus engine-level knobs.
 
@@ -55,6 +111,7 @@ class ZooContext:
     # Master params, optimizer state and loss stay f32 — the standard TPU
     # mixed-precision recipe that keeps the MXU at bf16 rate.
     compute_dtype: object = None
+    config: "ZooConfig" = dataclasses.field(default_factory=lambda: ZooConfig())
     _step_rng: jax.Array | None = None
 
     @property
@@ -207,24 +264,42 @@ def init_zoo_context(
       platform: force a jax platform ("cpu", "tpu"); tests use cpu meshes.
     """
     global _CONTEXT
-    if isinstance(conf, str):
-        conf = {"app_name": conf}
-    conf = dict(conf or {})
-    seed = int(conf.get("seed", seed))
-    mesh_shape = conf.get("mesh_shape", mesh_shape)
-    platform = conf.get("platform", platform)
-    compute_dtype = conf.get("compute_dtype", compute_dtype)
+    if isinstance(conf, ZooConfig):
+        cfg = dataclasses.replace(conf)  # never mutate the caller's config
+    else:
+        if isinstance(conf, str):
+            conf = {"app_name": conf}
+        conf = dict(conf or {})
+        known = {f.name for f in dataclasses.fields(ZooConfig)}
+        cfg = ZooConfig(**{k: v for k, v in conf.items() if k in known})
+        unknown = set(conf) - known
+        if unknown:
+            raise ValueError(
+                f"unknown conf keys {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+    if seed != 0 and cfg.seed == 0:
+        cfg.seed = int(seed)
+    if mesh_shape is not None and cfg.mesh_shape is None:
+        cfg.mesh_shape = mesh_shape
+    if tuple(mesh_axes) != (DATA_AXIS, MODEL_AXIS) and \
+            tuple(cfg.mesh_axes) == (DATA_AXIS, MODEL_AXIS):
+        cfg.mesh_axes = tuple(mesh_axes)
+    if platform is not None and cfg.platform is None:
+        cfg.platform = platform
+    if compute_dtype is not None and cfg.compute_dtype is None:
+        cfg.compute_dtype = compute_dtype
 
-    devices = jax.devices(platform) if platform else jax.devices()
-    axes = tuple(mesh_axes)
-    shape = _infer_mesh_shape(devices, axes, mesh_shape)
+    devices = jax.devices(cfg.platform) if cfg.platform else jax.devices()
+    axes = tuple(cfg.mesh_axes)
+    shape = _infer_mesh_shape(devices, axes, cfg.mesh_shape)
     n_used = math.prod(shape.values())
     dev_array = np.asarray(devices[:n_used]).reshape([shape[a] for a in axes])
     mesh = Mesh(dev_array, axes)
     ctx = ZooContext(
-        mesh=mesh, platform=devices[0].platform, seed=seed,
+        mesh=mesh, platform=devices[0].platform, seed=cfg.seed,
         compute_dtype=_resolve_compute_dtype(
-            compute_dtype, devices[0].platform),
+            cfg.compute_dtype, devices[0].platform),
+        config=cfg,
     )
     with _LOCK:
         _CONTEXT = ctx

@@ -14,7 +14,14 @@ from collections import defaultdict
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
-_TIMINGS: dict[str, list[float]] = defaultdict(list)
+def _new_agg() -> dict:
+    return {"count": 0, "total": 0.0, "min": float("inf"), "max": 0.0}
+
+
+# Per-name AGGREGATES (count/total/min/max), not per-call lists: time_it
+# wraps every train-step infeed+dispatch, so lists would grow without bound
+# over multi-day jobs.
+_TIMINGS: dict[str, dict] = defaultdict(_new_agg)
 
 
 @contextlib.contextmanager
@@ -25,13 +32,18 @@ def time_it(name: str, log: bool = False):
         yield
     finally:
         dt = time.perf_counter() - t0
-        _TIMINGS[name].append(dt)
+        agg = _TIMINGS[name]
+        agg["count"] += 1
+        agg["total"] += dt
+        agg["min"] = min(agg["min"], dt)
+        agg["max"] = max(agg["max"], dt)
         if log:
             logger.info("[%s] %.3f ms", name, dt * 1e3)
 
 
-def get_timings() -> dict[str, list[float]]:
-    return dict(_TIMINGS)
+def get_timings() -> dict[str, dict]:
+    """name -> {count, total, min, max} (seconds)."""
+    return {k: dict(v) for k, v in _TIMINGS.items()}
 
 
 def reset_timings() -> None:

@@ -33,6 +33,12 @@ from analytics_zoo_tpu.models.image.objectdetection.ssd import (
     ssd_tiny,
     ssd_vgg300,
 )
+from analytics_zoo_tpu.models.image.objectdetection.coco import (
+    COCO_CAT_ID_TO_IND,
+    COCO_CLASSES,
+    Coco,
+    load_coco_annotation,
+)
 from analytics_zoo_tpu.models.image.objectdetection.voc import (
     VOC_CLASS_TO_IND,
     VOC_CLASSES,
@@ -48,4 +54,5 @@ __all__ = [
     "PriorSpec", "SSD300_SPECS", "generate_priors",
     "ssd_vgg300", "ssd_tiny",
     "PascalVoc", "VOC_CLASSES", "VOC_CLASS_TO_IND", "load_voc_annotation",
+    "Coco", "COCO_CLASSES", "COCO_CAT_ID_TO_IND", "load_coco_annotation",
 ]

@@ -164,6 +164,16 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     )(q, k, v)
 
 
+def _resolve_blocks(lq: int, block_q, block_k) -> tuple[int, int]:
+    """Tuned defaults (v5e sweep, FLASH_r03.json): big blocks amortize
+    grid-step overhead; VMEM caps block_q at 1024 once lq >= 8192."""
+    if block_q is None:
+        block_q = 2048 if lq <= 4096 else 1024
+    if block_k is None:
+        block_k = 1024
+    return block_q, block_k
+
+
 def _pallas_available() -> bool:
     return jax.default_backend() == "tpu"
 
@@ -172,10 +182,16 @@ _warned_fallback = False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=256):
-    """Fused attention: Pallas kernel on TPU, jnp fallback elsewhere."""
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None):
+    """Fused attention: Pallas kernel on TPU, jnp fallback elsewhere.
+
+    Default blocks are tuned from the v5e sweep in FLASH_r03.json:
+    (2048, 1024) sustains 112 TF vs 24 TF at 256x256 (grid-step overheads
+    dominate small blocks), but the scoped-VMEM budget caps block_q at
+    1024 for sequences >= 8192 — ``_resolve_blocks`` encodes both."""
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    block_q, block_k = _resolve_blocks(q.shape[2], block_q, block_k)
     if _pallas_available():
         try:
             return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
@@ -215,6 +231,7 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     lk = k.shape[2]
     scale_v = 1.0 / math.sqrt(d) if scale is None else scale
     offset = lk - lq
+    _, block_k = _resolve_blocks(lq, block_q, block_k)
     bk = min(block_k, lk)
     n_k = -(-lk // bk)
     pad = n_k * bk - lk

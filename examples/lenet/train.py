@@ -69,6 +69,30 @@ def synthetic_mnist(n_train=4096, n_test=1024, seed=0):
     return make(n_train), make(n_test)
 
 
+def run(data_dir=None, batch_size=256, epochs=2, lr=0.01, limit=4096):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.lenet import build_lenet
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+
+    init_zoo_context("lenet example")
+    if data_dir:
+        (xtr, ytr), (xte, yte) = load_mnist(data_dir)
+    else:
+        (xtr, ytr), (xte, yte) = synthetic_mnist(limit)
+
+    def prep(x):
+        return ((x.astype(np.float32) / 255.0) - 0.1307)[..., None] / 0.3081
+
+    model = build_lenet()
+    model.compile(optimizer=SGD(lr=lr, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(prep(xtr), ytr.astype(np.int32), batch_size=batch_size,
+              nb_epoch=epochs)
+    return model.evaluate(prep(xte), yte.astype(np.int32),
+                          batch_size=batch_size)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data-dir", default=None,
@@ -79,28 +103,8 @@ def main():
     ap.add_argument("--n-train", type=int, default=4096,
                     help="synthetic train size")
     args = ap.parse_args()
-
-    from analytics_zoo_tpu import init_zoo_context
-    from analytics_zoo_tpu.models.lenet import build_lenet
-    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
-
-    init_zoo_context("lenet example")
-    if args.data_dir:
-        (xtr, ytr), (xte, yte) = load_mnist(args.data_dir)
-    else:
-        (xtr, ytr), (xte, yte) = synthetic_mnist(args.n_train)
-
-    def prep(x):
-        return ((x.astype(np.float32) / 255.0) - 0.1307)[..., None] / 0.3081
-
-    model = build_lenet()
-    model.compile(optimizer=SGD(lr=args.lr, momentum=0.9),
-                  loss="sparse_categorical_crossentropy",
-                  metrics=["accuracy"])
-    model.fit(prep(xtr), ytr.astype(np.int32), batch_size=args.batch_size,
-              nb_epoch=args.epochs)
-    results = model.evaluate(prep(xte), yte.astype(np.int32),
-                             batch_size=args.batch_size)
+    results = run(args.data_dir, args.batch_size, args.epochs, args.lr,
+                  args.n_train)
     print({k: round(float(v), 4) for k, v in results.items()})
 
 

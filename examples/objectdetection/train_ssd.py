@@ -1,0 +1,78 @@
+"""SSD object-detection training example — Pascal VOC (reference
+zoo/.../examples/objectdetection + SSDDataSet.scala pipeline:
+VOC -> roi transforms -> SSD -> MultiBoxLoss -> mAP).
+
+--voc-root points at a VOCdevkit folder; the default is the checked-in
+VOCmini fixture (3 classes), so the example always runs.
+
+Usage:
+    python examples/objectdetection/train_ssd.py --epochs 30
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+VOC_MINI = os.path.join(REPO, "tests", "resources", "VOCmini")
+MINI_CLASSES = ("car", "person", "dog")
+
+
+def run(voc_root=VOC_MINI, year="2007", classes=MINI_CLASSES,
+        resolution=64, variant="ssd-tiny", epochs=30, batch_size=8,
+        max_boxes=4, lr=1e-3):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.image import ssd_train_set, ssd_val_set
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        ObjectDetector,
+        PascalVoc,
+        mean_average_precision,
+    )
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    init_zoo_context("ssd voc")
+    class_map = {c: float(i + 1) for i, c in enumerate(classes)}
+    train_recs = PascalVoc(voc_root, year, "train",
+                           class_to_ind=class_map).roidb()
+    val_recs = PascalVoc(voc_root, year, "val",
+                         class_to_ind=class_map).roidb()
+    train = ssd_train_set(train_recs, resolution=resolution,
+                          max_boxes=max_boxes, label_offset=-1)
+    val = ssd_val_set(val_recs, resolution=resolution,
+                      max_boxes=max_boxes, label_offset=-1)
+
+    val_batches = list(val.batches(batch_size, shuffle=False,
+                                   drop_last=False))
+    val_x = np.concatenate([b["x"] for b in val_batches])
+    gts = [dict(boxes=r[r[:, 4] >= 0][:, :4], classes=r[r[:, 4] >= 0][:, 4])
+           for b in val_batches for r in b["y"]]
+
+    det = ObjectDetector(variant, class_names=classes)
+    det.compile(Adam(lr=lr))
+    det.model.fit(train, batch_size=batch_size, nb_epoch=epochs)
+    dets = det.predict_image_set(val_x, conf_threshold=0.05)
+    m = mean_average_precision(dets, gts, len(classes), iou_threshold=0.3)
+    return m, det
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--voc-root", default=VOC_MINI,
+                    help="VOCdevkit folder (default: VOCmini fixture)")
+    ap.add_argument("--year", default="2007")
+    ap.add_argument("--variant", default="ssd-tiny",
+                    choices=("ssd-tiny", "ssd-vgg16-300"))
+    ap.add_argument("--resolution", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args()
+    m, _ = run(args.voc_root, args.year, resolution=args.resolution,
+               variant=args.variant, epochs=args.epochs,
+               batch_size=args.batch_size)
+    print(f"VOC mAP@0.3: {m:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""NeuralCF recommendation example — movielens-style (reference
+pyzoo/zoo/examples/recommendation/ncf_explicit_example.py: ratings ->
+NeuralCF -> fit -> recommend_for_user).
+
+With --ratings, expects MovieLens ``user::item::rating::ts`` lines.
+Without, synthetic ratings with planted user/item affinity blocks.
+
+Usage:
+    python examples/recommendation/neuralcf.py --epochs 8
+"""
+
+import argparse
+
+import numpy as np
+
+
+def load_ratings(path=None, n_users=200, n_items=100, n=6000, seed=0):
+    if path:
+        users, items, ratings = [], [], []
+        with open(path) as f:
+            for line in f:
+                u, i, r, *_ = line.strip().split("::")
+                users.append(int(u) - 1)
+                items.append(int(i) - 1)
+                ratings.append(float(r))
+        users, items = np.asarray(users), np.asarray(items)
+        labels = (np.asarray(ratings) >= 4).astype(np.int32)  # implicit
+        return users, items, labels, users.max() + 1, items.max() + 1
+    # synthetic: users like items in their own block
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, size=n)
+    items = rng.integers(0, n_items, size=n)
+    affinity = (users % 4) == (items % 4)
+    noise = rng.random(n) < 0.1
+    labels = (affinity ^ noise).astype(np.int32)
+    return users.astype(np.int32), items.astype(np.int32), labels, \
+        n_users, n_items
+
+
+def run(ratings=None, epochs=8, batch_size=256):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_zoo_context("neuralcf")
+    users, items, labels, n_users, n_items = load_ratings(ratings)
+    n_train = int(0.9 * len(users))
+    ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+                   hidden_layers=(40, 20, 10))
+    ncf.compile(optimizer="adam",
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    ncf.fit([users[:n_train], items[:n_train]], labels[:n_train],
+            batch_size=batch_size, nb_epoch=epochs)
+    results = ncf.evaluate([users[n_train:], items[n_train:]],
+                           labels[n_train:], batch_size=batch_size)
+    recs = ncf.recommend_for_user(
+        user_id=0, candidate_items=np.arange(n_items), max_items=5)
+    return results, recs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ratings", default=None,
+                    help="movielens ratings.dat (default: synthetic)")
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args()
+    results, recs = run(args.ratings, args.epochs, args.batch_size)
+    print("test:", {k: round(v, 4) for k, v in results.items()})
+    print("top-5 items for user 0:", recs)
+
+
+if __name__ == "__main__":
+    main()

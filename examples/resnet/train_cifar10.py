@@ -52,6 +52,54 @@ def synthetic_cifar(n_train=4096, n_test=1024, seed=0):
     return make(n_train), make(n_test)
 
 
+def run(data_dir=None, depth=20, batch_size=128, epochs=10, lr=0.1,
+        n_train=4096, steps=None, per_chip_batch=None):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD,
+        warmup_epoch_decay,
+    )
+
+    ctx = init_zoo_context("resnet cifar10 example")
+    if per_chip_batch is not None:
+        batch_size = per_chip_batch * max(ctx.data_parallel_size, 1)
+    if data_dir:
+        (xtr, ytr), (xte, yte) = load_cifar10(data_dir)
+    else:
+        (xtr, ytr), (xte, yte) = synthetic_cifar(n_train)
+    if steps is not None:
+        n = max(batch_size * steps, batch_size)
+        xtr, ytr = xtr[:n], ytr[:n]
+        xte, yte = xte[:n], yte[:n]
+        epochs = 1
+
+    mean = np.asarray([125.3, 123.0, 113.9], np.float32)
+    std = np.asarray([63.0, 62.1, 66.7], np.float32)
+
+    def prep(x):
+        return (x.astype(np.float32) - mean) / std
+
+    spe = max(len(xtr) // batch_size, 1)
+    model = ResNet.cifar(depth=depth)
+    # TrainImageNet.scala LR recipe: linear warmup then epoch-step decay.
+    schedule = warmup_epoch_decay(
+        warmup_steps=spe, steps_per_epoch=spe,
+        boundaries_epochs=(max(epochs // 2, 1), max(3 * epochs // 4, 2)),
+        decay=0.1,
+    )
+    model.compile(
+        optimizer=SGD(lr=lr, momentum=0.9, weight_decay=1e-4,
+                      schedule=schedule),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    model.fit(prep(xtr), ytr.astype(np.int32), batch_size=batch_size,
+              nb_epoch=epochs)
+    return model.evaluate(prep(xte), yte.astype(np.int32),
+                          batch_size=batch_size)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data-dir", default=None)
@@ -62,44 +110,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--n-train", type=int, default=4096)
     args = ap.parse_args()
-
-    from analytics_zoo_tpu import init_zoo_context
-    from analytics_zoo_tpu.models.resnet import ResNet
-    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
-        SGD,
-        warmup_epoch_decay,
-    )
-
-    init_zoo_context("resnet cifar10 example")
-    if args.data_dir:
-        (xtr, ytr), (xte, yte) = load_cifar10(args.data_dir)
-    else:
-        (xtr, ytr), (xte, yte) = synthetic_cifar(args.n_train)
-
-    mean = np.asarray([125.3, 123.0, 113.9], np.float32)
-    std = np.asarray([63.0, 62.1, 66.7], np.float32)
-
-    def prep(x):
-        return (x.astype(np.float32) - mean) / std
-
-    steps = len(xtr) // args.batch_size
-    model = ResNet.cifar(depth=args.depth)
-    # TrainImageNet.scala LR recipe: linear warmup then epoch-step decay.
-    schedule = warmup_epoch_decay(
-        warmup_steps=steps, steps_per_epoch=steps,
-        boundaries_epochs=(args.epochs // 2, 3 * args.epochs // 4),
-        decay=0.1,
-    )
-    model.compile(
-        optimizer=SGD(lr=args.lr, momentum=0.9, weight_decay=1e-4,
-                      schedule=schedule),
-        loss="sparse_categorical_crossentropy",
-        metrics=["accuracy"],
-    )
-    model.fit(prep(xtr), ytr.astype(np.int32), batch_size=args.batch_size,
-              nb_epoch=args.epochs)
-    results = model.evaluate(prep(xte), yte.astype(np.int32),
-                             batch_size=args.batch_size)
+    results = run(args.data_dir, args.depth, args.batch_size, args.epochs,
+                  args.lr, args.n_train)
     print({k: round(float(v), 4) for k, v in results.items()})
 
 

@@ -1,0 +1,89 @@
+"""Cluster Serving demo — embedded server + client (reference
+serving/ClusterServing.scala loop + pyzoo/zoo/serving/client.py usage:
+enqueue images to the stream, server micro-batches + predicts + writes
+results back, client queries them).
+
+Runs fully self-contained: trains a tiny classifier, starts the serving
+loop on a background thread over an in-memory broker (use --spool DIR for
+the multi-process FileBroker instead), pushes images, prints predictions.
+
+Usage:
+    python examples/serving/demo.py --n 8
+"""
+
+import argparse
+import tempfile
+import threading
+
+import numpy as np
+
+
+def make_model(path, size=8):
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Flatten
+
+    m = Sequential()
+    m.add(Flatten(input_shape=(size, size, 1)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.random((128, size, size, 1)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0.5).astype(np.int32)
+    m.fit(x, y, batch_size=32, nb_epoch=10)
+    m.save(path, over_write=True)
+    return path
+
+
+def run(n=8, size=8, spool=None):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.serving import (
+        ClusterServing,
+        ClusterServingHelper,
+        FileBroker,
+        InMemoryBroker,
+        InputQueue,
+        OutputQueue,
+    )
+
+    init_zoo_context("serving demo")
+    tmp = tempfile.mkdtemp()
+    model_path = make_model(tmp + "/model.zoo", size)
+    broker = FileBroker(spool) if spool else InMemoryBroker()
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(size, size, 1),
+                             log_dir=tmp + "/logs"),
+        broker=broker)
+    server = threading.Thread(
+        target=lambda: serving.run(max_records=n), daemon=True)
+    server.start()
+
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    rng = np.random.default_rng(1)
+    expected = []
+    for i in range(n):
+        img = rng.random((size, size, 1)).astype(np.float32)
+        expected.append(int(img.mean() > 0.5))
+        inq.enqueue_image(f"img-{i}", img)
+    server.join(timeout=60)
+
+    results = {}
+    for i in range(n):
+        results[f"img-{i}"] = outq.query(f"img-{i}")
+    return results, expected
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=8)
+    ap.add_argument("--spool", default=None,
+                    help="directory for a FileBroker (default: in-memory)")
+    args = ap.parse_args()
+    results, expected = run(args.n, spool=args.spool)
+    for (uri, res), exp in zip(sorted(results.items()), expected):
+        print(f"{uri}: {res}  (true class {exp})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Typed ZooConfig (reference three-tier conf, NNContext.scala:188-237)
++ the estimator profiler/timing knobs."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import ZooConfig, init_zoo_context
+from analytics_zoo_tpu.common.utils import get_timings, reset_timings
+
+
+def _fit_tiny(nb_epoch=1):
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(2, activation="softmax", input_shape=(4,)))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=8, nb_epoch=nb_epoch)
+    return m
+
+
+def test_zooconfig_from_dict_and_env(monkeypatch):
+    monkeypatch.setenv("ZOO_FAILURE_RETRY_TIMES", "2")
+    monkeypatch.setenv("ZOO_INFEED_DEPTH", "3")
+    ctx = init_zoo_context({"app_name": "t", "seed": 11})
+    assert ctx.config.seed == 11
+    assert ctx.config.failure_retry_times == 2   # env tier
+    assert ctx.config.infeed_depth == 3
+    # explicit arg beats env
+    ctx = init_zoo_context(ZooConfig(failure_retry_times=9))
+    assert ctx.config.failure_retry_times == 9
+
+
+def test_unknown_conf_key_rejected():
+    with pytest.raises(ValueError, match="unknown conf"):
+        init_zoo_context({"not_a_knob": 1})
+
+
+def test_profiler_knob_writes_trace(tmp_path):
+    prof = str(tmp_path / "prof")
+    init_zoo_context(ZooConfig(profile_dir=prof, profile_steps=2))
+    _fit_tiny(nb_epoch=2)
+    traces = glob.glob(os.path.join(prof, "**", "*.trace.json.gz"),
+                       recursive=True)
+    assert traces, f"no trace under {prof}"
+    init_zoo_context(seed=0)  # reset global ctx for other tests
+
+
+def test_time_it_records_infeed_and_step():
+    init_zoo_context(seed=0)
+    reset_timings()
+    _fit_tiny()
+    t = get_timings()
+    assert "zoo.infeed" in t and "zoo.step_dispatch" in t
+    assert t["zoo.step_dispatch"]["count"] == 8  # 64/8 batches
+
+
+def test_explicit_value_beats_env(monkeypatch):
+    monkeypatch.setenv("ZOO_FAILURE_RETRY_TIMES", "0")
+    # explicit value equal to the default must still win over env
+    ctx = init_zoo_context({"failure_retry_times": 5})
+    assert ctx.config.failure_retry_times == 5
+
+
+def test_caller_config_not_mutated():
+    cfg = ZooConfig()
+    init_zoo_context(cfg, seed=42)
+    assert cfg.seed == 0  # caller's object untouched

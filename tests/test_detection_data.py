@@ -162,3 +162,80 @@ class TestSSDTrainsOnVocFixture:
         after = score()
         assert after > before, (before, after)
         assert after > 0.2, (before, after)
+
+
+class TestCocoParsing:
+    def _mini_instances(self, tmp_path):
+        import json
+
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        imgs, anns = [], []
+        for i in range(3):
+            arr = rng.integers(0, 255, size=(32, 48, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"im{i}.jpg")
+            imgs.append({"id": i, "file_name": f"im{i}.jpg",
+                         "width": 48, "height": 32})
+            anns.append({"id": 10 + i, "image_id": i, "category_id": 3,
+                         "bbox": [4, 5, 20, 15], "area": 300,
+                         "iscrowd": 0})
+        # one degenerate box + one unknown category: must be skipped
+        anns.append({"id": 99, "image_id": 0, "category_id": 3,
+                     "bbox": [4, 5, 0, 0], "area": 0, "iscrowd": 0})
+        anns.append({"id": 98, "image_id": 0, "category_id": 12,
+                     "bbox": [1, 1, 5, 5], "area": 25, "iscrowd": 0})
+        p = tmp_path / "instances.json"
+        with open(p, "w") as f:
+            json.dump({"images": imgs, "annotations": anns}, f)
+        return str(p)
+
+    def test_instances_json(self, tmp_path):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            COCO_CAT_ID_TO_IND,
+            COCO_CLASSES,
+            Coco,
+        )
+
+        path = self._mini_instances(tmp_path)
+        recs = Coco(str(tmp_path), instances_json=path).roidb()
+        assert len(recs) == 3
+        r = recs[0]
+        assert r["image"].shape == (32, 48, 3)
+        # degenerate + unknown-category annotations skipped
+        assert r["boxes"].shape == (1, 4)
+        # category_id 3 (car) -> dense index
+        assert r["classes"][0] == COCO_CAT_ID_TO_IND[3]
+        assert COCO_CLASSES[int(r["classes"][0])] == "car"
+        # corners clipped semantics: x2 = x1 + w - 1
+        np.testing.assert_allclose(r["boxes"][0], [4, 5, 23, 19])
+
+    def test_devkit_layout(self, tmp_path):
+        import json
+
+        from PIL import Image
+        rng = np.random.default_rng(1)
+        (tmp_path / "ImageSets").mkdir()
+        arr = rng.integers(0, 255, size=(20, 20, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / "a.jpg")
+        with open(tmp_path / "a.json", "w") as f:
+            json.dump({"image": {"width": 20, "height": 20},
+                       "annotation": [{"bbox": [2, 2, 10, 10], "area": 100,
+                                       "category_id": 1}]}, f)
+        with open(tmp_path / "ImageSets" / "train.txt", "w") as f:
+            f.write("a.jpg a.json\n")
+        from analytics_zoo_tpu.models.image.objectdetection import Coco
+
+        recs = Coco(str(tmp_path), "train").roidb()
+        assert len(recs) == 1 and recs[0]["boxes"].shape == (1, 4)
+        assert recs[0]["classes"][0] == 1.0  # person
+
+    def test_edge_crossing_bbox_clipped_not_shifted(self, tmp_path):
+        from analytics_zoo_tpu.models.image.objectdetection.coco import (
+            _boxes_from_annotations,
+        )
+
+        boxes, classes, _ = _boxes_from_annotations(
+            [{"bbox": [-5, 0, 10, 10], "category_id": 1, "area": 100}],
+            48.0, 32.0, {1: 1})
+        # raw corners: x in [-5, 4]; clipped to [0, 4] — NOT [0, 9]
+        np.testing.assert_allclose(boxes[0], [0, 0, 4, 9])

@@ -1,0 +1,103 @@
+"""Flash-attention micro-bench on compiled TPU (not interpret mode).
+
+Times the Pallas kernel vs the jnp O(L^2) reference at long context, both
+inside one jit with a scan of dependent iterations (the only reliable
+timing shape on this harness — see PROFILE_r03/ANALYSIS.md), and verifies
+numerics vs the reference on the first block.  Writes FLASH_r03.json.
+
+Usage: python tools/flash_bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops.pallas.flash_attention import (
+    _attention_reference,
+    _flash_fwd_pallas,
+    _resolve_blocks,
+)
+
+FETCH_S = 0.070  # tunnel fixed fetch latency (PROFILE_r03/ANALYSIS.md)
+
+
+def timed(fn, q, k, v, reps=10):
+    @jax.jit
+    def loop(q, k, v):
+        def body(c, _):
+            o = fn(c, k, v)
+            s = jnp.tanh(jnp.sum(o.astype(jnp.float32))) * 1e-6
+            return c + s.astype(c.dtype), ()
+        c, _ = jax.lax.scan(body, q, None, length=reps)
+        return jnp.sum(c.astype(jnp.float32))
+
+    float(loop(q, k, v))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(loop(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return max(best - FETCH_S, 1e-9) / reps
+
+
+def main():
+    d = jax.devices()[0]
+    out = {"device": d.device_kind, "platform": d.platform,
+           "mode": "compiled (not interpret)"}
+    results = []
+    for L in (4096, 8192):
+        B, H, D = 4, 8, 64
+        key = jax.random.PRNGKey(0)
+        q = (jax.random.normal(key, (B, H, L, D)) * 0.3).astype(jnp.bfloat16)
+        k = (jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
+             * 0.3).astype(jnp.bfloat16)
+        v = (jax.random.normal(jax.random.PRNGKey(2), (B, H, L, D))
+             * 0.3).astype(jnp.bfloat16)
+        scale = 1.0 / np.sqrt(D)
+
+        bq, bk = _resolve_blocks(L, None, None)
+        flash = lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, False, scale, bq, bk)
+        ref = lambda q, k, v: _attention_reference(q, k, v, False, scale)
+
+        # numerics: compiled Pallas vs reference on one batch row (the
+        # dense path's f32 L x L matrix at full batch OOMs 16G HBM at 8k)
+        got = np.asarray(jax.jit(flash)(q[:1], k[:1], v[:1]), np.float32)
+        want = np.asarray(jax.jit(ref)(q[:1], k[:1], v[:1]), np.float32)
+        err = float(np.max(np.abs(got - want)))
+        t_flash = timed(flash, q, k, v)
+        flops = 4 * B * H * L * L * D  # 2 matmuls, 2*L*L*D each
+        row = {
+            "seq_len": L, "batch": B, "heads": H, "head_dim": D,
+            "flash_ms": round(t_flash * 1e3, 2),
+            "flash_tflops": round(flops / t_flash / 1e12, 1),
+            "max_abs_err_vs_reference": round(err, 4),
+        }
+        try:
+            t_ref = timed(ref, q, k, v)
+            row["jnp_ms"] = round(t_ref * 1e3, 2)
+            row["speedup"] = round(t_ref / t_flash, 2)
+        except Exception as e:  # noqa: BLE001 — record the OOM, don't die
+            msg = str(e)
+            row["jnp_ms"] = None
+            row["jnp_error"] = ("OOM: dense O(L^2) attention exceeds HBM"
+                                if "memory" in msg.lower() else
+                                msg.splitlines()[0][:200])
+            row["speedup"] = None
+        results.append(row)
+    out["results"] = results
+    path = os.path.join(os.path.dirname(__file__), "..", "FLASH_r03.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
