@@ -243,8 +243,8 @@ def init_zoo_context(
     conf: Mapping[str, object] | str | None = None,
     *,
     mesh_shape: Mapping[str, int] | None = None,
-    mesh_axes: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
-    seed: int = 0,
+    mesh_axes: Sequence[str] | None = None,
+    seed: int | None = None,
     platform: str | None = None,
     compute_dtype=None,
 ) -> ZooContext:
@@ -277,16 +277,18 @@ def init_zoo_context(
             raise ValueError(
                 f"unknown conf keys {sorted(unknown)}; "
                 f"valid: {sorted(known)}")
-    if seed != 0 and cfg.seed == 0:
+    # Keyword args use None as the "not given" sentinel, so an explicitly
+    # passed kwarg ALWAYS wins over the conf/config value (no ambiguity
+    # when the explicit value happens to equal a default).
+    if seed is not None:
         cfg.seed = int(seed)
-    if mesh_shape is not None and cfg.mesh_shape is None:
+    if mesh_shape is not None:
         cfg.mesh_shape = mesh_shape
-    if tuple(mesh_axes) != (DATA_AXIS, MODEL_AXIS) and \
-            tuple(cfg.mesh_axes) == (DATA_AXIS, MODEL_AXIS):
+    if mesh_axes is not None:
         cfg.mesh_axes = tuple(mesh_axes)
-    if platform is not None and cfg.platform is None:
+    if platform is not None:
         cfg.platform = platform
-    if compute_dtype is not None and cfg.compute_dtype is None:
+    if compute_dtype is not None:
         cfg.compute_dtype = compute_dtype
 
     devices = jax.devices(cfg.platform) if cfg.platform else jax.devices()

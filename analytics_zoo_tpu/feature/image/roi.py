@@ -286,8 +286,18 @@ class RoiFeatureSet(FeatureSet):
 
     def _materialize(self, ri: int, seed: int, epoch: int):
         rec = self.records[ri]
+        image = rec.get("image")
+        if image is None:
+            # Lazy loading for full-scale datasets: PascalVoc/Coco
+            # roidb(read_image=False) records carry only "path", so the
+            # whole split is never resident at once (COCO train2017 would
+            # be ~60 GB decoded).
+            from PIL import Image
+
+            with Image.open(rec["path"]) as im:
+                image = np.asarray(im.convert("RGB"))
         rec = {
-            "image": rec["image"],
+            "image": image,
             "boxes": np.asarray(rec["boxes"], np.float32).reshape(-1, 4),
             "classes": np.asarray(rec.get("classes", []), np.float32),
             "difficult": np.asarray(

@@ -4,6 +4,7 @@ from analytics_zoo_tpu.models.anomalydetection import (  # noqa: F401
     AnomalyDetector,
 )
 from analytics_zoo_tpu.models.common import Ranker, ZooModel  # noqa: F401
+from analytics_zoo_tpu.models.inception import Inception  # noqa: F401
 from analytics_zoo_tpu.models.lenet import build_lenet  # noqa: F401
 from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
     ColumnFeatureInfo,

@@ -1,0 +1,101 @@
+"""Inception-v1 (GoogLeNet) — the reference's flagship ImageNet training
+example (zoo/.../examples/inception/Train.scala:31-120 trains BigDL's
+Inception_v1_NoAuxClassifier; python twin
+pyzoo/zoo/examples/inception/inception.py:119-165).
+
+NHWC graph built on the Model API: every inception block is four parallel
+towers merged on the channel axis — all MXU convolutions in one XLA
+program.  LRN layers match the reference's SpatialCrossMapLRN(5, 1e-4,
+0.75) placement.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    LRN2D,
+    AveragePooling2D,
+    Convolution2D,
+    Dense,
+    Dropout,
+    Flatten,
+    MaxPooling2D,
+    Merge,
+)
+
+# (1x1, [3x3_reduce, 3x3], [5x5_reduce, 5x5], pool_proj) per block —
+# inception.py:137-157 configs
+_V1_BLOCKS = {
+    "3a": (64, (96, 128), (16, 32), 32),
+    "3b": (128, (128, 192), (32, 96), 64),
+    "4a": (192, (96, 208), (16, 48), 64),
+    "4b": (160, (112, 224), (24, 64), 64),
+    "4c": (128, (128, 256), (24, 64), 64),
+    "4d": (112, (144, 288), (32, 64), 64),
+    "4e": (256, (160, 320), (32, 128), 128),
+    "5a": (256, (160, 320), (32, 128), 128),
+    "5b": (384, (192, 384), (48, 128), 128),
+}
+
+
+def _conv(x, filters, k, stride=1, name=None):
+    return Convolution2D(filters, k, k, subsample=(stride, stride),
+                         border_mode="same", activation="relu",
+                         init="glorot_uniform", name=name)(x)
+
+
+def _inception_block(x, key: str):
+    """inception_layer_v1 (inception.py:83-117): 1x1 | 1x1->3x3 |
+    1x1->5x5 | maxpool->1x1, channel-concat."""
+    c1, (c3r, c3), (c5r, c5), cp = _V1_BLOCKS[key]
+    p = f"inception_{key}/"
+    t1 = _conv(x, c1, 1, name=p + "1x1")
+    t2 = _conv(_conv(x, c3r, 1, name=p + "3x3_reduce"), c3, 3,
+               name=p + "3x3")
+    t3 = _conv(_conv(x, c5r, 1, name=p + "5x5_reduce"), c5, 5,
+               name=p + "5x5")
+    t4 = MaxPooling2D(pool_size=(3, 3), strides=(1, 1),
+                      border_mode="same", name=p + "pool")(x)
+    t4 = _conv(t4, cp, 1, name=p + "pool_proj")
+    return Merge(mode="concat", concat_axis=-1, name=p + "output")(
+        [t1, t2, t3, t4])
+
+
+class Inception:
+    """Factory namespace, like the reference companion objects."""
+
+    @staticmethod
+    def v1(classes: int = 1000, input_shape=(224, 224, 3),
+           has_dropout: bool = True) -> Model:
+        """Inception_v1_NoAuxClassifier
+        (inception.py:119-165 layer-for-layer)."""
+        inp = Input(shape=input_shape, name="input")
+        x = _conv(inp, 64, 7, stride=2, name="conv1/7x7_s2")
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same", name="pool1/3x3_s2")(x)
+        x = LRN2D(alpha=1e-4, k=1.0, beta=0.75, n=5,
+                  name="pool1/norm1")(x)
+        x = _conv(x, 64, 1, name="conv2/3x3_reduce")
+        x = _conv(x, 192, 3, name="conv2/3x3")
+        x = LRN2D(alpha=1e-4, k=1.0, beta=0.75, n=5, name="conv2/norm2")(x)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same", name="pool2/3x3_s2")(x)
+        x = _inception_block(x, "3a")
+        x = _inception_block(x, "3b")
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same", name="pool3/3x3_s2")(x)
+        for key in ("4a", "4b", "4c", "4d", "4e"):
+            x = _inception_block(x, key)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same", name="pool4/3x3_s2")(x)
+        x = _inception_block(x, "5a")
+        x = _inception_block(x, "5b")
+        pool = input_shape[0] // 32
+        x = AveragePooling2D(pool_size=(pool, pool), strides=(1, 1),
+                             name="pool5")(x)
+        x = Flatten()(x)
+        if has_dropout:
+            x = Dropout(0.4, name="pool5/drop")(x)
+        out = Dense(classes, activation="softmax",
+                    name="loss3/classifier")(x)
+        return Model(inp, out, name="inception_v1")

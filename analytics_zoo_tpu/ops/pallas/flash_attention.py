@@ -231,8 +231,11 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     lk = k.shape[2]
     scale_v = 1.0 / math.sqrt(d) if scale is None else scale
     offset = lk - lq
-    _, block_k = _resolve_blocks(lq, block_q, block_k)
-    bk = min(block_k, lk)
+    # The backward keeps its own 256 default: its scan materializes
+    # (b, h, lq, bk) f32 score/grad tiles in HBM, so the forward kernel's
+    # 1024 tuning would quadruple live memory and can OOM long-context
+    # training.  An explicit block_k still applies to both directions.
+    bk = min(block_k if block_k is not None else 256, lk)
     n_k = -(-lk // bk)
     pad = n_k * bk - lk
 

@@ -50,7 +50,11 @@ class _PoolND(Layer):
                 )
                 y = y / counts
             else:
-                y = y / float(jnp.prod(jnp.asarray(self.pool_size)))
+                # static python arithmetic: jnp.prod here would stage the
+                # op and yield a tracer, breaking float() under jit
+                import math
+
+                y = y / float(math.prod(self.pool_size))
         return y
 
     def compute_output_shape(self, input_shape):

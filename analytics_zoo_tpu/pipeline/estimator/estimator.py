@@ -445,10 +445,18 @@ class Estimator:
                     end_trigger, checkpoint_trigger, validation_set,
                     validation_trigger):
         ctx = self.ctx
+        cfg = ctx.config
         tstate = TrainingState(epoch=start_epoch,
                                iteration=self.global_step)
         epoch = start_epoch
         seed_arr = np.asarray(seed & 0x7FFFFFFF, np.int32)
+        # Profiler knob (ZOO_PROFILE_DIR / ZooConfig.profile_dir): one
+        # jax.profiler trace of profile_steps warm steps per fit() — armed
+        # ONCE per fit (not per epoch) so it fires even when epochs have
+        # fewer steps than the warmup offset.
+        prof_dir = cfg.profile_dir
+        prof_at = self.global_step + 3 if (
+            prof_dir and not self._profiled) else None
         while not end_trigger(tstate):
             epoch_t0 = time.perf_counter()
             n_records = 0
@@ -459,15 +467,8 @@ class Estimator:
             )
             loss_dev = None
             bi = start_batch
-            cfg = ctx.config
             feeder = _DeviceFeeder(batch_iter, ctx.shard_batch,
                                    depth=cfg.infeed_depth)
-            # Profiler knob (ZOO_PROFILE_DIR / ZooConfig.profile_dir): one
-            # jax.profiler trace of profile_steps warm steps per fit() —
-            # the measurement hook round-2's verdict found missing.
-            prof_dir = cfg.profile_dir
-            prof_at = self.global_step + 3 if (
-                prof_dir and not self._profiled) else None
             prof_active = False
             try:
                 feeder_iter = iter(feeder)
@@ -476,9 +477,12 @@ class Estimator:
                         sharded = next(feeder_iter, _SENTINEL)
                     if sharded is _SENTINEL:
                         break
-                    if prof_at is not None and self.global_step == prof_at:
+                    if prof_at is not None and not prof_active \
+                            and not self._profiled \
+                            and self.global_step >= prof_at:
                         jax.profiler.start_trace(prof_dir)
                         prof_active = True
+                        prof_at = self.global_step  # anchor the stop check
                     with time_it("zoo.step_dispatch"):
                         params, opt_state, state, loss_dev = step_fn(
                             params, opt_state, state, seed_arr,
@@ -508,6 +512,7 @@ class Estimator:
                     # epoch ended (or failed) mid-capture: close the trace
                     jax.profiler.stop_trace()
                     self._profiled = True
+                    prof_at = None
             # epoch boundary (the only unconditional host sync per epoch)
             dt = time.perf_counter() - epoch_t0
             if loss_dev is not None:

@@ -1,0 +1,96 @@
+"""Inception-v1 ImageNet training example (reference
+zoo/.../examples/inception/Train.scala:31-120: Inception_v1_NoAuxClassifier
+with SGD + iteration-based warmup/poly decay; python twin
+pyzoo/zoo/examples/inception/inception.py).
+
+With --data-dir, trains on ImageNet-style TFRecord or .npz shards (same
+loaders as the ResNet example); without, synthetic data measures training
+throughput.
+
+Usage:
+    python examples/inception/train.py --steps 20 --batch-size 128
+"""
+
+import argparse
+
+import numpy as np
+
+
+def run(image_size=224, batch_size=128, steps=20, classes=1000,
+        data_dir=None, epochs=1):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.dataset import FeatureSet
+    from analytics_zoo_tpu.models.inception import Inception
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD,
+        warmup_epoch_decay,
+    )
+
+    ctx = init_zoo_context("inception v1")
+    net = Inception.v1(classes=classes,
+                       input_shape=(image_size, image_size, 3))
+    # Train.scala:83-98: SGD, linear warmup then decay; momentum 0.9,
+    # weight decay 1e-4
+    schedule = warmup_epoch_decay(warmup_steps=steps // 4 + 1,
+                                  steps_per_epoch=max(steps, 1),
+                                  boundaries_epochs=(30, 60),
+                                  decay=0.1)
+    net.compile(optimizer=SGD(lr=0.065, momentum=0.9, weight_decay=1e-4,
+                              schedule=schedule),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+
+    if data_dir:
+        import glob
+
+        tfrec = sorted(glob.glob(f"{data_dir}/*.tfrecord")
+                       + glob.glob(f"{data_dir}/train-*-of-*"))
+        if tfrec:
+            from analytics_zoo_tpu.feature.tfrecord import (
+                imagenet_example_parser,
+            )
+            fs = FeatureSet.from_tfrecord(
+                tfrec, imagenet_example_parser(image_size=image_size,
+                                               label_offset=-1))
+        else:
+            fs = FeatureSet.from_shards(
+                sorted(glob.glob(f"{data_dir}/*.npz")))
+        fs.transform_on_device(_normalize)
+        net.fit(fs, batch_size=batch_size, nb_epoch=epochs)
+        return net
+
+    n = batch_size * steps
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(n, image_size, image_size, 3),
+                     dtype=np.uint8)
+    y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+    fs = FeatureSet.of(x, y).transform_on_device(_normalize)
+    net.fit(fs, batch_size=batch_size, nb_epoch=1)
+    return net
+
+
+def _normalize(batch):
+    import jax.numpy as jnp
+
+    x = batch["x"].astype(jnp.float32)
+    return {**batch, "x": (x - 127.0) / 59.0}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=1)
+    args = ap.parse_args()
+    net = run(args.image_size, args.batch_size, args.steps,
+              data_dir=args.data_dir, epochs=args.epochs)
+    h = net._estimator.history if net._estimator else []
+    if h:
+        print(f"final loss {h[-1]['loss']:.4f}, "
+              f"{h[-1]['throughput']:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

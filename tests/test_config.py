@@ -69,6 +69,28 @@ def test_explicit_value_beats_env(monkeypatch):
 
 
 def test_caller_config_not_mutated():
-    cfg = ZooConfig()
-    init_zoo_context(cfg, seed=42)
-    assert cfg.seed == 0  # caller's object untouched
+    cfg = ZooConfig(seed=3)
+    ctx = init_zoo_context(cfg, seed=42)
+    assert ctx.config.seed == 42  # explicit kwarg wins over config
+    assert cfg.seed == 3  # caller's object untouched
+
+
+def test_profiler_fires_with_tiny_epochs(tmp_path):
+    # 3-step epochs: the capture must still happen (armed per fit, not
+    # per epoch)
+    prof = str(tmp_path / "prof2")
+    init_zoo_context(ZooConfig(profile_dir=prof, profile_steps=2))
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(24, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(2, activation="softmax", input_shape=(4,)))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=8, nb_epoch=4)  # 3 steps/epoch
+    traces = glob.glob(os.path.join(prof, "**", "*.trace.json.gz"),
+                       recursive=True)
+    assert traces, "no trace captured with 3-step epochs"
+    init_zoo_context(seed=0)

@@ -82,3 +82,10 @@ def test_qaranker_example_ranks():
 
     res = run(epochs=5)
     assert res["recall@1"] > 0.4, res  # chance = 0.25 (1 of 4 answers)
+
+
+def test_inception_example_runs():
+    from examples.inception.train import run
+
+    net = run(image_size=64, batch_size=8, steps=2, classes=10)
+    assert net._estimator is not None

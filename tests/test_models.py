@@ -207,3 +207,39 @@ def test_seq2seq_copy_task(zoo_ctx):
     toks = model.infer(net.params[model.name], enc[:4], start_sign=1,
                        max_len=le)
     assert (toks == enc[:4]).mean() > 0.5
+
+
+class TestInceptionV1:
+    def test_shapes_and_param_count(self):
+        from analytics_zoo_tpu.models.inception import Inception
+
+        net = Inception.v1(classes=1000)
+        net.build_params()
+        import jax
+
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree_util.tree_leaves(net.params))
+        # GoogLeNet no-aux has ~7.0M params (6.99M conv/fc + biases)
+        assert 6.5e6 < n_params < 7.5e6, n_params
+        x = np.zeros((2, 224, 224, 3), np.float32)
+        out, _ = net.forward(net.params, x, state=net.state)
+        assert out.shape == (2, 1000)
+
+    def test_trains_on_tiny_task(self):
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.models.inception import Inception
+
+        init_zoo_context(seed=0)
+        net = Inception.v1(classes=2, input_shape=(64, 64, 3),
+                           has_dropout=False)
+        rng = np.random.default_rng(0)
+        n = 32
+        x = np.zeros((n, 64, 64, 3), np.float32)
+        y = rng.integers(0, 2, size=(n,)).astype(np.int32)
+        x[np.arange(n), :, :, 0] += y[:, None, None] * 1.0
+        net.compile(optimizer="adam",
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+        net.fit(x, y, batch_size=8, nb_epoch=8)
+        res = net.evaluate(x, y, batch_size=8)
+        assert res["accuracy"] > 0.8, res
