@@ -156,37 +156,75 @@ class _Checkpointer:
     Role of BigDL's ``model.<iter>`` + ``optimMethod.<iter>`` snapshots
     (Topology.scala:245-255), plus data-iterator state the reference never
     checkpointed (its RDD iterators restart from scratch on resume).
+
+    Saves are ASYNC (the orbax-style plan of SURVEY.md §5): the caller's
+    thread only dispatches device-side copies of the live buffers (so the
+    next step's donation can't touch them), while D2H transfer, pickling
+    and the atomic rename happen on a background thread.  At most one save
+    is in flight; a newer save (and ``latest``/``list``) waits for it.
     """
 
     path: str
     over_write: bool = True
     keep: int = 3
 
+    def __post_init__(self):
+        self._pending: threading.Thread | None = None
+        self._pending_err: BaseException | None = None
+
+    def _wait(self):
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+            if self._pending_err is not None:
+                err, self._pending_err = self._pending_err, None
+                raise err
+
     def save(self, tag: str, payload: dict) -> str:
+        self._wait()
         os.makedirs(self.path, exist_ok=True)
-        host = jax.tree_util.tree_map(np.asarray, payload)
+        # Device-side copies: cheap dispatches; the live arrays stay free
+        # to be donated by the next train step.
+        snap = jax.tree_util.tree_map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
+            payload)
         fname = os.path.join(self.path, f"ckpt-{tag}.pkl")
-        tmp = fname + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(host, f)
-        os.replace(tmp, fname)
-        self._gc()
+
+        def write():
+            try:
+                host = jax.tree_util.tree_map(np.asarray, snap)
+                tmp = fname + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(host, f)
+                os.replace(tmp, fname)
+                self._gc()
+            except BaseException as e:  # surfaced on the next save/_wait
+                self._pending_err = e
+
+        self._pending = threading.Thread(target=write, daemon=True,
+                                         name="zoo-ckpt")
+        self._pending.start()
         return fname
 
     def _gc(self):
-        files = self.list()
+        # raw listing: _gc runs ON the writer thread, so it must not _wait
+        files = self._list_files()
         for f in files[:-self.keep]:
             try:
                 os.remove(f)
             except OSError:
                 pass
 
-    def list(self) -> list[str]:
+    def _list_files(self) -> list[str]:
         if not os.path.isdir(self.path):
             return []
         files = [os.path.join(self.path, f) for f in os.listdir(self.path)
                  if f.startswith("ckpt-") and f.endswith(".pkl")]
         return sorted(files, key=os.path.getmtime)
+
+    def list(self) -> list[str]:
+        self._wait()  # a half-written snapshot must not be resumed from
+        return self._list_files()
 
     def latest(self) -> dict | None:
         """Reference ``getLatestFile`` (Topology.scala:1511-1528)."""
@@ -438,6 +476,12 @@ class Estimator:
         self.model.params = params
         self.model.state = state
         self._opt_state = opt_state
+        if self._ckpt is not None:
+            # Flush the in-flight async save before returning: the process
+            # may exit right after fit(), and a NEW estimator on the same
+            # dir must see the final snapshot (not a half-written .tmp).
+            # Also surfaces any deferred write error.
+            self._ckpt._wait()
         return self
 
     def _train_loop(self, params, opt_state, state, step_fn, train_set,

@@ -29,43 +29,35 @@ def run(image_size=224, batch_size=128, steps=20, classes=1000,
     ctx = init_zoo_context("inception v1")
     net = Inception.v1(classes=classes,
                        input_shape=(image_size, image_size, 3))
-    # Train.scala:83-98: SGD, linear warmup then decay; momentum 0.9,
-    # weight decay 1e-4
-    schedule = warmup_epoch_decay(warmup_steps=steps // 4 + 1,
-                                  steps_per_epoch=max(steps, 1),
+
+    if data_dir:
+        from analytics_zoo_tpu.feature.imagenet import imagenet_feature_set
+
+        fs = imagenet_feature_set(data_dir, image_size)
+    else:
+        n = batch_size * steps
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, size=(n, image_size, image_size, 3),
+                         dtype=np.uint8)
+        y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+        fs = FeatureSet.of(x, y)
+        epochs = 1
+    fs.transform_on_device(_normalize)
+
+    # Train.scala:83-98: SGD, linear warmup then epoch decay; momentum 0.9,
+    # weight decay 1e-4.  steps_per_epoch comes from the ACTUAL dataset so
+    # the decay boundaries land at real epochs, not at the synthetic-run
+    # step count.
+    steps_per_epoch = max(fs.num_samples // batch_size, 1)
+    schedule = warmup_epoch_decay(warmup_steps=2 * steps_per_epoch,
+                                  steps_per_epoch=steps_per_epoch,
                                   boundaries_epochs=(30, 60),
                                   decay=0.1)
     net.compile(optimizer=SGD(lr=0.065, momentum=0.9, weight_decay=1e-4,
                               schedule=schedule),
                 loss="sparse_categorical_crossentropy",
                 metrics=["accuracy"])
-
-    if data_dir:
-        import glob
-
-        tfrec = sorted(glob.glob(f"{data_dir}/*.tfrecord")
-                       + glob.glob(f"{data_dir}/train-*-of-*"))
-        if tfrec:
-            from analytics_zoo_tpu.feature.tfrecord import (
-                imagenet_example_parser,
-            )
-            fs = FeatureSet.from_tfrecord(
-                tfrec, imagenet_example_parser(image_size=image_size,
-                                               label_offset=-1))
-        else:
-            fs = FeatureSet.from_shards(
-                sorted(glob.glob(f"{data_dir}/*.npz")))
-        fs.transform_on_device(_normalize)
-        net.fit(fs, batch_size=batch_size, nb_epoch=epochs)
-        return net
-
-    n = batch_size * steps
-    rng = np.random.default_rng(0)
-    x = rng.integers(0, 256, size=(n, image_size, image_size, 3),
-                     dtype=np.uint8)
-    y = rng.integers(0, classes, size=(n,)).astype(np.int32)
-    fs = FeatureSet.of(x, y).transform_on_device(_normalize)
-    net.fit(fs, batch_size=batch_size, nb_epoch=1)
+    net.fit(fs, batch_size=batch_size, nb_epoch=epochs)
     return net
 
 

@@ -79,21 +79,9 @@ def run(image_size=224, per_chip_batch=256, steps=30, classes=1000,
     batch = per_chip_batch * max(ctx.data_parallel_size, 1)
 
     if data_dir:
-        import glob
+        from analytics_zoo_tpu.feature.imagenet import imagenet_feature_set
 
-        tfrec = sorted(glob.glob(f"{data_dir}/*.tfrecord")
-                       + glob.glob(f"{data_dir}/train-*-of-*"))
-        if tfrec:
-            # ImageNet TFRecord layout (image/encoded + image/class/label)
-            from analytics_zoo_tpu.feature.tfrecord import (
-                imagenet_example_parser,
-            )
-            train_set = FeatureSet.from_tfrecord(
-                tfrec, imagenet_example_parser(image_size=image_size,
-                                               label_offset=-1))
-        else:
-            train_set = FeatureSet.from_shards(
-                sorted(glob.glob(f"{data_dir}/*.npz")))
+        train_set = imagenet_feature_set(data_dir, image_size)
     else:
         n = batch * steps
         rng = np.random.default_rng(0)

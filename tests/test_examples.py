@@ -89,3 +89,20 @@ def test_inception_example_runs():
 
     net = run(image_size=64, batch_size=8, steps=2, classes=10)
     assert net._estimator is not None
+
+
+def test_chatbot_example_learns():
+    from examples.chatbot.train import run
+
+    res, replies, expect = run(epochs=15)
+    assert res["accuracy"] > 0.7, res
+    # generated answers match the deterministic mapping most of the time
+    assert (replies == expect).mean() > 0.5
+
+
+def test_nnframes_example_both_criteria():
+    from examples.nnframes.finetune import run
+
+    acc, acc2 = run(epochs=12)
+    assert acc > 0.85, acc
+    assert acc2 > 0.85, acc2
