@@ -12,9 +12,9 @@ alternating GAN optimization, and ready-made text estimators, all building
 the framework's own symbolic graph (autograd Variables + keras layers).
 """
 
-from .estimator import TFEstimator, TFEstimatorSpec, ZooOptimizer
+from .estimator import TFEstimator, TFEstimatorSpec, ZooOptimizer, sparse_ce
 from .gan import GANEstimator
 from .model import KerasModel
 
-__all__ = ["KerasModel", "TFEstimator", "TFEstimatorSpec", "ZooOptimizer",
+__all__ = ["KerasModel", "TFEstimator", "TFEstimatorSpec", "ZooOptimizer", "sparse_ce",
            "GANEstimator"]

@@ -31,6 +31,29 @@ def ZooOptimizer(optimizer):
     return get_optimizer(optimizer)
 
 
+def sparse_ce(probs, labels):
+    """Per-sample sparse CE as a graph op over (probs, int labels)
+    Variables; used by the BERT heads to express loss inside the model_fn
+    graph (the reference uses tf.nn.sparse_softmax_cross_entropy)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.autograd import _apply_op
+
+    def fn(p, y):
+        logp = jnp.log(jnp.clip(p, 1e-7, 1.0))
+        y = y.astype(jnp.int32).reshape(y.shape[0], -1)
+        if y.shape[-1:] != (1,):  # sequence labels: mean over positions
+            picked = jnp.take_along_axis(
+                logp.reshape(y.shape + (logp.shape[-1],)), y[..., None],
+                axis=-1)[..., 0]
+            return -jnp.mean(picked, axis=-1)
+        picked = jnp.take_along_axis(logp, y, axis=-1)[..., 0]
+        return -picked
+
+    return _apply_op(fn, lambda shapes: (shapes[0][0],), "sparse_ce",
+                     probs, labels)
+
+
 class TFEstimatorSpec:
     """Ops returned by a model_fn (reference estimator.py:76-82)."""
 

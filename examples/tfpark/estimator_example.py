@@ -1,0 +1,77 @@
+"""tfpark example — model_fn estimator + KerasModel (reference
+pyzoo/zoo/examples/tensorflow/tfpark/{estimator_dataset.py,
+keras_dataset.py}: tf.estimator-style training driven by the zoo
+runtime; here the model_fn builds symbolic zoo layers and the whole
+train step compiles to one XLA program).
+
+Usage:
+    python examples/tfpark/estimator_example.py --steps 300
+"""
+
+import argparse
+
+import numpy as np
+
+
+def blobs(n=512, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    centers = rng.normal(size=(classes, d)) * 3
+    x = centers[y] + rng.normal(size=(n, d)) * 0.4
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def run(steps=300, batch_size=32):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.tfpark import (
+        KerasModel,
+        TFEstimator,
+        TFEstimatorSpec,
+        sparse_ce,
+    )
+
+    init_zoo_context("tfpark example")
+    x, y = blobs()
+    n_train = int(0.8 * len(x))
+
+    # 1. tf.estimator-style model_fn (TFEstimator)
+    def model_fn(features, labels, mode, params):
+        h = Dense(24, activation="relu")(features)
+        probs = Dense(3, activation="softmax")(h)
+        if mode == "predict" or labels is None:
+            return TFEstimatorSpec(mode, predictions=probs)
+        return TFEstimatorSpec(mode, predictions=probs,
+                               loss=sparse_ce(probs, labels))
+
+    est = TFEstimator(model_fn, optimizer="adam")
+    est.train(lambda: (x[:n_train], y[:n_train]), steps=steps,
+              batch_size=batch_size)
+    est_metrics = est.evaluate(lambda: (x[n_train:], y[n_train:]),
+                               ["accuracy"])
+
+    # 2. tf.keras-style compiled model (tfpark KerasModel)
+    net = Sequential()
+    net.add(Dense(24, activation="relu", input_shape=(8,)))
+    net.add(Dense(3, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    km = KerasModel(net)
+    km.fit(x[:n_train], y[:n_train], batch_size=batch_size, epochs=8)
+    km_metrics = km.evaluate(x[n_train:], y[n_train:],
+                             batch_size=batch_size)
+    return est_metrics, km_metrics
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=300)
+    args = ap.parse_args()
+    est_m, km_m = run(args.steps)
+    print("TFEstimator:", {k: round(float(v), 4) for k, v in est_m.items()})
+    print("KerasModel: ", {k: round(float(v), 4) for k, v in km_m.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Inference throughput harness — the reference's int8 Perf role
+(zoo/.../examples/vnni/bigdl/Perf.scala:53-66: load a (quantized) model,
+run batches, print images/sec; VNNI int8 on Xeon there, int8 weight
+quantization + XLA here).
+
+Times f32 vs int8-quantized weights on a ResNet forward pass and reports
+quantization error and size reduction — the capability pair behind the
+reference's "int8: 4x model size down, up to 2x speedup" claim.
+
+Usage:
+    python examples/vnni/perf.py --batch 32 --iters 10
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def run(batch=32, iters=10, image_size=64, depth=18):
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.resnet import ResNet
+    from analytics_zoo_tpu.pipeline.inference.quantize import (
+        QuantizedTensor,
+        dequantize_params,
+        quantization_error,
+        quantize_params,
+    )
+
+    init_zoo_context("vnni perf")
+    net = ResNet.image_net(depth, classes=10,
+                           input_shape=(image_size, image_size, 3))
+    net.build_params()
+    x = np.random.default_rng(0).normal(
+        size=(batch, image_size, image_size, 3)).astype(np.float32)
+
+    fwd = jax.jit(lambda p, xx: net.forward(p, xx, state=net.state)[0])
+
+    def timed(params):
+        out = fwd(params, x)
+        float(np.asarray(out).sum())  # fetch-forced warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(params, x)
+        float(np.asarray(out).sum())
+        return batch * iters / (time.perf_counter() - t0)
+
+    ips_f32 = timed(net.params)
+
+    qparams = quantize_params(net.params, min_size=1024)
+    deq = dequantize_params(qparams)
+    err = quantization_error(net.params, qparams)
+
+    def nbytes(tree):
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda l: isinstance(l, QuantizedTensor)):
+            if isinstance(leaf, QuantizedTensor):
+                total += leaf.values.nbytes + leaf.scale.nbytes
+            else:
+                total += np.asarray(leaf).nbytes
+        return total
+
+    ips_deq = timed(deq)
+    return {
+        "images_per_sec_f32": round(ips_f32, 1),
+        "images_per_sec_int8_weights": round(ips_deq, 1),
+        "model_bytes_f32": nbytes(net.params),
+        "model_bytes_int8": nbytes(qparams),
+        "size_reduction": round(nbytes(net.params) / nbytes(qparams), 2),
+        "max_quant_error": round(float(err), 5),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--image-size", type=int, default=64)
+    args = ap.parse_args()
+    import json
+
+    print(json.dumps(run(args.batch, args.iters, args.image_size)))
+
+
+if __name__ == "__main__":
+    main()

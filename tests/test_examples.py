@@ -106,3 +106,20 @@ def test_nnframes_example_both_criteria():
     acc, acc2 = run(epochs=12)
     assert acc > 0.85, acc
     assert acc2 > 0.85, acc2
+
+
+def test_tfpark_example_both_paths():
+    from examples.tfpark.estimator_example import run
+
+    est_m, km_m = run(steps=200)
+    assert est_m["accuracy"] > 0.8, est_m
+    assert km_m["accuracy"] > 0.8, km_m
+
+
+def test_vnni_perf_example():
+    from examples.vnni.perf import run
+
+    r = run(batch=8, iters=2, image_size=32)
+    assert r["size_reduction"] > 3.0, r   # ~4x from f32 -> int8 weights
+    assert r["max_quant_error"] < 0.05, r
+    assert r["images_per_sec_f32"] > 0
