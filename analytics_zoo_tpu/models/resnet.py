@@ -20,6 +20,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
     GlobalAveragePooling2D,
     MaxPooling2D,
     Merge,
+    SpaceToDepth,
 )
 from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
     SGD,
@@ -78,13 +79,29 @@ class ResNet:
 
     @staticmethod
     def image_net(depth: int = 50, classes: int = 1000,
-                  input_shape=(224, 224, 3)) -> Model:
+                  input_shape=(224, 224, 3), stem: str = "7x7") -> Model:
         """ImageNet-scale ResNet (reference
-        examples/resnet/TrainImageNet.scala model config)."""
+        examples/resnet/TrainImageNet.scala model config).
+
+        stem: "7x7" = the classic 7x7/s2 conv; "space_to_depth" = the TPU
+        formulation (space-to-depth block 2 then 4x4/s1 conv on 12
+        channels — an 8x8/s2 conv's kernel rearranged, so the MXU sees 12
+        input channels unstrided instead of 3 strided; SAME-padding border
+        geometry differs from the 7x7, so it is a train-from-scratch
+        variant, not a checkpoint-compatible swap).  Same downstream
+        network either way.
+        """
         kind, stages = _STAGES[depth]
         block = _bottleneck if kind == "bottleneck" else _basic
         inp = Input(shape=input_shape, name="input")
-        x = _conv_bn(inp, 64, 7, stride=2, name="stem")
+        if stem == "space_to_depth":
+            x = SpaceToDepth(2, name="stem_s2d")(inp)
+            x = _conv_bn(x, 64, 4, stride=1, name="stem")
+        elif stem == "7x7":
+            x = _conv_bn(inp, 64, 7, stride=2, name="stem")
+        else:
+            raise ValueError(
+                f"stem must be '7x7' or 'space_to_depth', got {stem!r}")
         x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
                          border_mode="same")(x)
         filters = 64

@@ -2,17 +2,28 @@
 
 The reference's only inter-node strategy is synchronous data parallelism on
 Spark (SURVEY.md §2.4); TP/PP/SP/EP are absent.  Here every strategy is a
-first-class mesh axis (common/engine.py axes: data/model/seq/expert):
+first-class mesh axis (common/engine.py axes: data/model/seq/expert/pipe):
 
 - :mod:`strategies` — explicit shard_map train steps (psum = the
   AllReduceParameter replacement), tensor-parallel dense helpers.
 - :mod:`ring_attention` — sequence/context parallelism via ppermute ring —
   the long-context capability the reference lacks.
+- :mod:`pipeline` — GPipe microbatch pipeline parallelism over the ``pipe``
+  axis (scan + ppermute schedule; grad = automatic reverse pipeline).
 - :mod:`multihost` — jax.distributed bootstrap (the RayOnSpark role).
 """
 
 from analytics_zoo_tpu.parallel.multihost import (  # noqa: F401
     init_distributed,
+)
+from analytics_zoo_tpu.parallel.partition import (  # noqa: F401
+    match_partition_rules,
+    shard_params,
+    tree_shardings,
+)
+from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    stack_stage_params,
 )
 from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

@@ -1,0 +1,82 @@
+"""Regex partition rules: name-pattern → PartitionSpec for whole pytrees.
+
+The estimator's default is data parallelism with replicated params; TP/PP
+users need per-parameter shardings.  Writing a PartitionSpec pytree by hand
+for a 100-layer model is the failure mode; the idiomatic TPU approach
+(T5X/fmengine-style) is a small ordered rule table matched against the
+parameter's tree path:
+
+    rules = [
+        (r"dense_\\d+/kernel", P(None, "model")),
+        (r"embedding", P("model", None)),
+        (r".*", P()),                      # default: replicate
+    ]
+    specs = match_partition_rules(rules, params)
+    shardings = tree_shardings(mesh, specs)
+
+Scalars and size-1 leaves are never partitioned (a spec would be wasted on
+them and some optimizers carry scalar state).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def leaf_path_name(path) -> str:
+    """Render a jax tree path as a '/'-joined name (dict keys, sequence
+    indices, dataclass field names)."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        else:  # FlattenedIndexKey and anything else
+            parts.append(str(getattr(k, "key", k)))
+    return "/".join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, P]], params
+):
+    """PartitionSpec pytree for ``params``: first rule whose regex
+    ``re.search``-matches the leaf's '/'-joined path wins.
+
+    Raises ValueError naming the unmatched parameter if no rule matches —
+    add a catch-all ``(r".*", P())`` as the last rule to default-replicate.
+    """
+
+    def spec_for(path, leaf):
+        name = leaf_path_name(path)
+        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(f"no partition rule matches parameter {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tree_shardings(mesh, specs):
+    """NamedSharding pytree from a PartitionSpec pytree (for device_put /
+    jit in_shardings)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def shard_params(mesh, rules, params):
+    """device_put ``params`` according to ``rules`` — one call from an
+    unsharded pytree to a mesh-laid-out one."""
+    specs = match_partition_rules(rules, params)
+    return jax.device_put(params, tree_shardings(mesh, specs))

@@ -1,0 +1,130 @@
+"""GPipe-style pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: PP "No"); this
+module completes the framework's DP x TP x SP x EP x PP mesh-axis matrix.
+
+Design (TPU-idiomatic, not a scheduler translation): one pipeline stage per
+device along the ``pipe`` axis; the microbatch schedule is a single
+``lax.scan`` over ticks inside ``shard_map``, with ``lax.ppermute`` shifting
+activations one ICI hop to the next stage each tick.  Because the whole
+schedule is scan + ppermute, ``jax.grad`` of the pipelined forward IS the
+reverse pipeline — no hand-written backward schedule, and the bubble
+(S - 1 idle ticks at fill/drain) is the standard GPipe bubble.
+
+Contrast with the reference's execution model: BigDL runs the whole model on
+every Spark task and all-reduces gradients (wp-bigdl.md:148-164).  Here the
+model's *layers* are sharded across chips, so models larger than one chip's
+HBM train without resharding the optimizer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.common.engine import PIPE_AXIS, get_zoo_context
+
+
+def _pipeline_local(stage_params, x_mb, *, stage_fn, axis_name, n_stages,
+                    n_micro):
+    """Per-shard GPipe schedule.
+
+    stage_params: this shard's stage weights, leading dim 1 (stage-sharded).
+    x_mb: (M, mb, ...) microbatches, replicated over the pipe axis; stage 0
+      injects x_mb[t] at tick t.
+    Returns (M, mb, ...) final-stage outputs, replicated over the pipe axis.
+    """
+    idx = lax.axis_index(axis_name)
+    p_local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    n_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        # carry: the activation that arrived at this stage from the previous
+        # stage last tick.  Stage 0 ignores it and injects the next
+        # microbatch instead (clamped past the end: those outputs can never
+        # reach the last stage inside the valid tick window, so they are
+        # dead compute with zero cotangent, not a correctness hazard).
+        inj = x_mb[jnp.clip(t, 0, n_micro - 1)]
+        act = jnp.where(idx == 0, inj, carry)
+        out = stage_fn(p_local, act)
+        shifted = lax.ppermute(out, axis_name, perm)
+        return shifted, out
+
+    _, ys = lax.scan(tick, jnp.zeros_like(x_mb[0]), jnp.arange(n_ticks))
+    # Stage s processes microbatch t - s at tick t, so the last stage emits
+    # microbatch m at tick m + n_stages - 1: the ordered outputs are the
+    # last stage's ys[n_stages-1:].  Mask+psum replicates them everywhere so
+    # the loss (and jax.grad) is an ordinary SPMD computation.
+    valid = ys[n_stages - 1:]
+    return lax.psum(
+        jnp.where(idx == n_stages - 1, valid, jnp.zeros_like(valid)),
+        axis_name,
+    )
+
+
+def gpipe(stage_fn, stage_params, x, *, n_microbatch, mesh=None,
+          axis_name: str = PIPE_AXIS, batch_axis: str | None = None):
+    """Microbatched pipeline-parallel application of a stage stack.
+
+    Args:
+      stage_fn: ``(params_one_stage, act) -> act`` — one pipeline stage;
+        activations must keep one shape across stages (pad/project inside
+        the stage if needed), the usual contract for scanned stacks.
+      stage_params: pytree whose leaves have leading dim ``n_stages`` (==
+        the ``pipe`` axis size), stage i's weights at index i.  Under jit,
+        shard the leading dim over ``pipe``.
+      x: (B, ...) global batch; B must divide by ``n_microbatch`` (and by
+        ``n_microbatch * batch_axis size`` when composing with DP).
+      n_microbatch: GPipe microbatch count M; bubble fraction is
+        (S-1)/(M+S-1), so pick M >= ~4*S.
+      batch_axis: mesh axis to data-parallelize over (e.g. ``"data"``).
+        Each microbatch's rows are sharded over it, so every data shard
+        pipelines only its own rows — PP x DP composition.  Differentiating
+        through the replicated ``stage_params`` in_spec automatically psums
+        the per-shard parameter cotangents over ``batch_axis`` (shard_map's
+        transpose of replication), i.e. the DP gradient all-reduce needs no
+        explicit collective here.  None = batch replicated over every
+        non-pipe axis.
+    Returns:
+      (B, ...) outputs of the last stage, replicated over the pipe axis
+      (row-sharded over ``batch_axis`` when given).
+    """
+    mesh = mesh or get_zoo_context().mesh
+    n_stages = dict(mesh.shape).get(axis_name, 1)
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pipe axis "
+                f"size {n_stages} (leaf shape {leaf.shape})"
+            )
+    b = x.shape[0]
+    if b % n_microbatch:
+        raise ValueError(f"batch {b} not divisible by M={n_microbatch}")
+    if n_stages == 1:
+        one = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return stage_fn(one, x)
+    x_mb = x.reshape((n_microbatch, b // n_microbatch) + x.shape[1:])
+    mb_spec = P(None, batch_axis)  # rows of each microbatch over DP axis
+    fn = jax.shard_map(
+        partial(_pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
+                n_stages=n_stages, n_micro=n_microbatch),
+        mesh=mesh,
+        in_specs=(P(axis_name), mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )
+    out = fn(stage_params, x_mb)
+    return out.reshape((b,) + out.shape[2:])
+
+
+def stack_stage_params(per_stage: list):
+    """Stack a list of identically-structured per-stage param pytrees into
+    the leading-stage-dim layout ``gpipe`` expects."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage
+    )

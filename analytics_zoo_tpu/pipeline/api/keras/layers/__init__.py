@@ -123,6 +123,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.tensor_ops import (  # noqa: F4
     SelectTable,
     Softmax,
     SoftShrink,
+    SpaceToDepth,
     SplitTensor,
     Sqrt,
     Square,

@@ -479,3 +479,34 @@ class ResizeBilinear(Layer):
 
     def compute_output_shape(self, input_shape):
         return (input_shape[0], self.out_h, self.out_w, input_shape[3])
+
+
+class SpaceToDepth(Layer):
+    """NHWC space-to-depth: (B, H, W, C) -> (B, H/b, W/b, b*b*C), TF channel
+    order.  Beyond the reference (no Scala counterpart): the MXU-friendly
+    rearrangement that turns a strided small-channel stem conv into a dense
+    unstrided one (e.g. ResNet's 7x7/s2 on C=3 -> 4x4/s1 on C=12), the
+    standard TPU ResNet input optimization."""
+
+    def __init__(self, block_size=2, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name, **kw)
+        self.block_size = int(block_size)
+        self._config = dict(block_size=self.block_size)
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        b = self.block_size
+        n, h, w, c = inputs.shape
+        if h % b or w % b:
+            raise ValueError(
+                f"spatial dims {(h, w)} not divisible by block {b}")
+        x = inputs.reshape(n, h // b, b, w // b, b, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h // b, w // b, b * b * c)
+
+    def compute_output_shape(self, input_shape):
+        b = self.block_size
+        n, h, w, c = input_shape
+        if h % b or w % b:
+            raise ValueError(
+                f"spatial dims {(h, w)} not divisible by block {b}")
+        return (n, h // b, w // b, b * b * c)

@@ -243,3 +243,26 @@ class TestInceptionV1:
         net.fit(x, y, batch_size=8, nb_epoch=8)
         res = net.evaluate(x, y, batch_size=8)
         assert res["accuracy"] > 0.8, res
+
+
+def test_resnet_space_to_depth_stem(zoo_ctx):
+    """The TPU stem variant: same downstream network, same output shape,
+    trains; stem kernel is 4x4x12 instead of 7x7x3."""
+    import jax
+
+    from analytics_zoo_tpu.models.resnet import ResNet
+
+    net = ResNet.image_net(18, classes=4, input_shape=(32, 32, 3),
+                           stem="space_to_depth")
+    params, state = net.build_params(jax.random.PRNGKey(0))
+    assert params["stem_conv"]["kernel"].shape == (4, 4, 12, 64)
+    rng = np.random.default_rng(0)
+    n = 16
+    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32) * 3
+    out, _ = net.forward(params, x, state=state, training=False)
+    assert out.shape == (n, 4)
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    net.fit(x, y, batch_size=8, nb_epoch=6)
+    hist = net._estimator.history
+    assert hist[-1]["loss"] < 0.8 * hist[0]["loss"], hist

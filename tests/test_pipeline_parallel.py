@@ -1,0 +1,212 @@
+"""Pipeline parallelism (GPipe over the ``pipe`` mesh axis) vs a sequential
+oracle — the reference has no PP (SURVEY.md §2.4), so dense math is the
+oracle, as for TP/SP/EP."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _oracle(stage_params, x):
+    for i in range(stage_params["w"].shape[0]):
+        x = np.tanh(x @ stage_params["w"][i] + stage_params["b"][i])
+    return x
+
+
+def _make(rng, n_stages, d):
+    return {
+        "w": rng.normal(0, 0.5, (n_stages, d, d)).astype(np.float32),
+        "b": rng.normal(0, 0.1, (n_stages, d)).astype(np.float32),
+    }
+
+
+@pytest.fixture()
+def pipe_ctx():
+    from analytics_zoo_tpu import init_zoo_context
+
+    return init_zoo_context(
+        mesh_shape={"data": 2, "pipe": 4},
+        mesh_axes=("data", "pipe"), seed=0,
+    )
+
+
+class TestGPipe:
+    def test_forward_matches_sequential(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(0)
+        params = _make(rng, 4, 8)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        out = gpipe(_stage_fn, params, jnp.asarray(x), n_microbatch=8)
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(params, x), atol=1e-5)
+
+    def test_forward_under_jit_with_sharded_stages(self, pipe_ctx):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        mesh = pipe_ctx.mesh
+        rng = np.random.default_rng(1)
+        params = _make(rng, 4, 8)
+        sharded = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P("pipe"))),
+            params,
+        )
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        out = jax.jit(
+            lambda p, x: gpipe(_stage_fn, p, x, n_microbatch=8)
+        )(sharded, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(params, x), atol=1e-5)
+
+    def test_grad_is_reverse_pipeline(self, pipe_ctx):
+        """jax.grad through the scanned ppermute schedule must equal the
+        sequential model's gradients, for stage params AND input."""
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(2)
+        params = _make(rng, 4, 6)
+        x = jnp.asarray(rng.normal(size=(8, 6)).astype(np.float32))
+        tgt = jnp.asarray(rng.normal(size=(8, 6)).astype(np.float32))
+
+        def piped_loss(p, x):
+            return jnp.mean((gpipe(_stage_fn, p, x, n_microbatch=4)
+                             - tgt) ** 2)
+
+        def seq_loss(p, x):
+            for i in range(4):
+                x = jnp.tanh(x @ p["w"][i] + p["b"][i])
+            return jnp.mean((x - tgt) ** 2)
+
+        gp, gx = jax.grad(piped_loss, argnums=(0, 1))(params, x)
+        rp, rx = jax.grad(seq_loss, argnums=(0, 1))(params, x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-5)
+        for k in gp:
+            np.testing.assert_allclose(
+                np.asarray(gp[k]), np.asarray(rp[k]), atol=1e-5, err_msg=k)
+
+    def test_training_step_converges(self, pipe_ctx):
+        """Full pipelined train step: gpipe forward, grad, sgd — loss falls
+        on a learnable mapping."""
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(3)
+        params = jax.tree_util.tree_map(jnp.asarray, _make(rng, 4, 4))
+        x = jnp.asarray(rng.normal(size=(32, 4)).astype(np.float32))
+        w_true = rng.normal(size=(4, 4)).astype(np.float32)
+        y = jnp.tanh(jnp.asarray(x @ w_true))
+
+        @jax.jit
+        def step(p, x, y):
+            def loss(p):
+                return jnp.mean((gpipe(_stage_fn, p, x, n_microbatch=8)
+                                 - y) ** 2)
+
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree_util.tree_map(
+                lambda a, b: a - 0.3 * b, p, g), l
+
+        losses = []
+        for _ in range(60):
+            params, l = step(params, x, y)
+            losses.append(float(l))
+        assert losses[-1] < 0.2 * losses[0], losses[::15]
+
+    def test_single_stage_fallback(self):
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        init_zoo_context(mesh_shape={"data": 8}, seed=0)
+        rng = np.random.default_rng(4)
+        params = _make(rng, 1, 5)
+        x = rng.normal(size=(6, 5)).astype(np.float32)
+        out = gpipe(_stage_fn, params, jnp.asarray(x), n_microbatch=2)
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(params, x), atol=1e-6)
+
+    def test_stack_stage_params(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import (
+            gpipe,
+            stack_stage_params,
+        )
+
+        rng = np.random.default_rng(5)
+        per_stage = [
+            {"w": rng.normal(0, 0.5, (4, 4)).astype(np.float32),
+             "b": np.zeros(4, np.float32)}
+            for _ in range(4)
+        ]
+        stacked = stack_stage_params(per_stage)
+        assert stacked["w"].shape == (4, 4, 4)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        out = gpipe(_stage_fn, stacked, jnp.asarray(x), n_microbatch=4)
+        ref = x
+        for p in per_stage:
+            ref = np.tanh(ref @ p["w"] + p["b"])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def test_shape_errors(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(6)
+        params = _make(rng, 3, 4)  # wrong: pipe axis is 4
+        x = jnp.zeros((8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="pipe axis size"):
+            gpipe(_stage_fn, params, x, n_microbatch=4)
+        good = _make(rng, 4, 4)
+        with pytest.raises(ValueError, match="not divisible"):
+            gpipe(_stage_fn, good, x, n_microbatch=3)
+
+
+class TestGPipeDataParallel:
+    def test_batch_axis_shards_rows_and_matches_oracle(self, pipe_ctx):
+        """PP x DP: microbatch rows sharded over `data`; forward and the
+        DP-summed parameter grads must equal the sequential oracle."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        mesh = pipe_ctx.mesh
+        rng = np.random.default_rng(7)
+        params = jax.device_put(
+            _make(rng, 4, 6), NamedSharding(mesh, P("pipe")))
+        host = jax.tree_util.tree_map(np.asarray, params)
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        tgt = rng.normal(size=(16, 6)).astype(np.float32)
+        xd = jax.device_put(x, NamedSharding(mesh, P("data")))
+        td = jax.device_put(tgt, NamedSharding(mesh, P("data")))
+
+        @jax.jit
+        def loss_and_grad(p, x, t):
+            def loss(p):
+                out = gpipe(_stage_fn, p, x, n_microbatch=4,
+                            batch_axis="data")
+                return jnp.mean((out - t) ** 2), out
+
+            (l, out), g = jax.value_and_grad(loss, has_aux=True)(p)
+            return l, out, g
+
+        l, out, g = loss_and_grad(params, xd, td)
+        # forward oracle
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(host, x), atol=1e-5)
+        # the output stays row-sharded over data (no all-gather of compute)
+        assert out.sharding.spec[0] in (P("data")[0], "data")
+
+        def seq_loss(p):
+            a = jnp.asarray(x)
+            for i in range(4):
+                a = jnp.tanh(a @ p["w"][i] + p["b"][i])
+            return jnp.mean((a - tgt) ** 2)
+
+        ref = jax.grad(seq_loss)(host)
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(ref[k]), atol=1e-5, err_msg=k)

@@ -493,3 +493,47 @@ def test_resize_bilinear_align_corners_per_axis():
         (1, 5, 3, 1), np.float32)
     out, _ = apply_layer(ResizeBilinear(3, 1, align_corners=True), x)
     np.testing.assert_allclose(np.asarray(out)[0, :, 0, 0], [0.0, 2.0, 4.0])
+
+
+def test_space_to_depth_vs_tf_order_oracle():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SpaceToDepth
+
+    x = rng0.normal(size=(2, 4, 6, 3)).astype(np.float32)
+    out, _ = apply_layer(SpaceToDepth(2), x)
+    assert out.shape == (2, 2, 3, 12)
+    # TF channel order: output[b, i, j, (di*blk + dj)*C + c]
+    ref = np.zeros((2, 2, 3, 12), np.float32)
+    for di in range(2):
+        for dj in range(2):
+            for c in range(3):
+                ref[..., (di * 2 + dj) * 3 + c] = x[:, di::2, dj::2, c]
+    np.testing.assert_array_equal(out, ref)
+    with pytest.raises(ValueError, match="not divisible"):
+        SpaceToDepth(2).compute_output_shape((1, 5, 6, 3))
+
+
+def test_space_to_depth_stem_equals_strided_conv():
+    """4x4/s1 conv on the s2d grid == 8x8/s2 conv on the original image
+    (kernel rearranged): the stem reformulation is exact, not approximate."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = rng0.normal(size=(1, 16, 16, 3)).astype(np.float32)
+    k8 = rng0.normal(size=(8, 8, 3, 5)).astype(np.float32)
+    ref = lax.conv_general_dilated(
+        x, k8, window_strides=(2, 2), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # rearrange (8,8,3,5) -> (4,4,12,5): tap (2i+di, 2j+dj, c) goes to
+    # spatial (i, j), input channel (di*2+dj)*3+c  (TF s2d order)
+    k4 = np.zeros((4, 4, 12, 5), np.float32)
+    for di in range(2):
+        for dj in range(2):
+            for c in range(3):
+                k4[:, :, (di * 2 + dj) * 3 + c] = k8[di::2, dj::2, c]
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SpaceToDepth
+
+    xs, _ = apply_layer(SpaceToDepth(2), x)
+    out = lax.conv_general_dilated(
+        np.asarray(xs), k4, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

@@ -93,12 +93,13 @@ def probe_matmul():
          matmul_ms=round(dt * 1e3, 2))
 
 
-def probe_resnet(batch, steps, image=224):
+def probe_resnet(batch, steps, image=224, stem="7x7"):
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.models.resnet import ResNet
 
     ctx = init_zoo_context(seed=0)
-    net = ResNet.image_net(50, classes=1000, input_shape=(image, image, 3))
+    net = ResNet.image_net(50, classes=1000, input_shape=(image, image, 3),
+                           stem=stem)
     net.compile(optimizer=ResNet.imagenet_optimizer(
         batch_size=batch, steps_per_epoch=100),
         loss="sparse_categorical_crossentropy")
@@ -122,7 +123,7 @@ def probe_resnet(batch, steps, image=224):
         params, opt_state, state, seed_arr, np.asarray(0, np.int32), sharded)
     float(loss)  # fetch-forced sync (block_until_ready lies on axon)
     compile_s = time.perf_counter() - t0
-    emit(resnet_compile_s=round(compile_s, 1), batch=batch)
+    emit(resnet_compile_s=round(compile_s, 1), batch=batch, stem=stem)
 
     # batch arg (index 5) is not donated, safe to reuse across steps.
     t0 = time.perf_counter()
@@ -136,24 +137,31 @@ def probe_resnet(batch, steps, image=224):
     flops = 3 * 4.09e9 * batch
     emit(resnet_pure_step_ms=round(dt * 1e3, 1),
          resnet_pure_ips=round(ips, 1),
-         resnet_pure_mfu=round(flops / dt / 197e12, 4))
+         resnet_pure_mfu=round(flops / dt / 197e12, 4),
+         batch=batch, stem=stem)
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--stem", default="7x7",
+                   choices=["7x7", "space_to_depth"])
     p.add_argument("--skip-resnet", action="store_true")
+    p.add_argument("--resnet-only", action="store_true")
     args = p.parse_args()
+    if args.resnet_only and args.skip_resnet:
+        p.error("--resnet-only and --skip-resnet are mutually exclusive")
 
     d = jax.devices()[0]
     emit(platform=d.platform, device_kind=d.device_kind,
          n_devices=len(jax.devices()))
-    probe_dispatch()
-    probe_h2d()
-    probe_matmul()
+    if not args.resnet_only:
+        probe_dispatch()
+        probe_h2d()
+        probe_matmul()
     if not args.skip_resnet:
-        probe_resnet(args.batch, args.steps)
+        probe_resnet(args.batch, args.steps, stem=args.stem)
 
 
 if __name__ == "__main__":
