@@ -1,0 +1,82 @@
+"""Transformer sequence classification (reference
+pyzoo/zoo/examples/attention/transformer.py: a TransformerLayer stack over
+IMDB token/position inputs, pooled into a 2-class softmax).
+
+Self-contained: synthetic token sequences whose class is decided by which
+marker-token family occurs more often — attention has to aggregate over the
+whole sequence, chance is 0.5.  The whole model (embedding, n_block
+self-attention blocks, pooling, head) lowers to one jitted XLA program.
+
+Usage:
+    python examples/attention/transformer.py --epochs 8
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_data(n, vocab, seq_len, seed=0):
+    """Class 1 iff more tokens from [2, 12) than from [12, 22)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(22, vocab, size=(n, seq_len))
+    n_mark = rng.integers(2, seq_len // 2, size=n)
+    for i in range(n):
+        pos = rng.choice(seq_len, size=n_mark[i], replace=False)
+        fam = rng.integers(0, 2)
+        lo = 2 if fam else 12
+        x[i, pos] = rng.integers(lo, lo + 10, size=n_mark[i])
+        # tie-break: guarantee a strict majority for the chosen family
+    counts_pos = ((x >= 2) & (x < 12)).sum(1)
+    counts_neg = ((x >= 12) & (x < 22)).sum(1)
+    y = (counts_pos > counts_neg).astype(np.int32)
+    return x.astype(np.int32), y
+
+
+def run(epochs=8, n=1024, vocab=128, seq_len=24, batch_size=64):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Dense,
+        Dropout,
+        GlobalAveragePooling1D,
+        TransformerLayer,
+    )
+
+    init_zoo_context("transformer example")
+    x, y = make_data(n, vocab, seq_len)
+    xv, yv = make_data(256, vocab, seq_len, seed=1)
+
+    tokens = Input(shape=(seq_len,), name="tokens")
+    seq = TransformerLayer(vocab=vocab, seq_len=seq_len, n_block=2,
+                           n_head=4, hidden_size=64)(tokens)
+    pooled = GlobalAveragePooling1D()(seq)
+    pooled = Dropout(0.1)(pooled)
+    out = Dense(2, activation="softmax")(pooled)
+    model = Model(tokens, out, name="transformer_classifier")
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+              validation_data=(xv, yv))
+    return model.evaluate(xv, yv, batch_size=batch_size)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+    res = run(epochs=args.epochs, batch_size=args.batch_size)
+    print(f"validation: {res}")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # allow `python examples/<domain>/<script>.py` from anywhere: put the
+    # repo root (two levels up) on sys.path before importing the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    main()

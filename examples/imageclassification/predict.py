@@ -1,0 +1,93 @@
+"""Image-classification zoo predict (reference
+pyzoo/zoo/examples/imageclassification/predict.py: load an ImageClassifier
+zoo model, read an image folder into an ImageSet, predict with the model's
+preprocess config, print LabelOutput top-k).
+
+Self-contained: trains a small classifier on synthetic images (class =
+bright vs dark), then runs the zoo predict path — ImageSet ->
+config preprocessing -> batched predict -> (label, prob) top-k.  Pass
+--image-dir to classify your own images instead.
+
+Usage:
+    python examples/imageclassification/predict.py --topk 2
+"""
+
+import argparse
+
+import numpy as np
+
+
+def run(n=6, size=28, topk=2, image_dir=None, epochs=10):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.image import ImageSet
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier,
+        ImageClassificationConfig,
+    )
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        Flatten,
+        MaxPooling2D,
+    )
+
+    init_zoo_context("imageclassification predict")
+
+    # tiny trainable classifier standing in for a downloaded zoo model
+    net = Sequential()
+    net.add(Convolution2D(8, 3, 3, activation="relu",
+                          input_shape=(size, size, 3)))
+    net.add(MaxPooling2D())
+    net.add(Flatten())
+    net.add(Dense(2, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    def make_images(k, seed):
+        r = np.random.default_rng(seed)
+        y = r.integers(0, 2, size=k).astype(np.int32)
+        # class 1 = bright: a clear brightness offset, not a knife-edge
+        x = (r.random((k, size, size, 3)) * 0.5 +
+             y[:, None, None, None] * 0.45).astype(np.float32)
+        return x, y
+
+    x, y = make_images(256, 0)
+    net.fit(x, y, batch_size=32, nb_epoch=epochs)
+
+    config = ImageClassificationConfig(
+        resize=size, crop=size, mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0),
+        label_map={0: "dark", 1: "bright"})
+    clf = ImageClassifier(model=net, config=config)
+
+    if image_dir:
+        image_set = ImageSet.read(image_dir)
+        truths = None
+    else:
+        imgs, ytrue = make_images(n, 1)
+        truths = ["bright" if c else "dark" for c in ytrue]
+        image_set = ImageSet.from_arrays(imgs)
+    labeled = clf.predict_image_set(image_set, top_k=topk)
+    return labeled, truths
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--image-dir", default=None)
+    ap.add_argument("--topk", type=int, default=2)
+    args = ap.parse_args()
+    labeled, truths = run(topk=args.topk, image_dir=args.image_dir)
+    for i, preds in enumerate(labeled):
+        truth = f"  (true: {truths[i]})" if truths else ""
+        top = ", ".join(f"{name}={p:.2f}" for name, p in preds)
+        print(f"image {i}: {top}{truth}")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # allow `python examples/<domain>/<script>.py` from anywhere: put the
+    # repo root (two levels up) on sys.path before importing the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    main()

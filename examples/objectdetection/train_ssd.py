@@ -75,4 +75,11 @@ def main():
 
 
 if __name__ == "__main__":
+    import os
+    import sys
+
+    # allow `python examples/<domain>/<script>.py` from anywhere: put the
+    # repo root (two levels up) on sys.path before importing the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
     main()

@@ -1,0 +1,93 @@
+"""Finetune on top of a frozen PyTorch backbone (reference
+pyzoo/zoo/examples/pytorch/train/resnet_finetune/resnet_finetune.py: a
+torchvision ResNet wrapped in TorchNet as a frozen feature extractor, with
+a trainable classifier head finetuned on cats-vs-dogs via NNClassifier).
+
+TPU-native version: the torch module runs host-side through
+``pure_callback`` (with torch autograd supplying the input gradient), the
+jax head trains on device — same freeze-backbone/train-head recipe, no
+JNI.  Offline-safe: a small randomly-initialized CNN stands in for the
+torchvision download; point --script PATH at any TorchScript module to use
+a real one.
+
+Usage:
+    python examples/pytorch/finetune.py --epochs 10
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_backbone(channels=8):
+    """Stand-in pretrained backbone (reference downloads torchvision
+    resnet; this image has no network access)."""
+    import torch
+
+    return torch.nn.Sequential(
+        torch.nn.Conv2d(3, channels, 3, padding=1),
+        torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(4),
+        torch.nn.Flatten(),
+    )
+
+
+def run(epochs=10, n=256, size=16, batch_size=32, script=None):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    init_zoo_context("pytorch finetune", seed=0)
+    import torch
+
+    class _NHWC(torch.nn.Module):
+        """Adapter: zoo layers are NHWC, torch convs are NCHW."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, x):
+            return self.inner(x.permute(0, 3, 1, 2))
+
+    inner = torch.jit.load(script, map_location="cpu") if script \
+        else make_backbone()
+    backbone = TorchNet.from_pytorch(
+        _NHWC(inner), input_shape=(size, size, 3))
+
+    model = Sequential()
+    model.add(backbone)          # frozen: torch params never update
+    model.add(Dense(2, activation="softmax"))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, size=n).astype(np.int32)
+    # "cats vs dogs" stand-in: class-dependent red/blue dominance
+    x = rng.random((n, size, size, 3)).astype(np.float32) * 0.6
+    x[:, :, :, 0] += y[:, None, None] * 0.4
+    x[:, :, :, 2] += (1 - y)[:, None, None] * 0.4
+    model.fit(x, y, batch_size=batch_size, nb_epoch=epochs)
+    return model.evaluate(x, y, batch_size=batch_size)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--script", default=None,
+                    help="TorchScript backbone path (default: built-in)")
+    args = ap.parse_args()
+    print(run(epochs=args.epochs, script=args.script))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # allow `python examples/<domain>/<script>.py` from anywhere: put the
+    # repo root (two levels up) on sys.path before importing the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    main()

@@ -1,0 +1,115 @@
+"""Streaming text classification (reference
+pyzoo/zoo/examples/streaming/textclassification/
+streaming_text_classification.py: a Spark Structured Streaming loop that
+tokenizes arriving lines and classifies them with a trained
+TextClassifier).
+
+TPU-native version: the stream is a serving broker (in-memory here; Redis
+or the file spool in production — same API), the consumer is the Cluster
+Serving micro-batch loop, and the model is a TextClassifier trained
+in-process.  New lines are tokenized with the training TextSet's
+word index and enqueued; predictions stream back per-uri.
+
+Usage:
+    python examples/streaming/streaming_text_classification.py
+"""
+
+import argparse
+import tempfile
+import threading
+
+import numpy as np
+
+_CLASS_WORDS = {0: ["game", "team", "score", "coach", "season"],
+                1: ["market", "stock", "trade", "profit", "bank"]}
+
+
+def make_corpus(n, seq_len, seed=0):
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    filler = ["the", "a", "of", "and", "to", "in", "it", "was"]
+    for _ in range(n):
+        c = int(rng.integers(0, 2))
+        words = [str(rng.choice(_CLASS_WORDS[c])) if rng.random() < 0.4
+                 else str(rng.choice(filler)) for _ in range(seq_len)]
+        texts.append(" ".join(words))
+        labels.append(c)
+    return texts, np.asarray(labels, np.int32)
+
+
+def run(n_stream=6, seq_len=20, epochs=8):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    from analytics_zoo_tpu.serving import (
+        ClusterServing,
+        ClusterServingHelper,
+        InMemoryBroker,
+        InputQueue,
+        OutputQueue,
+    )
+
+    init_zoo_context("streaming text classification")
+
+    # 1. train a TextClassifier on a toy 2-class corpus
+    texts, labels = make_corpus(512, seq_len)
+    ts = TextSet.from_texts(texts, list(labels)) \
+        .tokenize().normalize().word2idx().shape_sequence(seq_len)
+    clf = TextClassifier(class_num=2, token_length=32,
+                         sequence_length=seq_len, encoder="cnn",
+                         vocab_size=len(ts.get_word_index()) + 1)
+    clf.compile(optimizer="adam",
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(ts.to_feature_set(), batch_size=64, nb_epoch=epochs)
+
+    tmp = tempfile.mkdtemp()
+    model_path = tmp + "/textclassifier.zoo"
+    clf.model.save(model_path)
+
+    # 2. stream: broker + serving loop + client
+    broker = InMemoryBroker()
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(seq_len,),
+                             log_dir=tmp + "/logs"),
+        broker=broker)
+    server = threading.Thread(
+        target=lambda: serving.run(max_records=n_stream, idle_timeout=30),
+        daemon=True)
+    server.start()
+
+    stream_texts, truth = make_corpus(n_stream, seq_len, seed=1)
+    inq, outq = InputQueue(broker=broker), OutputQueue(broker=broker)
+    word_index = ts.get_word_index()
+    for i, line in enumerate(stream_texts):
+        toks = [word_index.get(w.lower(), 0) for w in line.split()]
+        toks = (toks + [0] * seq_len)[:seq_len]
+        inq.enqueue(f"line-{i}", np.asarray(toks, np.float32))
+    server.join(timeout=120)
+
+    results = {f"line-{i}": outq.query(f"line-{i}")
+               for i in range(n_stream)}
+    return results, truth, stream_texts
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=6)
+    args = ap.parse_args()
+    results, truth, texts = run(n_stream=args.n)
+    for i in range(args.n):
+        uri = f"line-{i}"
+        print(f"{uri}: pred={results[uri]} true={truth[i]} "
+              f"| {texts[i][:48]}...")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # allow `python examples/<domain>/<script>.py` from anywhere: put the
+    # repo root (two levels up) on sys.path before importing the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    main()

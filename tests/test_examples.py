@@ -123,3 +123,46 @@ def test_vnni_perf_example():
     assert r["size_reduction"] > 3.0, r   # ~4x from f32 -> int8 weights
     assert r["max_quant_error"] < 0.05, r
     assert r["images_per_sec_f32"] > 0
+
+
+def test_transformer_example_learns():
+    from examples.attention.transformer import run
+
+    res = run(epochs=4, n=512, batch_size=64)
+    assert res["accuracy"] > 0.7, res  # 2 classes, chance = 0.5
+
+
+def test_autograd_customloss_example_fits():
+    from examples.autograd.customloss import run
+
+    r = run(epochs=40)
+    assert r["mae"] < 0.05, r
+    np.testing.assert_allclose(r["kernel"], [1.0, 1.0], atol=0.1)
+
+
+def test_imageclassification_predict_example():
+    from examples.imageclassification.predict import run
+
+    labeled, truths = run(n=6, epochs=6)
+    assert len(labeled) == 6 and len(labeled[0]) == 2  # top-2 pairs
+    agree = sum(1 for l, t in zip(labeled, truths) if l[0][0] == t)
+    assert agree >= 5, (labeled, truths)
+
+
+def test_pytorch_finetune_example_learns():
+    from examples.pytorch.finetune import run
+
+    res = run(epochs=12, n=256)
+    assert res["accuracy"] > 0.8, res
+
+
+def test_streaming_textclassification_example():
+    from examples.streaming.streaming_text_classification import run
+
+    results, truth, _ = run(n_stream=4, epochs=6)
+    assert len(results) == 4
+    correct = sum(
+        1 for i in range(4)
+        if results[f"line-{i}"] and
+        int(results[f"line-{i}"][0][0]) == int(truth[i]))
+    assert correct >= 3, (results, truth)
