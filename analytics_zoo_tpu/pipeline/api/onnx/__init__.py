@@ -1,13 +1,17 @@
-"""ONNX model loader.
+"""ONNX model loader + exporter.
 
 Reference: pyzoo/zoo/pipeline/api/onnx/onnx_loader.py + mapper/*.py (44 op
-mappers building a zoo keras graph from an onnx ModelProto).
+mappers building a zoo keras graph from an onnx ModelProto); the export
+direction plays the role of ``NetSaver`` (Net.scala:264+, zoo model ->
+external format).
 
 TPU re-design: the graph is interpreted once at trace time into a single
 jit-compiled XLA program (:class:`OnnxNet` is an ordinary zoo Layer), with
 float initializers exposed as trainable params so imported models can be
-fine-tuned.  The protobuf is parsed by the self-contained wire codec in
-:mod:`.proto` — the ``onnx`` package is not required.
+fine-tuned.  The protobuf is parsed/written by the self-contained wire
+codec in :mod:`.proto` — the ``onnx`` package is not required either
+direction.  :func:`export_onnx` (in :mod:`.export`) serializes a trained
+Sequential/Model to ONNX bytes (NCHW, inference mode).
 """
 
 from __future__ import annotations
@@ -132,4 +136,8 @@ def load_onnx(path_or_bytes, trainable=True) -> OnnxNet:
     return OnnxNet(decode_model(data), trainable=trainable)
 
 
-__all__ = ["OnnxNet", "load_onnx", "proto", "MAPPERS"]
+from analytics_zoo_tpu.pipeline.api.onnx.export import (  # noqa: E402
+    export_onnx,
+)
+
+__all__ = ["OnnxNet", "load_onnx", "export_onnx", "proto", "MAPPERS"]
