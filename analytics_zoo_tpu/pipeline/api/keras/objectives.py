@@ -192,8 +192,11 @@ _LOSSES = {
     "msle": mean_squared_logarithmic_error,
     "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
     "binary_crossentropy": binary_crossentropy,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
     "kld": kullback_leibler_divergence,
     "kullback_leibler_divergence": kullback_leibler_divergence,
     "poisson": poisson,

@@ -201,8 +201,8 @@ def _export_flatten(ex, layer, params, state, ins, shapes, perms):
     n = int(np.prod(shape))
     if len(shape) == 3:  # (H, W, C) -> ONNX flat order is CHW
         perm = np.arange(n).reshape(shape).transpose(2, 0, 1).ravel()
-    else:
-        perm = None
+    else:  # already flat: keep whatever element order it arrived in
+        perm = perms[0]
     return out, (n,), perm
 
 

@@ -297,3 +297,58 @@ class TestAutograd:
         b = np.array([[1.0, 0.0, 0.0]], np.float32)
         res, _ = model.forward(params, [jnp.asarray(a), jnp.asarray(b)])
         np.testing.assert_allclose(np.asarray(res), [[13.0]], rtol=1e-6)
+
+
+def test_from_logits_losses_registered():
+    """Registry names for the from-logits variants (used by the
+    transformer bench and tfpark) match their probability twins."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras.objectives import get_loss
+
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 6)),
+                         jnp.float32)
+    y = jnp.asarray([0, 2, 5, 1], jnp.int32)
+    a = get_loss("sparse_categorical_crossentropy_from_logits")
+    b = get_loss("sparse_categorical_crossentropy")
+    np.testing.assert_allclose(
+        np.asarray(a.fn(y, logits)),
+        np.asarray(b.fn(y, jax.nn.softmax(logits, axis=-1))),
+        rtol=1e-5, atol=1e-6)
+    yb = jnp.asarray([0.0, 1.0, 1.0, 0.0])
+    lb = jnp.asarray([-2.0, 3.0, 0.5, -0.5])
+    c = get_loss("binary_crossentropy_from_logits")
+    d = get_loss("binary_crossentropy")
+    np.testing.assert_allclose(
+        np.asarray(c.fn(yb, lb)),
+        np.asarray(d.fn(yb, jax.nn.sigmoid(lb))), rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_remat_matches_baseline(zoo_ctx):
+    """remat=True (jax.checkpoint per block) must be a pure memory/FLOP
+    trade: identical outputs AND gradients to the non-remat stack."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import TransformerLayer
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 50, size=(2, 12)), jnp.int32)
+
+    base = TransformerLayer(vocab=50, seq_len=12, n_block=2, n_head=2,
+                            hidden_size=16, embedding_drop=0.0,
+                            hidden_drop=0.0, attn_drop=0.0)
+    params = base.init_params(jax.random.PRNGKey(0))
+    rem = TransformerLayer(vocab=50, seq_len=12, n_block=2, n_head=2,
+                           hidden_size=16, embedding_drop=0.0,
+                           hidden_drop=0.0, attn_drop=0.0, remat=True)
+
+    def loss(layer, p):
+        return jnp.sum(layer.call(p, toks, training=True,
+                                  rng=jax.random.PRNGKey(1)) ** 2)
+
+    la, ga = jax.value_and_grad(lambda p: loss(base, p))(params)
+    lb, gb = jax.value_and_grad(lambda p: loss(rem, p))(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5), ga, gb)

@@ -441,3 +441,23 @@ class TestFlatPermPropagation:
         m.build_params(jax.random.PRNGKey(7))
         with pytest.raises(ValueError, match="Flatten or a global pool"):
             export_onnx(m)
+
+
+def test_double_flatten_keeps_order(zoo_ctx):
+    """A Flatten on an already-flat tensor must propagate the CHW perm."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        Flatten,
+    )
+
+    m = Sequential()
+    m.add(Convolution2D(3, 3, 3, border_mode="same",
+                        input_shape=(4, 4, 2)))
+    m.add(Flatten())
+    m.add(Flatten())
+    m.add(Dense(4))
+    m.build_params(jax.random.PRNGKey(8))
+    x = rng0.normal(size=(2, 4, 4, 2)).astype(np.float32)
+    _roundtrip(m, x)
