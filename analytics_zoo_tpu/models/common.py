@@ -70,13 +70,14 @@ class ZooModel:
     def load_model(path):
         """Reference ZooModel.loadModel (models/common/ZooModel.scala)."""
         import os
-        import pickle
+
+        from analytics_zoo_tpu.common.safe_pickle import safe_load
 
         net = KerasNet.load(path)
         meta = path + ".zoo_meta"
         if os.path.exists(meta):
             with open(meta, "rb") as f:
-                blob = pickle.load(f)
+                blob = safe_load(f)
             obj = blob["cls"].__new__(blob["cls"])
             obj.__dict__.update(blob["cfg"])
             obj.model = net

@@ -22,6 +22,10 @@ from __future__ import annotations
 
 import os
 import pickle
+from analytics_zoo_tpu.common.safe_pickle import (
+    safe_load,
+    safe_loads,
+)
 from typing import Any, Sequence
 
 import jax
@@ -232,7 +236,7 @@ class KerasNet(_ContainerBase):
     def load_weights(self, path):
         data = np.load(path if path.endswith(".npz") else path + ".npz",
                        allow_pickle=False)
-        treedef = pickle.loads(data["treedef"].tobytes())
+        treedef = safe_loads(data["treedef"].tobytes())
         flat = [data[str(i)] for i in range(len(data.files) - 1)]
         self.params, self.state = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(a) for a in flat]
@@ -265,7 +269,7 @@ class KerasNet(_ContainerBase):
     @staticmethod
     def load(path) -> "KerasNet":
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            blob = safe_load(f)
         net = blob["net"]
         if blob["weights"] is not None:
             net.params, net.state = jax.tree_util.tree_map(

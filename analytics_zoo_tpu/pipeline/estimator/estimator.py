@@ -32,6 +32,9 @@ import dataclasses
 import logging
 import os
 import pickle
+from analytics_zoo_tpu.common.safe_pickle import (
+    safe_load,
+)
 import queue
 import threading
 import time
@@ -232,7 +235,7 @@ class _Checkpointer:
         if not files:
             return None
         with open(files[-1], "rb") as f:
-            return pickle.load(f)
+            return safe_load(f)
 
 
 class Estimator:

@@ -14,6 +14,9 @@ from __future__ import annotations
 
 import os
 import pickle
+from analytics_zoo_tpu.common.safe_pickle import (
+    safe_load,
+)
 import tempfile
 
 import jax
@@ -205,7 +208,7 @@ class GANEstimator:
 
     def _load(self):
         with open(self.checkpoint_path, "rb") as f:
-            blob = pickle.load(f)
+            blob = safe_load(f)
         self._gp, self._dp = blob["gp"], blob["dp"]
         self._gs, self._ds = blob["gs"], blob["ds"]
         self.step = blob["step"]

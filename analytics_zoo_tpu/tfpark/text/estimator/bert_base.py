@@ -102,7 +102,10 @@ class BERTBaseEstimator(TFEstimator):
         init_checkpoint: tf checkpoint restore)."""
         net = self._train_net or self._pred_net
         params, _ = net.build_params()
-        with np.load(self._init_checkpoint, allow_pickle=True) as data:
+        # plain-array archive (save_checkpoint writes np.asarray only);
+        # allow_pickle stays False so a tampered file cannot smuggle a
+        # pickle payload through an object array
+        with np.load(self._init_checkpoint, allow_pickle=False) as data:
             saved = {k: data[k] for k in data.files}
         name = self.bert.name
         bert_params = params.get(name)

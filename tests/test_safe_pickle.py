@@ -1,0 +1,127 @@
+"""CheckedUnpickler (reference CheckedObjectInputStream parity): model and
+checkpoint files are untrusted input; only whitelisted classes
+deserialize."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+
+class TestCheckedUnpickler:
+    def test_malicious_reduce_refused(self, tmp_path):
+        from analytics_zoo_tpu.common.safe_pickle import safe_load
+
+        class Evil:
+            def __reduce__(self):
+                import os
+
+                return (os.system, ("echo pwned",))
+
+        p = tmp_path / "evil.pkl"
+        p.write_bytes(pickle.dumps(Evil()))
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            with open(p, "rb") as f:
+                safe_load(f)
+
+    def test_builtin_eval_refused(self):
+        from analytics_zoo_tpu.common.safe_pickle import safe_loads
+
+        payload = b"cbuiltins\neval\n(V1+1\ntR."
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            safe_loads(payload)
+
+    def test_plain_pytrees_load(self):
+        from analytics_zoo_tpu.common.safe_pickle import safe_loads
+
+        obj = {"a": np.arange(4), "b": [1.5, {"c": (2, 3)}],
+               "s": {1, 2}, "od": __import__("collections").OrderedDict(
+                   x=1)}
+        out = safe_loads(pickle.dumps(obj))
+        np.testing.assert_array_equal(out["a"], obj["a"])
+        assert out["s"] == {1, 2}
+
+    def test_model_load_is_checked(self, zoo_ctx, tmp_path):
+        """KerasNet.load goes through the checked loader: a tampered model
+        file with a malicious payload is refused, a real one loads."""
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+        m = Sequential()
+        m.add(Dense(2, input_shape=(3,)))
+        m.build_params(jax.random.PRNGKey(0))
+        good = tmp_path / "model.zoo"
+        m.save(str(good))
+        loaded = KerasNet.load(str(good))
+        x = np.zeros((2, 3), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(loaded.predict(x)), np.asarray(m.predict(x)),
+            atol=1e-6)
+
+        class Evil:
+            def __reduce__(self):
+                import os
+
+                return (os.system, ("echo pwned",))
+
+        bad = tmp_path / "tampered.zoo"
+        bad.write_bytes(pickle.dumps({"net": Evil(), "weights": None}))
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            KerasNet.load(str(bad))
+
+    def test_checkpoint_load_is_checked(self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.pipeline.estimator.estimator import (
+            _Checkpointer,
+        )
+
+        class Evil:
+            def __reduce__(self):
+                return (print, ("pwned",))
+
+        ck = _Checkpointer(str(tmp_path))
+        (tmp_path / "ckpt-000099.pkl").write_bytes(pickle.dumps(Evil()))
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            ck.latest()
+
+
+class TestNoRootBypass:
+    """Review finding: a broad numpy/jax module-root allowance is
+    bypassable via exec-equivalent library callables; the allowlist must
+    be exact."""
+
+    def test_numpy_runstring_gadget_refused(self):
+        import io
+
+        from analytics_zoo_tpu.common.safe_pickle import safe_loads
+
+        # opcode-level global reference to numpy's exec wrapper
+        payload = (b"cnumpy.testing._private.utils\nrunstring\n"
+                   b"(Vopen('/tmp/pwned_probe','w')\n}tR.")
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            safe_loads(payload)
+
+    def test_arbitrary_numpy_function_refused(self):
+        from analytics_zoo_tpu.common.safe_pickle import safe_loads
+
+        payload = b"cnumpy\nload\n(V/etc/passwd\ntR."
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            safe_loads(payload)
+
+    def test_optax_state_and_jax_treedef_still_load(self, zoo_ctx):
+        import optax
+
+        from analytics_zoo_tpu.common.safe_pickle import safe_loads
+
+        params = {"w": np.ones((2, 2), np.float32)}
+        opt_state = optax.chain(optax.clip_by_global_norm(1.0),
+                                optax.adam(1e-3)).init(params)
+        host = jax.tree_util.tree_map(np.asarray, opt_state)
+        _, treedef = jax.tree_util.tree_flatten(params)
+        blob = pickle.dumps({"opt": host, "treedef": treedef,
+                             "step": np.int64(7)})
+        out = safe_loads(blob)
+        assert int(out["step"]) == 7
+        assert out["treedef"] == treedef
