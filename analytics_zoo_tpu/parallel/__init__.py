@@ -24,6 +24,7 @@ from analytics_zoo_tpu.parallel.partition import (  # noqa: F401
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     gpipe,
     stack_stage_params,
+    transformer_gpipe,
 )
 from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

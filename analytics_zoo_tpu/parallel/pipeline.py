@@ -128,3 +128,41 @@ def stack_stage_params(per_stage: list):
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage
     )
+
+
+def transformer_gpipe(layer, params, h, *, n_microbatch, mask=None,
+                      mesh=None, axis_name: str = PIPE_AXIS,
+                      batch_axis=None):
+    """Run a transformer block stack (TransformerLayer/BERT core) as a
+    GPipe pipeline: block i's weights live on pipe shard i.
+
+    ``layer.n_block`` must equal the pipe axis size; ``h`` is the
+    post-embedding activation (B, L, D) — embeddings and the head stay
+    replicated (they are the small ends of the model; the block stack is
+    what outgrows one chip's HBM).  ``mask`` is an additive attention mask
+    closed over every stage; because the schedule re-slices the batch into
+    microbatches, only batch-independent masks are expressible (shape
+    (L, L) or (1, 1, L, L) — shared structural masks).  Per-sample padding
+    masks (leading batch dim > 1, the BERT padded-batch case) are
+    rejected: they cannot follow the microbatch slicing through a closure.
+    Blocks run in inference mode (dropout off); the scan+ppermute schedule
+    is shared with :func:`gpipe`, so jax.grad still yields the reverse
+    pipeline for training use, and ``layer.remat=True`` is honored per
+    stage.
+    """
+    if mask is not None and mask.ndim >= 3 and mask.shape[0] != 1:
+        raise ValueError(
+            "transformer_gpipe: per-sample masks (leading batch dim "
+            f"{mask.shape[0]}) cannot follow the microbatch schedule; "
+            "only batch-independent masks are supported")
+    blocks = params["blocks"] if isinstance(params, dict) else params
+    stacked = stack_stage_params(list(blocks))
+
+    def stage_fn(bp, act):
+        return layer._block_forward(bp, act, mask, False, None)
+
+    if layer.remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    return gpipe(stage_fn, stacked, h, n_microbatch=n_microbatch,
+                 mesh=mesh, axis_name=axis_name, batch_axis=batch_axis)
