@@ -17,7 +17,10 @@ from analytics_zoo_tpu.pipeline.inference.inference_model import (
     InferenceModel,
 )
 from analytics_zoo_tpu.pipeline.inference.quantize import (
+    Int8Model,
+    calibrate_activations,
     dequantize_params,
+    quantize_model,
     quantize_params,
 )
 
@@ -26,4 +29,7 @@ __all__ = [
     "AbstractInferenceModel",
     "quantize_params",
     "dequantize_params",
+    "calibrate_activations",
+    "quantize_model",
+    "Int8Model",
 ]

@@ -55,6 +55,7 @@ class InferenceModel:
         self._compiled = {}       # shape-key -> compiled executable
         self._lock = threading.Lock()
         self._quantized = False
+        self._int8_model = None
 
     # ------------------------------------------------------------------
     # doLoad* family (InferenceModel.scala:81-657)
@@ -78,6 +79,7 @@ class InferenceModel:
         self._state = net.state
         self._compiled = {}
         self._quantized = False
+        self._int8_model = None
         return self
 
     def load_torch(self, module, input_shape) -> "InferenceModel":
@@ -93,16 +95,30 @@ class InferenceModel:
         self._compiled = {}
         return self
 
-    def optimize(self, precision: str = "int8") -> "InferenceModel":
+    def optimize(self, precision: str = "int8",
+                 calibration_data=None) -> "InferenceModel":
         """Offline optimization pass (the OpenVINO-conversion role,
         InferenceModel.scala doLoadOpenVINO* + int8 calibration).
 
         ``int8``: weight-only per-channel quantization (HBM traffic ~4x
-        lower); ``bf16``: cast weights to bfloat16 (MXU-native).
+        lower); with ``calibration_data`` (representative inputs, the
+        reference's calibration dataset), activations are calibrated too
+        and Dense/Conv layers execute int8 x int8 -> int32 on the MXU;
+        ``bf16``: cast weights to bfloat16 (MXU-native).
         """
         if self._net is None:
             raise RuntimeError("load a model first")
-        if precision == "int8":
+        self._int8_model = None  # every optimize() choice starts clean
+        self._bf16 = False
+        if precision == "int8" and calibration_data is not None:
+            from analytics_zoo_tpu.pipeline.inference.quantize import (
+                quantize_model,
+            )
+
+            self._int8_model = quantize_model(self._net, calibration_data)
+            self._params = self._int8_model.qparams
+            self._quantized = True
+        elif precision == "int8":
             self._params = quantize_params(self._net.params)
             self._quantized = True
         elif precision == "bf16":
@@ -114,6 +130,7 @@ class InferenceModel:
                 self._net.params,
             )
             self._quantized = False
+            self._bf16 = True
         else:
             raise ValueError(f"unknown precision {precision!r}")
         self._compiled = {}
@@ -131,13 +148,29 @@ class InferenceModel:
     # compile cache
     # ------------------------------------------------------------------
     def _forward_fn(self):
+        import jax.numpy as jnp
+
         net, quantized = self._net, self._quantized
+        calibrated = getattr(self, "_int8_model", None) is not None
+        bf16 = getattr(self, "_bf16", False)
 
         def fwd(params, state, xs):
-            if quantized:
+            # calibrated int8: wrapped layers read their int8 kernels from
+            # the installed apply hooks (params supplies only float leaves
+            # like biases), so no dequantization pass
+            if quantized and not calibrated:
                 params = dequantize_params(params)
+            if bf16:
+                # weights are bf16: inputs must match (conv/dot require
+                # uniform dtypes); results return in f32 for callers
+                xs = [x.astype(jnp.bfloat16)
+                      if jnp.issubdtype(x.dtype, jnp.floating) else x
+                      for x in xs]
             x = xs[0] if len(xs) == 1 else list(xs)
             out, _ = net.forward(params, x, state=state, training=False)
+            if bf16:
+                out = jax.tree_util.tree_map(
+                    lambda o: o.astype(jnp.float32), out)
             return out
 
         return fwd
@@ -149,12 +182,24 @@ class InferenceModel:
             with self._lock:
                 exe = self._compiled.get(key)
                 if exe is None:
-                    # AOT: lower + compile now, store the executable
-                    exe = (
-                        jax.jit(self._forward_fn())
-                        .lower(self._params, self._state, list(xs))
-                        .compile()
-                    )
+                    # AOT: lower + compile now, store the executable.  For
+                    # calibrated int8 the apply hooks are installed only
+                    # while tracing; the executable bakes in the int8
+                    # path.  Every compile holds the global HOOK_LOCK so
+                    # no trace can observe another model's hooks (layer
+                    # .apply is shared net-wide state).
+                    from analytics_zoo_tpu.pipeline.inference.quantize \
+                        import HOOK_LOCK
+
+                    int8 = getattr(self, "_int8_model", None)
+                    ctx = int8.installed() if int8 is not None \
+                        else HOOK_LOCK
+                    with ctx:
+                        exe = (
+                            jax.jit(self._forward_fn())
+                            .lower(self._params, self._state, list(xs))
+                            .compile()
+                        )
                     self._compiled[key] = exe
         return exe
 

@@ -24,6 +24,9 @@ def make_backbone(channels=8):
     resnet; this image has no network access)."""
     import torch
 
+    # deterministic "pretrained" weights regardless of who consumed the
+    # torch global RNG before us (test-ordering flake otherwise)
+    torch.manual_seed(0)
     return torch.nn.Sequential(
         torch.nn.Conv2d(3, channels, 3, padding=1),
         torch.nn.ReLU(),
