@@ -56,6 +56,7 @@ class InferenceModel:
         self._lock = threading.Lock()
         self._quantized = False
         self._int8_model = None
+        self._bf16 = False
 
     # ------------------------------------------------------------------
     # doLoad* family (InferenceModel.scala:81-657)
@@ -80,6 +81,7 @@ class InferenceModel:
         self._compiled = {}
         self._quantized = False
         self._int8_model = None
+        self._bf16 = False
         return self
 
     def load_torch(self, module, input_shape) -> "InferenceModel":
@@ -108,6 +110,10 @@ class InferenceModel:
         """
         if self._net is None:
             raise RuntimeError("load a model first")
+        if precision not in ("int8", "bf16"):
+            # validate BEFORE mutating: a bad precision must not leave the
+            # model half-reconfigured with stale executables
+            raise ValueError(f"unknown precision {precision!r}")
         self._int8_model = None  # every optimize() choice starts clean
         self._bf16 = False
         if precision == "int8" and calibration_data is not None:
@@ -131,8 +137,6 @@ class InferenceModel:
             )
             self._quantized = False
             self._bf16 = True
-        else:
-            raise ValueError(f"unknown precision {precision!r}")
         self._compiled = {}
         return self
 

@@ -260,12 +260,21 @@ class Int8Model:
         return _hooked(self._assignments())
 
     def predict(self, x, batch_size: int = 32):
+        # The hooks must be installed whenever a call might trace (any new
+        # batch shape), so the whole loop runs under installed(); padding
+        # the tail batch keeps the shape set to ONE executable, which also
+        # bounds how long the global HOOK_LOCK is interesting to anyone.
         with self.installed():
             outs = []
             n = np.shape(x)[0]
             for i in range(0, n, batch_size):
-                outs.append(np.asarray(self._fwd(
-                    self.qparams, jnp.asarray(x[i:i + batch_size]))))
+                xb = np.asarray(x[i:i + batch_size])
+                pad = batch_size - xb.shape[0]
+                if pad:
+                    xb = np.concatenate(
+                        [xb, np.repeat(xb[-1:], pad, axis=0)], axis=0)
+                out = np.asarray(self._fwd(self.qparams, jnp.asarray(xb)))
+                outs.append(out[:out.shape[0] - pad] if pad else out)
             return np.concatenate(outs, axis=0)
 
 

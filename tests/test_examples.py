@@ -166,3 +166,27 @@ def test_streaming_textclassification_example():
         if results[f"line-{i}"] and
         int(results[f"line-{i}"][0][0]) == int(truth[i]))
     assert correct >= 3, (results, truth)
+
+
+def _run_notebook(path):
+    """Execute every code cell of a notebook in one namespace (the apps/
+    smoke gate — the reference's 16 notebooks have no CI at all)."""
+    import json
+
+    with open(path) as f:
+        nb = json.load(f)
+    ns = {}
+    for cell in nb["cells"]:
+        if cell["cell_type"] == "code":
+            exec("".join(cell["source"]), ns)  # noqa: S102
+    return ns
+
+
+def test_getting_started_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/getting_started.ipynb"))
+    assert ns["results"]["accuracy"] > 0.85
+
+
+def test_anomaly_detection_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/anomaly_detection.ipynb"))
+    assert ns["hits"] >= 3, ns["hits"]
