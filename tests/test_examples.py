@@ -190,3 +190,15 @@ def test_getting_started_notebook_runs():
 def test_anomaly_detection_notebook_runs():
     ns = _run_notebook(os.path.join(REPO, "apps/anomaly_detection.ipynb"))
     assert ns["hits"] >= 3, ns["hits"]
+
+
+def test_streaming_objectdetection_example():
+    from examples.streaming.streaming_object_detection import run
+
+    results, out_dir = run(epochs=2, n_stream=3)
+    assert len(results) == 3
+    outs = sorted(os.listdir(out_dir))
+    assert outs == ["img-0.npy", "img-1.npy", "img-2.npy"]
+    # annotated copies keep image shape
+    a = np.load(os.path.join(out_dir, outs[0]))
+    assert a.shape == (64, 64, 3)
