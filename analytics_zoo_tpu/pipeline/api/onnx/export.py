@@ -167,8 +167,9 @@ def _export_pool2d(ex, layer, params, state, ins, shapes, perms):
     return out, out_shape, None
 
 
-def _export_gap2d(ex, layer, params, state, ins, shapes, perms):
-    pooled = ex.add("GlobalAveragePool", [ins[0]], hint=layer.name)
+def _export_globalpool2d(ex, layer, params, state, ins, shapes, perms):
+    op = "GlobalMaxPool" if layer.mode == "max" else "GlobalAveragePool"
+    pooled = ex.add(op, [ins[0]], hint=layer.name)
     out = ex.add("Flatten", [pooled], {"axis": 1})
     return out, (shapes[0][-1],), None
 
@@ -281,7 +282,8 @@ def _emitters():
         L.Convolution2D: _export_conv2d,
         L.MaxPooling2D: _export_pool2d,
         L.AveragePooling2D: _export_pool2d,
-        L.GlobalAveragePooling2D: _export_gap2d,
+        L.GlobalAveragePooling2D: _export_globalpool2d,
+        L.GlobalMaxPooling2D: _export_globalpool2d,
         L.BatchNormalization: _export_bn,
         L.Flatten: _export_flatten,
         L.Dropout: _export_dropout,

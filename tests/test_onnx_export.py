@@ -461,3 +461,21 @@ def test_double_flatten_keeps_order(zoo_ctx):
     m.build_params(jax.random.PRNGKey(8))
     x = rng0.normal(size=(2, 4, 4, 2)).astype(np.float32)
     _roundtrip(m, x)
+
+
+def test_global_max_pool_export(zoo_ctx):
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        GlobalMaxPooling2D,
+    )
+
+    m = Sequential()
+    m.add(Convolution2D(4, 3, 3, border_mode="same",
+                        input_shape=(6, 6, 2)))
+    m.add(GlobalMaxPooling2D())
+    m.add(Dense(3))
+    m.build_params(jax.random.PRNGKey(9))
+    x = rng0.normal(size=(2, 6, 6, 2)).astype(np.float32)
+    _roundtrip(m, x)
