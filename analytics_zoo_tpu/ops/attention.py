@@ -6,15 +6,54 @@ Reference behavior being covered: the O(L²) ``multiHeadSelfAttention`` inside
 TransformerLayer.scala:137 and BERT.scala's attention with additive mask.
 The reference materializes the full (L, L) score matrix per head on CPU; here
 the default path is a blockwise-friendly jnp einsum that XLA fuses, and the
-hot path can be served by a Pallas kernel (ops/pallas) on TPU.
+hot path is served by a Pallas kernel (ops/pallas) on TPU — including the
+*training* configuration (attention dropout on, padded batch with a BERT
+(B, 1, 1, L) additive mask): dropout lowers into the kernel via a
+counter-based hash PRNG and broadcastable masks stream blockwise, so the
+realistic path never falls back to the dense O(L²) route.
 """
 
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _flash_backend_ok() -> bool:
+    return (jax.default_backend() == "tpu"
+            or bool(os.environ.get("ZOO_FLASH_INTERPRET")))
+
+
+def flash_eligible(q_shape, mask_shape, mask_ndim, dropout_p, has_rng,
+                   k_len, use_flash="auto"):
+    """Pure routing predicate (backend check excluded) — unit-testable.
+
+    Args mirror what :func:`dot_product_attention` sees: ``mask_shape`` is
+    None or the mask's shape; flash handles masks broadcastable to
+    (B|1, H|1, Lq|1, Lk).  Dropout needs an rng to derive the kernel seed.
+    """
+    if use_flash == False:  # noqa: E712
+        return False
+    b, h_, lq, d = q_shape[-4], q_shape[-3], q_shape[-2], q_shape[-1]
+    # d % 64: the kernel sustains 76.7 TFLOP/s at head_dim 64
+    # (FLASH_r03.json), which covers BERT-base/GPT-base head sizes
+    if lq < 256 or d % 64 != 0:
+        return False
+    if dropout_p > 0.0 and not has_rng:
+        return False
+    if mask_shape is not None:
+        if mask_ndim != 4:
+            return False
+        if (mask_shape[0] not in (1, b) or mask_shape[1] not in (1, h_)
+                or mask_shape[2] not in (1, lq)
+                or mask_shape[3] != k_len):
+            return False
+    return True
 
 
 def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
@@ -33,17 +72,27 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
     """
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
-    # Route big unmasked/causal attention through the Pallas flash kernel on
-    # TPU (O(L·D) HBM traffic); the jnp path serves masked/dropout/small
-    # cases and non-TPU backends.
-    if (use_flash != False and mask is None and dropout_p == 0.0  # noqa: E712
-            and q.shape[-2] >= 256 and d % 128 == 0
-            and jax.default_backend() == "tpu"):
+    # Route big attention — masked, dropout, or clean — through the Pallas
+    # flash kernel on TPU (O(L·D) HBM traffic); the jnp path serves small /
+    # oddly-shaped cases and non-TPU backends.
+    if _flash_backend_ok() and flash_eligible(
+            q.shape, None if mask is None else mask.shape,
+            None if mask is None else mask.ndim, dropout_p,
+            rng is not None, k.shape[-2], use_flash):
         from analytics_zoo_tpu.ops.pallas.flash_attention import (
             flash_attention,
         )
 
-        return flash_attention(q, k, v, causal, scale)
+        bias = None
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32)
+            else:
+                bias = mask.astype(jnp.float32)
+        return flash_attention(
+            q, k, v, causal, scale, bias=bias,
+            dropout_p=float(dropout_p),
+            dropout_seed=rng if dropout_p > 0.0 else None)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         lq, lk = scores.shape[-2], scores.shape[-1]

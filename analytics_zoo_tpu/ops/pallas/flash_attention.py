@@ -7,6 +7,20 @@ numerically-stable online-softmax accumulation, so HBM traffic is O(L·D)
 per head instead of O(L²), and the score block lives only in VMEM where the
 MXU consumes it.
 
+Training-path features (so real TransformerLayer/BERT training — dropout
+on, padded batches — lowers to this kernel instead of the dense path):
+
+* **additive bias/mask**: any shape broadcastable as (B|1, H|1, Lq|1, Lk)
+  — covers the BERT (B, 1, 1, L) padding-mask convention (BERT.scala:66)
+  and full (B, H, Lq, Lk) biases, streamed blockwise;
+* **segment ids**: (B, Lq)/(B, Lk) int arrays; attention is masked where
+  q/k segments differ (packed-sequence training);
+* **attention dropout**: computed *inside* the kernel from a counter-based
+  hash PRNG (`_keep_bits`) keyed on (seed, b, h, q_pos, k_pos).  The same
+  pure function runs in the Pallas forward, the jnp fallback forward, and
+  the blockwise backward, so the dropout mask is bit-identical across
+  forward/backward without ever being materialized in HBM.
+
 Semantics: causal masking is *end-aligned* for lq != lk (query i sees keys
 0..(lk-lq)+i), matching the jnp path in ops/attention.py — the decode-style
 convention where q is the tail of the key sequence.
@@ -15,7 +29,9 @@ Gradient support: ``flash_attention`` is wrapped in jax.custom_vjp; the
 backward recomputes attention **blockwise** with a lax.scan over key blocks
 (O(Lq·block_k) live memory, the standard flash rematerialisation strategy),
 so long-context training never materializes the (L, L) matrix.  On CPU
-(tests) the forward falls back to the jnp path automatically.
+(tests) the forward falls back to the jnp path automatically; set
+``ZOO_FLASH_INTERPRET=1`` to force the actual Pallas kernel in interpret
+mode on CPU (CI routing tests).
 """
 
 from __future__ import annotations
@@ -23,31 +39,120 @@ from __future__ import annotations
 import functools
 import logging
 import math
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _NEG = -1e30
 
+# Trace-time routing counters (tests assert the kernel actually fires for
+# training-shaped inputs; jit traces once so these count compilations).
+invocation_counts = {"pallas": 0, "fallback": 0}
 
-def _attention_reference(q, k, v, causal, scale):
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+# ---------------------------------------------------------------------------
+# Counter-based dropout hash.  splitmix32-style finalizer over a position/
+# seed counter: stateless, identical in Pallas and jnp, so fwd/bwd agree.
+# ---------------------------------------------------------------------------
+_C1 = np.uint32(0x9E3779B9)
+_C2 = np.uint32(0x85EBCA6B)
+_C3 = np.uint32(0xC2B2AE35)
+_C4 = np.uint32(0x27D4EB2F)
+
+
+def _mix32(x):
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * np.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _keep_bits(seed0, seed1, b, h, q_pos, k_pos):
+    """uint32 hash tile; shape follows broadcasting of q_pos × k_pos."""
+    def u(t):
+        return jnp.asarray(t).astype(jnp.uint32)
+
+    x = (u(q_pos) * _C1) ^ (u(k_pos) * _C2)
+    x = x ^ (u(b) * _C3) ^ (u(h) * _C4)
+    x = x ^ u(seed0) ^ (u(seed1) * _C2)
+    return _mix32(x)
+
+
+def _drop_threshold(dropout_p):
+    return np.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+
+
+def _normalize_seed(seed):
+    """Accept int, PRNG key, or int array; return (2,) int32."""
+    if seed is None:
+        return None
+    if isinstance(seed, int):
+        return jnp.asarray([seed, 0], jnp.int32)
+    seed = jnp.asarray(seed)
+    if jnp.issubdtype(seed.dtype, jax.dtypes.prng_key):
+        seed = jax.random.key_data(seed)
+    seed = seed.reshape(-1)
+    if seed.dtype != jnp.int32:
+        seed = jax.lax.bitcast_convert_type(seed.astype(jnp.uint32),
+                                            jnp.int32)
+    if seed.shape[0] == 1:
+        seed = jnp.concatenate([seed, jnp.zeros((1,), jnp.int32)])
+    return seed[:2]
+
+
+# ---------------------------------------------------------------------------
+# Dense reference (CPU fallback + test oracle)
+# ---------------------------------------------------------------------------
+
+
+def _attention_reference(q, k, v, causal, scale, bias=None, q_seg=None,
+                         kv_seg=None, dropout_p=0.0, seed=None):
+    scores = jnp.einsum("bhqd,bhkd->bhqk",
+                        q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    lq, lk = scores.shape[-2], scores.shape[-1]
+    live = None
     if causal:
-        lq, lk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
-        scores = jnp.where(mask, scores, _NEG)
-    probs = jax.nn.softmax(scores, axis=-1)
-    if causal:
-        # keyless rows (lq > lk end-aligned) output zero, matching the
-        # streaming kernel's acc/max(l, eps) and the blockwise backward —
-        # not softmax's uniform distribution over fully-masked rows
-        any_key = jnp.any(mask, axis=-1)
-        probs = jnp.where(any_key[..., None], probs, 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        live = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)[None, None]
+    if q_seg is not None:
+        seg_live = (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+        live = seg_live if live is None else live & seg_live
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if live is not None:
+        scores = jnp.where(live, scores, _NEG)
+    # softmax with the kernel's exact semantics: the running-max floor at
+    # _NEG means rows that are fully masked (by `live` OR by a large
+    # negative bias) produce zero output, not softmax's uniform row
+    m2 = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), _NEG)
+    p = jnp.exp(scores - m2)
+    if live is not None:
+        p = jnp.where(live, p, 0.0)
+    probs = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-20)
+    if dropout_p > 0.0:
+        b, h = scores.shape[0], scores.shape[1]
+        bits = _keep_bits(
+            seed[0], seed[1],
+            jnp.arange(b, dtype=jnp.int32)[:, None, None, None],
+            jnp.arange(h, dtype=jnp.int32)[None, :, None, None],
+            jnp.arange(lq, dtype=jnp.int32)[None, None, :, None],
+            jnp.arange(lk, dtype=jnp.int32)[None, None, None, :])
+        keep = bits >= _drop_threshold(dropout_p)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                      interpret=False):
+                      interpret=False, bias=None, q_seg=None, kv_seg=None,
+                      dropout_p=0.0, seed=None):
     """Streaming forward: K/V blocks are a GRID dimension.
 
     grid = (b, h, n_q, n_k) with the key-block index innermost; Pallas's
@@ -67,8 +172,29 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     block_k = min(block_k, lk)
     n_q = pl.cdiv(lq, block_q)
     n_k = pl.cdiv(lk, block_k)
+    has_bias = bias is not None
+    has_seg = q_seg is not None
+    has_drop = dropout_p > 0.0
+    if has_bias:
+        bb, bh, bq, _ = bias.shape
+        bq_blk = block_q if bq > 1 else 1
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+    def kernel(*refs):
+        i = 3
+        q_ref, k_ref, v_ref = refs[:3]
+        if has_bias:
+            bias_ref = refs[i]
+            i += 1
+        if has_seg:
+            qseg_ref, kseg_ref = refs[i:i + 2]
+            i += 2
+        if has_drop:
+            seed_ref = refs[i]
+            i += 1
+        o_ref, m_ref, l_ref, acc_ref = refs[i:i + 4]
+
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -102,18 +228,31 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                 jnp.int32, (block_q, 1), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
-            # mask padded key rows (lk % block_k != 0) and, if causal, the
-            # end-aligned upper triangle
+            if has_bias:
+                s = s + bias_ref[0, 0].astype(jnp.float32)
+            # mask padded key rows (lk % block_k != 0), if causal the
+            # end-aligned upper triangle, and cross-segment pairs
             live = k_pos < lk
             if causal:
                 live = live & (q_pos + offset >= k_pos)
+            if has_seg:
+                sq = qseg_ref[0].reshape(block_q, 1)
+                sk = kseg_ref[0].reshape(1, block_k)
+                live = live & (sq == sk)
             s = jnp.where(live, s, _NEG)
             m, l = m_ref[...], l_ref[...]
             new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m - new_m)
             p = jnp.where(live, jnp.exp(s - new_m), 0.0)
             m_ref[...] = new_m
+            # l is the full softmax denominator (pre-dropout), so the final
+            # acc / l division reproduces dropout-after-softmax semantics
             l_ref[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if has_drop:
+                bits = _keep_bits(seed_ref[0], seed_ref[1], bi, hi,
+                                  q_pos, k_pos)
+                p = jnp.where(bits >= _drop_threshold(dropout_p),
+                              p * (1.0 / (1.0 - dropout_p)), 0.0)
             acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -132,21 +271,46 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                 acc_ref[...] / jnp.maximum(l_ref[...], 1e-20)
             ).astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bq_blk, block_k),
+            lambda bi, hi, qi, ki, _bb=bb, _bh=bh, _bq=bq: (
+                bi if _bb > 1 else 0, hi if _bh > 1 else 0,
+                qi if _bq > 1 else 0, ki),
+            memory_space=pltpu.VMEM))
+        args.append(bias.astype(jnp.float32))
+    if has_seg:
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda bi, hi, qi, ki: (bi, qi),
+            memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda bi, hi, qi, ki: (bi, ki),
+            memory_space=pltpu.VMEM))
+        args.append(q_seg.astype(jnp.int32))
+        args.append(kv_seg.astype(jnp.int32))
+    if has_drop:
+        in_specs.append(pl.BlockSpec(
+            (2,), lambda bi, hi, qi, ki: (0,),
+            memory_space=pltpu.SMEM))
+        args.append(seed.astype(jnp.int32))
+
     grid = (b, h, n_q, n_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0),
                                memory_space=pltpu.VMEM),
@@ -161,12 +325,17 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _resolve_blocks(lq: int, block_q, block_k) -> tuple[int, int]:
+def _resolve_blocks(lq: int, block_q, block_k,
+                    full_bias: bool = False) -> tuple[int, int]:
     """Tuned defaults (v5e sweep, FLASH_r03.json): big blocks amortize
-    grid-step overhead; VMEM caps block_q at 1024 once lq >= 8192."""
+    grid-step overhead; VMEM caps block_q at 1024 once lq >= 8192.  A full
+    (…, Lq, Lk) bias streams an extra (block_q, block_k) f32 tile, so its
+    blocks drop to 512² to stay inside the ~16 MB VMEM budget."""
+    if full_bias:
+        return block_q or 512, block_k or 512
     if block_q is None:
         block_q = 2048 if lq <= 4096 else 1024
     if block_k is None:
@@ -174,28 +343,40 @@ def _resolve_blocks(lq: int, block_q, block_k) -> tuple[int, int]:
     return block_q, block_k
 
 
+def _interpret_forced() -> bool:
+    return bool(os.environ.get("ZOO_FLASH_INTERPRET"))
+
+
 def _pallas_available() -> bool:
-    return jax.default_backend() == "tpu"
+    return jax.default_backend() == "tpu" or _interpret_forced()
 
 
 _warned_fallback = False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None):
-    """Fused attention: Pallas kernel on TPU, jnp fallback elsewhere.
+# ---------------------------------------------------------------------------
+# custom_vjp core: array args explicit so bias/segments/seed differentiate
+# (or get float0 cotangents) correctly.
+# ---------------------------------------------------------------------------
 
-    Default blocks are tuned from the v5e sweep in FLASH_r03.json:
-    (2048, 1024) sustains 112 TF vs 24 TF at 256x256 (grid-step overheads
-    dominate small blocks), but the scoped-VMEM budget caps block_q at
-    1024 for sequences >= 8192 — ``_resolve_blocks`` encodes both."""
-    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
-    block_q, block_k = _resolve_blocks(q.shape[2], block_q, block_k)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_core(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
+                dropout_p, block_q, block_k):
+    return _forward_impl(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
+                         dropout_p, block_q, block_k)
+
+
+def _forward_impl(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
+                  dropout_p, block_q, block_k):
     if _pallas_available():
         try:
-            return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
-                                     block_k)
+            out = _flash_fwd_pallas(
+                q, k, v, causal, scale, block_q, block_k,
+                interpret=_interpret_forced(), bias=bias, q_seg=q_seg,
+                kv_seg=kv_seg, dropout_p=dropout_p, seed=seed)
+            invocation_counts["pallas"] += 1
+            return out
         except Exception:
             # Do NOT silently degrade to the O(L²) path on TPU: warn loudly
             # (once) with the actual kernel error so a broken kernel is
@@ -207,30 +388,33 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     "Pallas flash-attention kernel failed on TPU; falling "
                     "back to the O(L^2) jnp path. THIS IS A PERFORMANCE BUG."
                 )
-    return _attention_reference(q, k, v, causal, scale)
+    invocation_counts["fallback"] += 1
+    return _attention_reference(q, k, v, causal, scale, bias=bias,
+                                q_seg=q_seg, kv_seg=kv_seg,
+                                dropout_p=dropout_p, seed=seed)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v, out)
+def _fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, scale, dropout_p,
+         block_q, block_k):
+    out = _flash_core(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
+                      dropout_p, block_q, block_k)
+    return out, (q, k, v, bias, q_seg, kv_seg, seed, out)
 
 
-def _block_mask(q_pos, k_pos, lk, offset, causal):
-    live = k_pos[None, :] < lk
-    if causal:
-        live = live & (q_pos[:, None] + offset >= k_pos[None, :])
-    return live  # (lq, block_k)
-
-
-def _bwd(causal, scale, block_q, block_k, res, g):
+def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
     """Blockwise flash backward: lax.scan over key blocks, recomputing each
     (lq, block_k) score tile from q/k (rematerialisation).  Live memory is
-    O(lq·block_k + lk·d); the (lq, lk) matrix is never materialized."""
-    q, k, v, out = res
+    O(lq·block_k + lk·d); the (lq, lk) matrix is never materialized.
+    Dropout is re-derived from the same `_keep_bits` hash the forward used,
+    so no mask is stored."""
+    q, k, v, bias, q_seg, kv_seg, seed, out = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale_v = 1.0 / math.sqrt(d) if scale is None else scale
     offset = lk - lq
+    has_bias = bias is not None
+    has_seg = q_seg is not None
+    has_drop = dropout_p > 0.0
     # The backward keeps its own 256 default: its scan materializes
     # (b, h, lq, bk) f32 score/grad tiles in HBM, so the forward kernel's
     # 1024 tuning would quadruple live memory and can OOM long-context
@@ -248,14 +432,40 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     vb_s = jnp.moveaxis(vp.reshape(b, h, n_k, bk, d), 2, 0)
     kpos_s = jnp.arange(n_k * bk, dtype=jnp.int32).reshape(n_k, bk)
     q_pos = jnp.arange(lq, dtype=jnp.int32)
+    if has_bias:
+        bb, bh, bq, _ = bias.shape
+        bias_p = jnp.pad(bias.astype(jnp.float32),
+                         ((0, 0), (0, 0), (0, 0), (0, pad)))
+        bias_s = jnp.moveaxis(bias_p.reshape(bb, bh, bq, n_k, bk), 3, 0)
+    else:
+        bias_s = jnp.zeros((n_k, 1, 1, 1, 1), jnp.float32)
+    if has_seg:
+        kseg_p = jnp.pad(kv_seg.astype(jnp.int32), ((0, 0), (0, pad)),
+                         constant_values=-1)
+        kseg_s = jnp.moveaxis(kseg_p.reshape(b, n_k, bk), 1, 0)
+        qseg = q_seg.astype(jnp.int32)
+    else:
+        kseg_s = jnp.zeros((n_k, 1, 1), jnp.int32)
+        qseg = None
+
+    def block_scores(kb, kpos, bias_blk, kseg_blk):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale_v
+        if has_bias:
+            s = s + bias_blk
+        live = (kpos < lk)[None, :]  # (1, bk) -> broadcast (lq, bk)
+        if causal:
+            live = live & (q_pos[:, None] + offset >= kpos[None, :])
+        live = live[None, None]  # (1, 1, lq, bk)
+        if has_seg:
+            live = live & (qseg[:, None, :, None] ==
+                           kseg_blk[:, None, None, :])
+        return jnp.where(live, s, _NEG), live
 
     # pass 1: streaming softmax stats (m, l) per query row
     def stats_step(carry, xs):
         m, l = carry
-        kb, kpos = xs
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale_v
-        live = _block_mask(q_pos, kpos, lk, offset, causal)
-        s = jnp.where(live, s, _NEG)
+        kb, kpos, bias_blk, kseg_blk = xs
+        s, live = block_scores(kb, kpos, bias_blk, kseg_blk)
         new_m = jnp.maximum(m, jnp.max(s, axis=-1))
         l = l * jnp.exp(m - new_m) + jnp.sum(
             jnp.where(live, jnp.exp(s - new_m[..., None]), 0.0), axis=-1)
@@ -263,30 +473,111 @@ def _bwd(causal, scale, block_q, block_k, res, g):
 
     m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
-    (m, l), _ = jax.lax.scan(stats_step, (m0, l0), (kb_s, kpos_s))
+    (m, l), _ = jax.lax.scan(stats_step, (m0, l0),
+                             (kb_s, kpos_s, bias_s, kseg_s))
     l_safe = jnp.maximum(l, 1e-20)
-    # D_i = sum_j P_ij (dO_i · V_j) = dO_i · O_i  (flash-bwd identity)
+    # D_i = sum_j P~_ij (dO_i · V_j) = dO_i · O_i  (flash-bwd identity;
+    # holds with dropout because O already contains the dropped P~)
     D = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, h, lq)
+    if has_drop:
+        thr = _drop_threshold(dropout_p)
+        inv_keep = 1.0 / (1.0 - dropout_p)
+        b_idx = jnp.arange(b, dtype=jnp.int32)[:, None, None, None]
+        h_idx = jnp.arange(h, dtype=jnp.int32)[None, :, None, None]
 
-    # pass 2: accumulate dQ; emit per-block dK/dV
+    # pass 2: accumulate dQ; emit per-block dK/dV (and dbias tiles)
     def grad_step(dq, xs):
-        kb, vb, kpos = xs
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale_v
-        live = _block_mask(q_pos, kpos, lk, offset, causal)
+        kb, vb, kpos, bias_blk, kseg_blk = xs
+        s, live = block_scores(kb, kpos, bias_blk, kseg_blk)
         p = jnp.where(live, jnp.exp(s - m[..., None]), 0.0) / l_safe[
             ..., None]
+        if has_drop:
+            bits = _keep_bits(seed[0], seed[1], b_idx, h_idx,
+                              q_pos[None, None, :, None],
+                              kpos[None, None, None, :])
+            t = jnp.where(bits >= thr, inv_keep, 0.0)
+            p_t = p * t
+        else:
+            p_t = p
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vb)
-        ds = p * (dp - D[..., None]) * scale_v
+        # softmax jacobian: dL/ds = P (t·dp − D); the q·k scale folds into
+        # dq/dk below, while dbias takes the unscaled dL/ds
+        ds_raw = p * ((dp * t if has_drop else dp) - D[..., None])
+        ds = ds_raw * scale_v
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
         dkb = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        dvb = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-        return dq, (dkb, dvb)
+        dvb = jnp.einsum("bhqk,bhqd->bhkd", p_t, gf)
+        if has_bias:
+            db = ds_raw
+            if bb == 1:
+                db = jnp.sum(db, axis=0, keepdims=True)
+            if bh == 1:
+                db = jnp.sum(db, axis=1, keepdims=True)
+            if bq == 1:
+                db = jnp.sum(db, axis=2, keepdims=True)
+        else:
+            db = jnp.zeros((1, 1, 1, bk), jnp.float32)
+        return dq, (dkb, dvb, db)
 
     dq0 = jnp.zeros_like(qf)
-    dq, (dk_s, dv_s) = jax.lax.scan(grad_step, dq0, (kb_s, vb_s, kpos_s))
+    dq, (dk_s, dv_s, db_s) = jax.lax.scan(
+        grad_step, dq0, (kb_s, vb_s, kpos_s, bias_s, kseg_s))
     dk = jnp.moveaxis(dk_s, 0, 2).reshape(b, h, n_k * bk, d)[:, :, :lk]
     dv = jnp.moveaxis(dv_s, 0, 2).reshape(b, h, n_k * bk, d)[:, :, :lk]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    if has_bias:
+        dbias = jnp.moveaxis(db_s, 0, 3).reshape(
+            bb, bh, bq, n_k * bk)[..., :lk].astype(bias.dtype)
+    else:
+        dbias = None
+    dseg_q = (np.zeros(q_seg.shape, dtype=jax.dtypes.float0)
+              if has_seg else None)
+    dseg_kv = (np.zeros(kv_seg.shape, dtype=jax.dtypes.float0)
+               if has_seg else None)
+    dseed = (np.zeros(seed.shape, dtype=jax.dtypes.float0)
+             if seed is not None else None)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias, dseg_q, dseg_kv, dseed)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_core.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, *, bias=None, q_segment_ids=None,
+                    kv_segment_ids=None, dropout_p=0.0, dropout_seed=None):
+    """Fused attention: Pallas kernel on TPU, jnp fallback elsewhere.
+
+    Args:
+      q, k, v: (B, H, L, D).
+      bias: optional additive f32 mask/bias, shape (B|1, H|1, Lq|1, Lk) —
+        the BERT (B, 1, 1, L) padding mask streams as (1, block_k) tiles.
+      q_segment_ids / kv_segment_ids: optional (B, Lq)/(B, Lk) int arrays;
+        attention masked where segments differ (packed sequences).
+      dropout_p: attention-prob dropout; requires ``dropout_seed`` (int,
+        PRNG key, or (2,) int array).  The mask is hash-derived in-kernel.
+
+    Default blocks are tuned from the v5e sweep in FLASH_r03.json:
+    (2048, 1024) sustains 112 TF vs 24 TF at 256x256 (grid-step overheads
+    dominate small blocks), but the scoped-VMEM budget caps block_q at
+    1024 for sequences >= 8192 — ``_resolve_blocks`` encodes both."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias.ndim != 4 or bias.shape[3] != lk or \
+                bias.shape[0] not in (1, b) or bias.shape[1] not in (1, h) \
+                or bias.shape[2] not in (1, lq):
+            raise ValueError(
+                f"bias shape {bias.shape} not broadcastable to "
+                f"({b}|1, {h}|1, {lq}|1, {lk})")
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = _normalize_seed(dropout_seed) if dropout_p > 0.0 else None
+    full_bias = bias is not None and bias.shape[2] > 1
+    block_q, block_k = _resolve_blocks(lq, block_q, block_k, full_bias)
+    return _flash_core(q, k, v, bias, q_segment_ids, kv_segment_ids, seed,
+                       causal, scale, float(dropout_p), block_q, block_k)

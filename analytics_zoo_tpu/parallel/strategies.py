@@ -101,6 +101,94 @@ def tp_mlp(x, w1, b1, w2, b2, axis_name=MODEL_AXIS, activation=jax.nn.gelu):
     return row_parallel_dense(h, w2, b2, axis_name=axis_name)
 
 
+def moe_mlp_topk(x, gate_w, w1, b1, w2, b2, top_k=2, capacity_factor=1.25,
+                 axis_name=None, renormalize=False, return_aux=False):
+    """GShard/Switch-style **routed** MoE feed-forward: top-k routing with
+    expert capacity and ``all_to_all`` dispatch over the ``expert`` mesh
+    axis.  This is the scalable counterpart of :func:`ep_moe_mlp` (dense
+    dispatch, kept as the correctness oracle: with ``top_k=E`` and
+    ``capacity_factor`` >= 1 the two are numerically equal).
+
+    Per shard: tokens pick their top-k experts from the full router; the
+    assignment stream is priority-ordered (all 1st choices first, then 2nd
+    choices, token order within a choice) and each expert accepts at most
+    ``C = ceil(capacity_factor * top_k * T / E)`` assignments — the rest
+    are dropped (output contribution zero, the standard Switch semantics).
+    Kept tokens are scattered into a per-expert ``(E, C, D)`` buffer, an
+    ``all_to_all`` ships each expert's buffer to its owning shard, the
+    owner runs its experts' MLP on ``(E_local, n_shards*C, D)``, and the
+    reverse ``all_to_all`` + gather + gate-weighted scatter-add rebuilds
+    the token outputs.  EP FLOPs are O(top_k/E) of dense dispatch.
+
+    Args (inside shard_map, all local views):
+      x: (T, D) this shard's tokens (shard tokens over the expert axis; a
+        replicated x is also correct, just redundant compute).
+      gate_w: (D, E) the FULL router, replicated over the expert axis.
+      w1: (E_local, D, F), b1: (E_local, F), w2: (E_local, F, D): this
+        shard's experts.  b2: (D,) replicated.
+      renormalize: rescale the k gate values to sum to 1 (GShard top-2
+        convention); default False (Switch: raw softmax probs).
+      return_aux: also return the load-balancing auxiliary loss
+        (E * sum_e mean_prob_e * frac_first_choice_e, pmean'd over the
+        expert axis — ~1.0 when perfectly balanced).
+    Returns: (T, D) [, aux scalar].
+    """
+    import math
+
+    from analytics_zoo_tpu.common.engine import EXPERT_AXIS
+
+    axis_name = axis_name or EXPERT_AXIS
+    t, d = x.shape
+    e_local = w1.shape[0]
+    e = gate_w.shape[1]
+    if e % e_local:
+        raise ValueError(
+            f"router width E={e} must be a multiple of the local expert "
+            f"count E_local={e_local} (w1 leading dim)")
+    cap = int(math.ceil(capacity_factor * top_k * t / e))
+    cap = max(1, min(cap, t))
+
+    probs = jax.nn.softmax((x @ gate_w).astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)  # (T, k)
+    if renormalize:
+        top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+    # assignment stream, priority-ordered: k-major so every token's 1st
+    # choice outranks any 2nd choice in the capacity race
+    expert = top_idx.T.reshape(-1)                      # (kT,)
+    gatev = top_vals.T.reshape(-1).astype(x.dtype)      # (kT,)
+    tok = jnp.tile(jnp.arange(t), top_k)                # (kT,)
+    oh = jax.nn.one_hot(expert, e, dtype=jnp.int32)     # (kT, E)
+    slot = jnp.sum((jnp.cumsum(oh, 0) - 1) * oh, 1)     # slot within expert
+    keep = slot < cap
+    slot_c = jnp.where(keep, slot, 0)
+    # scatter kept tokens into per-expert buffers; dropped assignments
+    # scatter-add zeros (slot collisions impossible for kept: cumsum slots
+    # are unique per expert)
+    contrib = jnp.where(keep[:, None], x[tok], 0.0)
+    buf = jnp.zeros((e, cap, d), x.dtype).at[expert, slot_c].add(contrib)
+    # ship each expert's buffer to its owner shard; receive every shard's
+    # buffer for OUR experts: (E, C, D) -> (E_local, n_sh*C, D)
+    recv = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=1,
+                              tiled=True)
+    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", recv, w1) + b1[:, None, :])
+    y = jnp.einsum("etf,efd->etd", h, w2)  # (E_local, n_sh*C, D)
+    # reverse path: give every shard back its slots
+    back = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                              tiled=True)  # (E, C, D)
+    got = back[expert, slot_c] * jnp.where(keep, gatev, 0.0)[:, None]
+    out = jnp.zeros((t, d), x.dtype).at[tok].add(got) + b2
+    if not return_aux:
+        return out
+    # GShard load-balance loss on global statistics (tokens are sharded
+    # over the expert axis, so pmean the per-shard means)
+    me = jax.lax.pmean(jnp.mean(probs, 0), axis_name)
+    ce = jax.lax.pmean(
+        jnp.mean(jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32), 0),
+        axis_name)
+    aux = e * jnp.sum(me * ce)
+    return out, aux
+
+
 def ep_moe_mlp(x, gate_w, w1, b1, w2, b2, axis_name=None):
     """Expert-parallel dense-dispatch MoE feed-forward.
 

@@ -284,3 +284,224 @@ class TestTransformerGPipe:
         with pytest.raises(ValueError, match="per-sample masks"):
             transformer_gpipe(layer, params, h, n_microbatch=4,
                               mask=jnp.zeros((8, 1, 8, 8)))
+
+
+class TestGPipeHetero:
+    """Non-shape-preserving pipelines (VERDICT r03 weak #6): stage
+    boundaries change shape/dtype; union-buffer carry + lax.switch."""
+
+    def test_changing_shapes_match_sequential(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe_hetero
+
+        rng = np.random.default_rng(0)
+        w0 = rng.normal(0, .5, (4, 10)).astype(np.float32)
+        w1 = rng.normal(0, .5, (10, 6)).astype(np.float32)
+        w2 = rng.normal(0, .5, (6, 6)).astype(np.float32)
+        w3 = rng.normal(0, .5, (6, 3)).astype(np.float32)
+        edge = [{"w": w0}, {"w": w1}, {"w": w2}, {"w": w3}]
+        fns = [lambda e, s, a: jnp.tanh(a @ e["w"])] * 4
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+
+        def seq(x):
+            a = jnp.asarray(x)
+            for wi in (w0, w1, w2, w3):
+                a = jnp.tanh(a @ wi)
+            return a
+
+        out = gpipe_hetero(fns, edge, {}, jnp.asarray(x), n_microbatch=8)
+        assert out.shape == (16, 3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(seq(x)),
+                                   atol=1e-5)
+
+    def test_int_tokens_and_pytree_boundary(self, pipe_ctx):
+        """Stage 0 consumes int32 tokens (bitcast through the f32 union
+        buffer must be exact) and emits a pytree boundary."""
+        from analytics_zoo_tpu.parallel.pipeline import gpipe_hetero
+
+        rng = np.random.default_rng(1)
+        table = rng.normal(0, .5, (50, 8)).astype(np.float32)
+        w = rng.normal(0, .5, (8, 8)).astype(np.float32)
+        wh = rng.normal(0, .5, (8, 5)).astype(np.float32)
+        toks = rng.integers(0, 50, size=(8, 6)).astype(np.int32)
+
+        def f0(e, s, t):
+            h = jnp.take(e["tbl"], t, axis=0)
+            return {"h": h, "t": t}
+
+        def f1(e, s, d):
+            return {"h": jnp.tanh(d["h"] @ e["w"]), "t": d["t"]}
+
+        def f2(e, s, d):
+            return d["h"] + jnp.take(e["tbl"], d["t"], axis=0)
+
+        def f3(e, s, h):
+            return h @ e["wh"]
+
+        edge = [{"tbl": table}, {"w": w}, {"tbl": table}, {"wh": wh}]
+        out = gpipe_hetero([f0, f1, f2, f3], edge, {}, jnp.asarray(toks),
+                           n_microbatch=4)
+        emb = table[toks]
+        ref = (np.tanh(emb @ w) + emb) @ wh
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def test_grads_match_sequential(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe_hetero
+
+        rng = np.random.default_rng(2)
+        edge = [{"w": rng.normal(0, .5, (4, 7)).astype(np.float32)},
+                {"w": rng.normal(0, .5, (7, 5)).astype(np.float32)},
+                {"w": rng.normal(0, .5, (5, 5)).astype(np.float32)},
+                {"w": rng.normal(0, .5, (5, 2)).astype(np.float32)}]
+        fns = [lambda e, s, a: jnp.tanh(a @ e["w"])] * 4
+        x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+
+        def piped(edge, x):
+            return jnp.mean(gpipe_hetero(fns, list(edge), {}, x,
+                                         n_microbatch=4) ** 2)
+
+        def seq(edge, x):
+            a = x
+            for e in edge:
+                a = jnp.tanh(a @ e["w"])
+            return jnp.mean(a ** 2)
+
+        gp, gx = jax.grad(piped, argnums=(0, 1))(tuple(edge), x)
+        rp, rx = jax.grad(seq, argnums=(0, 1))(tuple(edge), x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5), gp, rp)
+
+    def test_full_lm_embed_blocks_head(self, pipe_ctx):
+        """The GPT stack (tools/transformer_bench.py shape) pipelined
+        end-to-end: tokens -> embed -> 4 blocks -> LM head, vs the
+        sequential model.  Forward and grads."""
+        from analytics_zoo_tpu.parallel.pipeline import transformer_gpipe_lm
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            TransformerLayer,
+        )
+
+        layer = TransformerLayer(vocab=32, seq_len=8, n_block=4, n_head=2,
+                                 hidden_size=16, embedding_drop=0.0,
+                                 hidden_drop=0.0, attn_drop=0.0)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        head_w = jnp.asarray(rng.normal(0, .2, (16, 32)).astype(np.float32))
+        head_b = jnp.zeros((32,), jnp.float32)
+        toks = jnp.asarray(rng.integers(0, 32, size=(8, 8)).astype(np.int32))
+
+        def seq(params, head_w):
+            h = layer.call(params, toks, training=False)
+            return h @ head_w + head_b
+
+        def piped(params, head_w):
+            return transformer_gpipe_lm(layer, params, head_w, head_b,
+                                        toks, n_microbatch=4)
+
+        ref = seq(params, head_w)
+        out = piped(params, head_w)
+        assert out.shape == (8, 8, 32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        gp, gh = jax.grad(
+            lambda p, w: jnp.mean(piped(p, w) ** 2), argnums=(0, 1))(
+                params, head_w)
+        rp, rh = jax.grad(
+            lambda p, w: jnp.mean(seq(p, w) ** 2), argnums=(0, 1))(
+                params, head_w)
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(rh),
+                                   atol=2e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5), gp, rp)
+
+    def test_full_lm_with_data_parallel(self, pipe_ctx):
+        """PP x DP composition for the hetero pipeline."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.pipeline import transformer_gpipe_lm
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            TransformerLayer,
+        )
+
+        layer = TransformerLayer(vocab=16, seq_len=4, n_block=4, n_head=2,
+                                 hidden_size=8, embedding_drop=0.0,
+                                 hidden_drop=0.0, attn_drop=0.0)
+        params = layer.init_params(jax.random.PRNGKey(1))
+        rng = np.random.default_rng(4)
+        head_w = jnp.asarray(rng.normal(0, .2, (8, 16)).astype(np.float32))
+        head_b = jnp.zeros((16,), jnp.float32)
+        toks = rng.integers(0, 16, size=(8, 4)).astype(np.int32)
+        mesh = pipe_ctx.mesh
+        toks_d = jax.device_put(jnp.asarray(toks),
+                                NamedSharding(mesh, P("data")))
+
+        out = jax.jit(lambda p, w, t: transformer_gpipe_lm(
+            layer, p, w, head_b, t, n_microbatch=4,
+            batch_axis="data"))(params, head_w, toks_d)
+        ref = layer.call(params, jnp.asarray(toks),
+                         training=False) @ head_w + head_b
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+class TestGPipeCircular:
+    """Interleaved/circular schedule (virtual stages): shard i hosts
+    stages i, i+S, ... and the ring is traversed v times."""
+
+    def test_matches_sequential(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(5)
+        params = _make(rng, 8, 6)  # 8 virtual stages on pipe=4, v=2
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        out = gpipe(_stage_fn, params, jnp.asarray(x), n_microbatch=8,
+                    circular_repeats=2)
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(params, x), atol=1e-5)
+
+    def test_grads_match_sequential(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(6)
+        params = _make(rng, 8, 5)
+        x = jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))
+
+        def piped(p, x):
+            return jnp.mean(gpipe(_stage_fn, p, x, n_microbatch=4,
+                                  circular_repeats=2) ** 2)
+
+        def seq(p, x):
+            for i in range(8):
+                x = jnp.tanh(x @ p["w"][i] + p["b"][i])
+            return jnp.mean(x ** 2)
+
+        gp, gx = jax.grad(piped, argnums=(0, 1))(params, x)
+        rp, rx = jax.grad(seq, argnums=(0, 1))(params, x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   atol=1e-5)
+        for k in gp:
+            np.testing.assert_allclose(
+                np.asarray(gp[k]), np.asarray(rp[k]), atol=1e-5, err_msg=k)
+
+    def test_exact_microbatch_equals_pipe_size(self, pipe_ctx):
+        """M == S: the delay line degenerates to a direct hand-off."""
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(7)
+        params = _make(rng, 12, 4)  # v=3
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        out = gpipe(_stage_fn, params, jnp.asarray(x), n_microbatch=4,
+                    circular_repeats=3)
+        np.testing.assert_allclose(
+            np.asarray(out), _oracle(params, x), atol=1e-5)
+
+    def test_requires_enough_microbatches(self, pipe_ctx):
+        from analytics_zoo_tpu.parallel.pipeline import gpipe
+
+        rng = np.random.default_rng(8)
+        params = _make(rng, 8, 4)
+        with pytest.raises(ValueError, match="circular"):
+            gpipe(_stage_fn, params, jnp.zeros((4, 4)), n_microbatch=2,
+                  circular_repeats=2)
