@@ -1,5 +1,6 @@
 """3D (medical) image transforms — reference zoo/.../feature/image3d
-(AffineTransform3D, Crop3D variants, Rotate3D)."""
+(AffineTransform3D, Crop3D variants, Rotate3D, Warp.scala flow-field
+warp)."""
 
 from analytics_zoo_tpu.feature.image3d.transforms import (
     AffineTransform3D,
@@ -7,6 +8,7 @@ from analytics_zoo_tpu.feature.image3d.transforms import (
     Crop3D,
     RandomCrop3D,
     Rotate3D,
+    Warp3D,
     rotation_matrix_3d,
 )
 
@@ -16,5 +18,6 @@ __all__ = [
     "CenterCrop3D",
     "RandomCrop3D",
     "Rotate3D",
+    "Warp3D",
     "rotation_matrix_3d",
 ]

@@ -2,4 +2,6 @@ from analytics_zoo_tpu.feature.text.textset import (  # noqa: F401
     Relation,
     TextFeature,
     TextSet,
+    read_relations_csv,
+    read_relations_parquet,
 )

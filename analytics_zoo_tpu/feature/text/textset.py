@@ -41,6 +41,39 @@ class Relation:
     label: int
 
 
+def read_relations_csv(path: str, sep: str = ",") -> list[Relation]:
+    """id1,id2,label per line (reference Relations.read,
+    feature/common/Relations.scala:43-76); a header line is skipped,
+    malformed data lines raise (silent drops would shrink the training
+    relation set unnoticed)."""
+    out = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            stripped = line.rstrip("\n")
+            if not stripped:
+                continue
+            parts = stripped.split(sep)
+            if lineno == 1 and parts[-1] == "label":
+                continue  # header
+            if len(parts) != 3:
+                raise ValueError(
+                    f"{path}:{lineno}: expected id1{sep}id2{sep}label, "
+                    f"got {stripped!r}")
+            out.append(Relation(parts[0], parts[1], int(parts[2])))
+    return out
+
+
+def read_relations_parquet(path: str) -> list[Relation]:
+    """Relations from a parquet file with schema "id1"(str), "id2"(str),
+    "label"(int) — reference Relations.readParquet
+    (feature/common/Relations.scala:78)."""
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    return [Relation(str(a), str(b), int(c))
+            for a, b, c in zip(df["id1"], df["id2"], df["label"])]
+
+
 class TextSet:
     """Pipeline container (reference TextSet.scala).  All stages return a
     new TextSet; ``word_index`` is built by word2idx and reusable across
@@ -69,6 +102,19 @@ class TextSet:
                 uri, text = line.rstrip("\n").split(sep, 1)
                 feats.append(TextFeature(text, uri=uri))
         return TextSet(feats)
+
+    @staticmethod
+    def read_parquet(path: str) -> "TextSet":
+        """Read texts with id from a parquet file with schema
+        "id"(str), "text"(str) — reference TextSet.readParquet
+        (TextSet.scala:372); pandas/pyarrow stands in for SQLContext."""
+        import pandas as pd
+
+        df = pd.read_parquet(path)
+        return TextSet([
+            TextFeature(str(text), uri=str(uri))
+            for uri, text in zip(df["id"], df["text"])
+        ])
 
     # -- pipeline stages ---------------------------------------------------
     def tokenize(self) -> "TextSet":
@@ -132,6 +178,35 @@ class TextSet:
 
     def get_word_index(self) -> dict[str, int]:
         return dict(self.word_index or {})
+
+    def set_word_index(self, vocab: dict[str, int]) -> "TextSet":
+        """Assign a word index to use during word2idx (reference
+        TextSet.setWordIndex, TextSet.scala:207)."""
+        self.word_index = dict(vocab)
+        return self
+
+    def save_word_index(self, path: str) -> None:
+        """Save the word index as "word id" lines for future inference
+        (reference TextSet.saveWordIndex, TextSet.scala:222/687)."""
+        if not self.word_index:
+            raise ValueError(
+                "wordIndex is None, nothing to save. Please transform "
+                "from word to index first")
+        with open(path, "w") as f:
+            for word, idx in self.word_index.items():
+                f.write(f"{word} {idx}\n")
+
+    def load_word_index(self, path: str) -> "TextSet":
+        """Load a saved "word id" index so word2idx reuses it exactly
+        (reference TextSet.loadWordIndex, TextSet.scala:243/698)."""
+        vocab = {}
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                word, idx = line.rsplit(" ", 1)
+                vocab[word] = int(idx)
+        return self.set_word_index(vocab)
 
     def __len__(self):
         return len(self.features)

@@ -98,6 +98,82 @@ def _as_list(v):
 
 
 # ---------------------------------------------------------------------------
+# V1 (upgrade_proto-era) layer normalization — reference V1LayerConverter
+# ---------------------------------------------------------------------------
+
+# V1LayerParameter.LayerType enum (public caffe.proto, frozen): both the
+# text-format enum identifiers and the binary enum ints map to the modern
+# string type names the converters use (reference V1LayerConverter.scala:39
+# implements the same legacy set; loss/data types map to the names the
+# train-only-layer filter already drops).
+_V1_LAYER_TYPES = {
+    "NONE": (0, None),
+    "ACCURACY": (1, "Accuracy"),
+    "BNLL": (2, "BNLL"),
+    "CONCAT": (3, "Concat"),
+    "CONVOLUTION": (4, "Convolution"),
+    "DATA": (5, "Data"),
+    "DROPOUT": (6, "Dropout"),
+    "EUCLIDEAN_LOSS": (7, "EuclideanLoss"),
+    "FLATTEN": (8, "Flatten"),
+    "HDF5_DATA": (9, "HDF5Data"),
+    "HDF5_OUTPUT": (10, "HDF5Output"),
+    "IM2COL": (11, "Im2col"),
+    "IMAGE_DATA": (12, "ImageData"),
+    "INFOGAIN_LOSS": (13, "InfogainLoss"),
+    "INNER_PRODUCT": (14, "InnerProduct"),
+    "LRN": (15, "LRN"),
+    "MULTINOMIAL_LOGISTIC_LOSS": (16, "MultinomialLogisticLoss"),
+    "POOLING": (17, "Pooling"),
+    "RELU": (18, "ReLU"),
+    "SIGMOID": (19, "Sigmoid"),
+    "SOFTMAX": (20, "Softmax"),
+    "SOFTMAX_LOSS": (21, "SoftmaxWithLoss"),
+    "SPLIT": (22, "Split"),
+    "TANH": (23, "TanH"),
+    "WINDOW_DATA": (24, "WindowData"),
+    "ELTWISE": (25, "Eltwise"),
+    "POWER": (26, "Power"),
+    "SIGMOID_CROSS_ENTROPY_LOSS": (27, "SigmoidCrossEntropyLoss"),
+    "HINGE_LOSS": (28, "HingeLoss"),
+    "MEMORY_DATA": (29, "MemoryData"),
+    "ARGMAX": (30, "ArgMax"),
+    "THRESHOLD": (31, "Threshold"),
+    "DUMMY_DATA": (32, "DummyData"),
+    "SLICE": (33, "Slice"),
+    "MVN": (34, "MVN"),
+    "ABSVAL": (35, "AbsVal"),
+    "SILENCE": (36, "Silence"),
+    "CONTRASTIVE_LOSS": (37, "ContrastiveLoss"),
+    "EXP": (38, "Exp"),
+    "DECONVOLUTION": (39, "Deconvolution"),
+}
+_V1_BY_NAME = {k: v[1] for k, v in _V1_LAYER_TYPES.items()}
+_V1_BY_INT = {v[0]: v[1] for v in _V1_LAYER_TYPES.values()}
+
+
+def normalize_v1_layer(ly: dict) -> dict:
+    """Translate an upgrade_proto-era ``layers { type: CONVOLUTION }``
+    entry (enum type — text identifier or binary int) into the modern
+    string-typed form the converters consume.  Modern entries pass through
+    untouched.  Reference: CaffeLoader.scala:63-75 selecting
+    V1LayerConverter for V1 nets."""
+    t = ly.get("type")
+    new_t = None
+    if isinstance(t, int):
+        new_t = _V1_BY_INT.get(t)
+        if new_t is None:
+            raise NotImplementedError(f"unknown V1 layer type enum {t}")
+    elif isinstance(t, str) and t in _V1_BY_NAME:
+        new_t = _V1_BY_NAME[t]
+    if new_t is None:
+        return ly
+    out = dict(ly)
+    out["type"] = new_t
+    return out
+
+
+# ---------------------------------------------------------------------------
 # caffemodel (binary NetParameter) — only blobs are needed; topology comes
 # from the prototxt
 # ---------------------------------------------------------------------------
@@ -202,6 +278,9 @@ class CaffeNet(Layer):
         self.trainable = trainable
         raw_layers = _as_list(net_def.get("layer")) \
             or _as_list(net_def.get("layers"))
+        # V1 (upgrade_proto-era) nets carry enum layer types — normalize
+        # them to the modern string names first (V1LayerConverter role)
+        raw_layers = [normalize_v1_layer(ly) for ly in raw_layers]
         # drop train-only layers (phase TRAIN, loss/accuracy heads)
         self.layers = []
         for ly in raw_layers:
@@ -213,6 +292,10 @@ class CaffeNet(Layer):
                 "SoftmaxWithLoss", "Accuracy", "EuclideanLoss",
                 "SigmoidCrossEntropyLoss", "HingeLoss", "Data",
                 "ImageData", "HDF5Data",
+                # V1-era train/data heads (V1LayerConverter drop set)
+                "WindowData", "MemoryData", "DummyData", "HDF5Output",
+                "MultinomialLogisticLoss", "InfogainLoss",
+                "ContrastiveLoss", "Silence",
             ):
                 continue
             self.layers.append(ly)

@@ -16,17 +16,19 @@ realistic path never falls back to the dense O(L²) route.
 from __future__ import annotations
 
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 
-_NEG = -1e30
-
 
 def _flash_backend_ok() -> bool:
-    return (jax.default_backend() == "tpu"
-            or bool(os.environ.get("ZOO_FLASH_INTERPRET")))
+    # single source of truth for the backend gate (incl. the
+    # ZOO_FLASH_INTERPRET CI knob) lives next to the kernel
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        _pallas_available,
+    )
+
+    return _pallas_available()
 
 
 def flash_eligible(q_shape, mask_shape, mask_ndim, dropout_p, has_rng,
@@ -80,6 +82,7 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
             None if mask is None else mask.ndim, dropout_p,
             rng is not None, k.shape[-2], use_flash):
         from analytics_zoo_tpu.ops.pallas.flash_attention import (
+            _NEG,
             flash_attention,
         )
 

@@ -159,61 +159,75 @@ def gpipe(stage_fn, stage_params, x, *, n_microbatch, mesh=None,
 # ---------------------------------------------------------------------------
 
 
-def _flat_size(struct) -> int:
+def _is_int(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.bool_) or \
+        jnp.issubdtype(dtype, jnp.integer)
+
+
+def _pair_sizes(struct) -> tuple[int, int]:
+    """(float_size, int_size) of a boundary struct: float and integer/bool
+    leaves travel in SEPARATE buffers — floats in a differentiable f32
+    vector, ints in an exact int32 vector (a float psum of bitcast int
+    payloads would corrupt bit patterns that alias f32 NaN/-0.0, and a
+    bitcast round-trip would sever gradient flow)."""
     import math
 
-    return sum(math.prod(s.shape) for s in jax.tree_util.tree_leaves(struct))
-
-
-def _encode(tree, buf_len: int):
-    """Flatten a pytree of arrays into one f32 vector (ints/bools bitcast
-    or widened losslessly), zero-padded to ``buf_len``."""
-    parts = []
-    for a in jax.tree_util.tree_leaves(tree):
-        if jnp.issubdtype(a.dtype, jnp.bool_):
-            part = a.astype(jnp.int32)
-            part = lax.bitcast_convert_type(part, jnp.float32)
-        elif jnp.issubdtype(a.dtype, jnp.integer):
-            part = lax.bitcast_convert_type(a.astype(jnp.int32),
-                                            jnp.float32)
+    f = i = 0
+    for s in jax.tree_util.tree_leaves(struct):
+        if _is_int(s.dtype):
+            i += math.prod(s.shape)
         else:
-            part = a.astype(jnp.float32)
-        parts.append(part.reshape(-1))
-    v = (jnp.concatenate(parts) if parts
-         else jnp.zeros((0,), jnp.float32))
-    return jnp.pad(v, (0, buf_len - v.shape[0]))
+            f += math.prod(s.shape)
+    return f, i
 
 
-def _decode(buf, struct):
+def _encode(tree, flen: int, ilen: int):
+    """Flatten a pytree into (f32 vector, int32 vector), zero-padded."""
+    fparts, iparts = [], []
+    for a in jax.tree_util.tree_leaves(tree):
+        if _is_int(a.dtype):
+            iparts.append(a.astype(jnp.int32).reshape(-1))
+        else:
+            fparts.append(a.astype(jnp.float32).reshape(-1))
+    fv = (jnp.concatenate(fparts) if fparts
+          else jnp.zeros((0,), jnp.float32))
+    iv = (jnp.concatenate(iparts) if iparts
+          else jnp.zeros((0,), jnp.int32))
+    return (jnp.pad(fv, (0, flen - fv.shape[0])),
+            jnp.pad(iv, (0, ilen - iv.shape[0])))
+
+
+def _decode(bufs, struct):
     """Inverse of :func:`_encode` for the given ShapeDtypeStruct pytree."""
     import math
 
+    fbuf, ibuf = bufs
     leaves, treedef = jax.tree_util.tree_flatten(struct)
-    out, off = [], 0
+    out, foff, ioff = [], 0, 0
     for s in leaves:
         n = math.prod(s.shape)
-        seg = buf[off:off + n].reshape(s.shape)
-        off += n
-        if jnp.issubdtype(s.dtype, jnp.bool_):
-            seg = lax.bitcast_convert_type(seg, jnp.int32).astype(jnp.bool_)
-        elif jnp.issubdtype(s.dtype, jnp.integer):
-            seg = lax.bitcast_convert_type(seg, jnp.int32).astype(s.dtype)
+        if _is_int(s.dtype):
+            seg = ibuf[ioff:ioff + n].reshape(s.shape).astype(s.dtype)
+            ioff += n
         else:
-            seg = seg.astype(s.dtype)
+            seg = fbuf[foff:foff + n].reshape(s.shape).astype(s.dtype)
+            foff += n
         out.append(seg)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _pipeline_local_hetero(edge_params, stacked_params, x_mb, *, stage_fns,
                            axis_name, n_stages, n_micro, boundaries,
-                           buf_len):
+                           flen, ilen):
     """Per-shard schedule for heterogeneous stages.
 
     The activation crossing each stage boundary may be ANY pytree (shapes,
-    dtypes and structure all free), so the ppermute'd carry is a flat f32
-    union buffer sized to the largest boundary; each shard decodes its own
-    input struct, runs its stage via ``lax.switch`` (a real XLA
-    conditional — only the selected branch executes), and re-encodes.
+    dtypes and structure all free), so the ppermute'd carry is a flat
+    (f32, int32) union buffer pair sized to the largest boundary; each
+    shard decodes its own input struct, runs its stage via ``lax.switch``
+    (a real XLA conditional — only the selected branch executes), and
+    re-encodes.  Float payloads ride the f32 buffer (differentiable); int
+    payloads ride the int32 buffer (exact under the integer psum).
     """
     idx = lax.axis_index(axis_name)
     stacked_local = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
@@ -221,10 +235,10 @@ def _pipeline_local_hetero(edge_params, stacked_params, x_mb, *, stage_fns,
     n_ticks = n_micro + n_stages - 1
 
     def make_branch(i):
-        def branch(buf):
-            act = _decode(buf, boundaries[i])
+        def branch(bufs):
+            act = _decode(bufs, boundaries[i])
             out = stage_fns[i](edge_params[i], stacked_local, act)
-            return _encode(out, buf_len)
+            return _encode(out, flen, ilen)
         return branch
 
     branches = [make_branch(i) for i in range(n_stages)]
@@ -232,20 +246,24 @@ def _pipeline_local_hetero(edge_params, stacked_params, x_mb, *, stage_fns,
     def tick(carry, t):
         mb = jax.tree_util.tree_map(
             lambda a: a[jnp.clip(t, 0, n_micro - 1)], x_mb)
-        inj = _encode(mb, buf_len)
-        buf_in = jnp.where(idx == 0, inj, carry)
-        out = lax.switch(idx, branches, buf_in)
-        shifted = lax.ppermute(out, axis_name, perm)
+        inj = _encode(mb, flen, ilen)
+        bufs_in = jax.tree_util.tree_map(
+            lambda i, c: jnp.where(idx == 0, i, c), inj, carry)
+        out = lax.switch(idx, branches, bufs_in)
+        shifted = jax.tree_util.tree_map(
+            lambda b: lax.ppermute(b, axis_name, perm), out)
         return shifted, out
 
-    _, ys = lax.scan(tick, jnp.zeros((buf_len,), jnp.float32),
-                     jnp.arange(n_ticks))
-    valid = ys[n_stages - 1:]
-    valid = lax.psum(
-        jnp.where(idx == n_stages - 1, valid, jnp.zeros_like(valid)),
-        axis_name,
-    )
-    return jax.vmap(lambda b: _decode(b, boundaries[n_stages]))(valid)
+    carry0 = (jnp.zeros((flen,), jnp.float32), jnp.zeros((ilen,), jnp.int32))
+    _, ys = lax.scan(tick, carry0, jnp.arange(n_ticks))
+    valid = jax.tree_util.tree_map(lambda b: b[n_stages - 1:], ys)
+    valid = jax.tree_util.tree_map(
+        lambda b: lax.psum(
+            jnp.where(idx == n_stages - 1, b, jnp.zeros_like(b)),
+            axis_name),
+        valid)
+    return jax.vmap(lambda f, i: _decode((f, i), boundaries[n_stages]))(
+        *valid)
 
 
 def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
@@ -299,7 +317,9 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
     for i in range(n_stages):
         bound.append(jax.eval_shape(
             stage_fns[i], edge_params[i], stacked_local_struct, bound[i]))
-    buf_len = max(_flat_size(s) for s in bound)
+    sizes = [_pair_sizes(s) for s in bound]
+    flen = max(f for f, _ in sizes)
+    ilen = max(i for _, i in sizes)
 
     if n_stages == 1:
         one = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
@@ -311,7 +331,8 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
     fn = jax.shard_map(
         partial(_pipeline_local_hetero, stage_fns=stage_fns,
                 axis_name=axis_name, n_stages=n_stages,
-                n_micro=n_microbatch, boundaries=bound, buf_len=buf_len),
+                n_micro=n_microbatch, boundaries=bound, flen=flen,
+                ilen=ilen),
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(None, batch_axis)),
         out_specs=P(None, batch_axis),

@@ -163,6 +163,12 @@ class ClusterServing:
         """Blocking serve loop.  Stops after ``max_records`` served, after
         ``idle_timeout`` seconds without input, or on :meth:`stop`."""
         served = 0
+        # a previous run() on this server closed its summary on exit (e.g.
+        # a warm-up pass before start()): open a fresh event file
+        if self.summary.closed:
+            self.summary = InferenceSummary(
+                self.helper.log_dir,
+                time.strftime("%Y%m%d-%H%M%S") + "-ClusterServing")
         last_active = time.monotonic()
         while not self._stop.is_set():
             try:

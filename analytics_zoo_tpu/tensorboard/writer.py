@@ -34,6 +34,12 @@ class FileWriter:
 
     def _write(self, event: bytes):
         with self._lock:
+            # a closed writer drops events instead of raising: serving's
+            # run() closes its summary on loop exit, and a concurrently
+            # finishing batch (or a later warm-up run() on the same server
+            # object) must not crash on the trailing Throughput scalar
+            if self._fh.closed:
+                return
             write_record(self._fh, event)
             self._fh.flush()
 
@@ -44,7 +50,14 @@ class FileWriter:
         )
 
     def close(self):
-        self._fh.close()
+        # under the lock: a concurrent _write must either complete before
+        # the close or observe closed-and-drop — never write a closed fh
+        with self._lock:
+            self._fh.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._fh.closed
 
 
 class _SummaryBase:
@@ -76,6 +89,10 @@ class _SummaryBase:
 
     def close(self):
         self._writer.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._writer.closed
 
 
 class TrainSummary(_SummaryBase):

@@ -327,3 +327,116 @@ layer { name: "p" type: "Pooling" bottom: "data" top: "p"
     net.ensure_built((1, 4, 4))
     with pytest.raises(NotImplementedError, match="STOCHASTIC"):
         net.apply({}, jnp.zeros((1, 1, 4, 4), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# V1 (upgrade_proto-era) format — reference V1LayerConverter.scala:39
+# ---------------------------------------------------------------------------
+
+V1_PROTOTXT = """
+name: "V1Net"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layers {
+  name: "conv1"
+  type: CONVOLUTION
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers {
+  name: "pool1"
+  type: POOLING
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layers { name: "flat" type: FLATTEN bottom: "pool1" top: "flat" }
+layers {
+  name: "fc"
+  type: INNER_PRODUCT
+  bottom: "flat"
+  top: "fc"
+  inner_product_param { num_output: 5 }
+}
+layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "fc" top: "loss" }
+layers { name: "acc" type: ACCURACY bottom: "prob" top: "acc" }
+"""
+
+
+def encode_v1_caffemodel(layer_blobs, type_enum=4):
+    """V1 NetParameter: repeated V1LayerParameter `layers` = field 2
+    (name=4, type=5 enum, blobs=6)."""
+    out = bytearray()
+    _put_bytes(out, 1, b"v1net")
+    for name, blobs in layer_blobs.items():
+        layer = bytearray()
+        _put_bytes(layer, 4, name.encode())
+        _put_varint(layer, 5, type_enum)
+        for arr in blobs:
+            _put_bytes(layer, 6, encode_blob(arr))
+        _put_bytes(out, 2, bytes(layer))
+    return bytes(out)
+
+
+def test_caffe_v1_net_vs_torch(tmp_path):
+    """A V1-format (enum-typed `layers`) prototxt + V1 binary caffemodel
+    loads and matches torch — the legacy path CaffeLoader.scala:63-671
+    serves via V1LayerConverter."""
+    import torch
+    import torch.nn.functional as F
+
+    w = (rng0.normal(size=(4, 3, 3, 3)) * 0.3).astype(np.float32)
+    b = rng0.normal(size=(4,)).astype(np.float32)
+    fcw = (rng0.normal(size=(5, 4 * 4 * 4)) * 0.1).astype(np.float32)
+    fcb = rng0.normal(size=(5,)).astype(np.float32)
+    blobs = {"conv1": [w, b], "fc": [fcw, fcb]}
+
+    proto = tmp_path / "v1.prototxt"
+    proto.write_text(V1_PROTOTXT)
+    model = tmp_path / "v1.caffemodel"
+    model.write_bytes(encode_v1_caffemodel(blobs))
+
+    net = load_caffe(str(proto), str(model))
+    net.ensure_built((3, 8, 8))
+    params = net.init_params(jax.random.PRNGKey(0))
+    x = rng0.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, _ = net.apply(params, jnp.asarray(x))
+
+    t = torch.from_numpy
+    y = F.conv2d(t(x), t(w), t(b), padding=1)
+    y = F.max_pool2d(torch.relu(y), 2, 2)
+    y = y.flatten(1) @ t(fcw).T + t(fcb)
+    ref = torch.softmax(y, dim=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+    # V1 loss/accuracy heads were dropped; prob is the only output
+    assert net.output_names == ["prob"]
+
+
+def test_caffe_v1_int_enum_types():
+    """Binary-parsed V1 nets carry int enum types; normalize_v1_layer maps
+    the full frozen caffe.proto enum table."""
+    from analytics_zoo_tpu.models.caffe import normalize_v1_layer
+
+    assert normalize_v1_layer({"type": 4})["type"] == "Convolution"
+    assert normalize_v1_layer({"type": 14})["type"] == "InnerProduct"
+    assert normalize_v1_layer({"type": "POOLING"})["type"] == "Pooling"
+    assert normalize_v1_layer({"type": "TANH"})["type"] == "TanH"
+    # modern entries untouched
+    assert normalize_v1_layer({"type": "Convolution"})["type"] \
+        == "Convolution"
+    with pytest.raises(NotImplementedError):
+        normalize_v1_layer({"type": 9999})
+
+
+def test_caffe_v1_blobs_parse():
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    data = encode_v1_caffemodel({"ip": [w]})
+    blobs = parse_caffemodel(data)
+    np.testing.assert_array_equal(blobs["ip"][0], w)

@@ -1,0 +1,929 @@
+"""Independent-oracle tests for the public keras layers that previously
+had none (VERDICT r03 weak #5) — torch (CPU) or numpy math is the oracle,
+the analogue of the reference's per-layer KerasBaseSpec comparisons
+(zoo/src/test/.../keras/layers/*Spec.scala; SURVEY.md §4).  Coverage is
+ENFORCED by test_layer_oracle_enforcement.py via tests/oracle_registry.py:
+every public layer must appear there with a real test."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_layers import apply_layer
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.default_rng(seed).normal(size=shape) * scale).astype(
+        np.float32)
+
+
+def _nhwc_to_nchw(x):
+    return np.moveaxis(x, -1, 1)
+
+
+def _nchw_to_nhwc(x):
+    return np.moveaxis(x, 1, -1)
+
+
+# ---------------------------------------------------------------------------
+# core
+# ---------------------------------------------------------------------------
+
+
+def test_activation():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Activation
+
+    x = _r((3, 7), 0)
+    for name, tfn in [("relu", torch.relu), ("tanh", torch.tanh),
+                      ("sigmoid", torch.sigmoid),
+                      ("softmax", lambda t: torch.softmax(t, -1))]:
+        out, _ = apply_layer(Activation(name), x)
+        np.testing.assert_allclose(
+            out, tfn(torch.from_numpy(x)).numpy(), rtol=1e-5, atol=1e-6,
+            err_msg=name)
+
+
+def test_dropout():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dropout
+
+    x = np.ones((64, 64), np.float32)
+    layer = Dropout(0.4)
+    ev, _ = apply_layer(layer, x, training=False)
+    np.testing.assert_array_equal(ev, x)  # inference = identity
+    tr, _ = apply_layer(layer, x, training=True, rng=jax.random.PRNGKey(1))
+    zeros = float((tr == 0).mean())
+    assert abs(zeros - 0.4) < 0.05  # drop rate
+    kept = tr[tr != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)  # inverted scale
+
+
+def test_flatten():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Flatten
+
+    x = _r((2, 3, 4, 5), 1)
+    out, _ = apply_layer(Flatten(), x)
+    np.testing.assert_array_equal(out, x.reshape(2, -1))
+
+
+def test_reshape():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Reshape
+
+    x = _r((2, 3, 8), 2)
+    out, _ = apply_layer(Reshape((4, 6)), x)
+    np.testing.assert_array_equal(out, x.reshape(2, 4, 6))
+    out, _ = apply_layer(Reshape((-1, 2)), x)
+    np.testing.assert_array_equal(out, x.reshape(2, 12, 2))
+
+
+def test_permute():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Permute
+
+    x = _r((2, 3, 4, 5), 3)
+    out, _ = apply_layer(Permute((3, 1, 2)), x)
+    np.testing.assert_array_equal(out, np.transpose(x, (0, 3, 1, 2)))
+
+
+def test_repeat_vector():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import RepeatVector
+
+    x = _r((2, 5), 4)
+    out, _ = apply_layer(RepeatVector(3), x)
+    np.testing.assert_array_equal(out, np.repeat(x[:, None, :], 3, 1))
+
+
+def test_masking():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Masking
+
+    x = _r((2, 4, 3), 5)
+    x[0, 1] = 7.0
+    x[1, 3] = 7.0
+    out, _ = apply_layer(Masking(7.0), x)
+    ref = x.copy()
+    ref[0, 1] = 0.0
+    ref[1, 3] = 0.0
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_highway():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Highway
+
+    x = _r((4, 6), 6)
+    out, params = apply_layer(Highway(activation="tanh"), x)
+    h = np.tanh(x @ np.asarray(params["kernel"]) + np.asarray(
+        params["bias"]))
+    t = 1.0 / (1.0 + np.exp(-(x @ np.asarray(params["gate_kernel"])
+                              + np.asarray(params["gate_bias"]))))
+    np.testing.assert_allclose(out, t * h + (1 - t) * x, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_identity_and_input():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Identity,
+        Input,
+        InputLayer,
+    )
+
+    x = _r((3, 4), 7)
+    out, _ = apply_layer(Identity(), x)
+    np.testing.assert_array_equal(out, x)
+    out, _ = apply_layer(InputLayer(input_shape=(4,)), x)
+    np.testing.assert_array_equal(out, x)
+    var = Input(shape=(4,))  # graph entry point: symbolic variable
+    assert tuple(var.shape)[1:] == (4,)
+
+
+def test_base_layer_contract():
+    """The Layer base class contract: build-once, add_weight -> init_params
+    materialization, apply() routing, output-shape inference."""
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+    class Affine(Layer):
+        def build(self, input_shape):
+            self.add_weight("w", (int(input_shape[-1]),), "one")
+
+        def call(self, params, inputs, state=None, training=False,
+                 rng=None):
+            return inputs * params["w"]
+
+    layer = Affine(input_shape=(5,))
+    layer.ensure_built((5,))
+    assert layer.built
+    params = layer.init_params(jax.random.PRNGKey(0))
+    assert params["w"].shape == (5,)
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+    x = _r((2, 5), 8)
+    out, _ = layer.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+    assert layer.compute_output_shape((None, 5)) == (None, 5)
+
+
+def test_gaussian_noise():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import GaussianNoise
+
+    x = np.zeros((200, 200), np.float32)
+    layer = GaussianNoise(0.5)
+    ev, _ = apply_layer(layer, x, training=False)
+    np.testing.assert_array_equal(ev, x)
+    tr, _ = apply_layer(layer, x, training=True, rng=jax.random.PRNGKey(2))
+    assert abs(float(tr.std()) - 0.5) < 0.01
+    assert abs(float(tr.mean())) < 0.01
+
+
+def test_gaussian_dropout():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import GaussianDropout
+
+    x = np.ones((200, 200), np.float32)
+    layer = GaussianDropout(0.3)
+    ev, _ = apply_layer(layer, x, training=False)
+    np.testing.assert_array_equal(ev, x)
+    tr, _ = apply_layer(layer, x, training=True, rng=jax.random.PRNGKey(3))
+    assert abs(float(tr.mean()) - 1.0) < 0.01  # multiplicative, mean 1
+    want_std = np.sqrt(0.3 / 0.7)
+    assert abs(float(tr.std()) - want_std) < 0.02
+
+
+def test_spatial_dropout_1d_2d():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        SpatialDropout1D,
+        SpatialDropout2D,
+    )
+
+    x1 = np.ones((8, 16, 32), np.float32)
+    tr, _ = apply_layer(SpatialDropout1D(0.5), x1, training=True,
+                        rng=jax.random.PRNGKey(4))
+    # whole (sample, channel) maps are either all-zero or all-scaled
+    per_map = tr.reshape(8, 16, 32)
+    for b in range(8):
+        for c in range(32):
+            col = per_map[b, :, c]
+            assert (col == 0).all() or np.allclose(col, 2.0), (b, c)
+    x2 = np.ones((4, 5, 6, 8), np.float32)
+    tr2, _ = apply_layer(SpatialDropout2D(0.5), x2, training=True,
+                         rng=jax.random.PRNGKey(5))
+    flat = tr2.reshape(4, -1, 8)
+    for b in range(4):
+        for c in range(8):
+            col = flat[b, :, c]
+            assert (col == 0).all() or np.allclose(col, 2.0), (b, c)
+
+
+# ---------------------------------------------------------------------------
+# conv
+# ---------------------------------------------------------------------------
+
+
+def _conv1d_ref(x, params, stride=1, dilation=1, pad=0):
+    import torch
+
+    w = np.asarray(params["kernel"])  # (k, in, out)
+    conv = torch.nn.Conv1d(w.shape[1], w.shape[2], w.shape[0],
+                           stride=stride, dilation=dilation, padding=pad)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.transpose(w, (2, 1, 0))))
+        conv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = conv(torch.from_numpy(np.transpose(x, (0, 2, 1)))).numpy()
+    return np.transpose(ref, (0, 2, 1))
+
+
+def test_conv1d_vs_torch():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Convolution1D
+
+    x = _r((2, 12, 3), 10)
+    out, params = apply_layer(Convolution1D(5, 4, subsample_length=2), x)
+    np.testing.assert_allclose(out, _conv1d_ref(x, params, stride=2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_atrous_conv1d_vs_torch():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AtrousConvolution1D,
+    )
+
+    x = _r((2, 16, 3), 11)
+    out, params = apply_layer(AtrousConvolution1D(4, 3, atrous_rate=2), x)
+    np.testing.assert_allclose(out, _conv1d_ref(x, params, dilation=2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_atrous_conv2d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AtrousConvolution2D,
+    )
+
+    x = _r((2, 10, 10, 3), 12)
+    out, params = apply_layer(AtrousConvolution2D(4, 3, 3,
+                                                  atrous_rate=(2, 2)), x)
+    w = np.asarray(params["kernel"])  # (kh, kw, in, out)
+    conv = torch.nn.Conv2d(3, 4, 3, dilation=2)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.transpose(w, (3, 2, 0, 1))))
+        conv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = conv(torch.from_numpy(_nhwc_to_nchw(x))).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_conv3d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Convolution3D
+
+    x = _r((2, 6, 7, 8, 2), 13)
+    out, params = apply_layer(
+        Convolution3D(3, 3, 3, 3, subsample=(2, 1, 2)), x)
+    w = np.asarray(params["kernel"])  # (kd, kh, kw, in, out)
+    conv = torch.nn.Conv3d(2, 3, 3, stride=(2, 1, 2))
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(
+            np.transpose(w, (4, 3, 0, 1, 2))))
+        conv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = conv(torch.from_numpy(_nhwc_to_nchw(x))).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv2d_transpose_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Deconvolution2D
+
+    x = _r((2, 5, 5, 3), 14)
+    out, params = apply_layer(Deconvolution2D(4, 3, 3, subsample=(2, 2)), x)
+    w = np.asarray(params["kernel"])  # (kh, kw, in, out)
+    deconv = torch.nn.ConvTranspose2d(3, 4, 3, stride=2)
+    with torch.no_grad():
+        # lax.conv_transpose keeps forward-conv kernel orientation;
+        # torch's transposed conv flips spatially -> flip to align
+        deconv.weight.copy_(torch.from_numpy(
+            np.transpose(w[::-1, ::-1].copy(), (2, 3, 0, 1))))
+        deconv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = deconv(torch.from_numpy(_nhwc_to_nchw(x))).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_separable_conv2d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        SeparableConvolution2D,
+    )
+
+    x = _r((2, 9, 9, 3), 15)
+    layer = SeparableConvolution2D(6, 3, 3, depth_multiplier=2)
+    out, params = apply_layer(layer, x)
+    dw = np.asarray(params["depthwise_kernel"])  # (kh, kw, 1, in*dm)
+    pw = np.asarray(params["pointwise_kernel"])  # (1, 1, in*dm, out)
+    depth = torch.nn.Conv2d(3, 6, 3, groups=3, bias=False)
+    point = torch.nn.Conv2d(6, 6, 1)
+    with torch.no_grad():
+        # jax depthwise kernel (kh, kw, 1, in*dm) laid out channel-major:
+        # output channel c*dm+m <- input channel c
+        wd = np.transpose(dw[:, :, 0, :], (2, 0, 1))[:, None, :, :]
+        depth.weight.copy_(torch.from_numpy(wd))
+        point.weight.copy_(torch.from_numpy(
+            np.transpose(pw[0, 0], (1, 0))[:, :, None, None].copy()))
+        point.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = point(depth(torch.from_numpy(_nhwc_to_nchw(x)))).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_locally_connected_1d_vs_manual():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        LocallyConnected1D,
+    )
+
+    x = _r((2, 10, 3), 16)
+    layer = LocallyConnected1D(4, 3, subsample_length=2)
+    out, params = apply_layer(layer, x)
+    k = np.asarray(params["kernel"])   # (out_len, fl*in, nb)
+    b = np.asarray(params["bias"])
+    out_len = (10 - 3) // 2 + 1
+    ref = np.zeros((2, out_len, 4), np.float32)
+    for pos in range(out_len):
+        patch = x[:, pos * 2:pos * 2 + 3, :].reshape(2, -1)
+        ref[:, pos] = patch @ k[pos] + b[pos]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cropping():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Cropping1D,
+        Cropping2D,
+        Cropping3D,
+    )
+
+    x1 = _r((2, 10, 3), 17)
+    out, _ = apply_layer(Cropping1D((2, 3)), x1)
+    np.testing.assert_array_equal(out, x1[:, 2:-3])
+    x2 = _r((2, 8, 9, 3), 18)
+    out, _ = apply_layer(Cropping2D(((1, 2), (3, 1))), x2)
+    np.testing.assert_array_equal(out, x2[:, 1:-2, 3:-1])
+    x3 = _r((2, 6, 7, 8, 2), 19)
+    out, _ = apply_layer(Cropping3D(((1, 1), (2, 1), (0, 3))), x3)
+    np.testing.assert_array_equal(out, x3[:, 1:-1, 2:-1, 0:-3])
+
+
+def test_zero_padding():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        ZeroPadding1D,
+        ZeroPadding2D,
+        ZeroPadding3D,
+    )
+
+    x1 = _r((2, 5, 3), 20)
+    out, _ = apply_layer(ZeroPadding1D(2), x1)
+    np.testing.assert_array_equal(
+        out, np.pad(x1, ((0, 0), (2, 2), (0, 0))))
+    x2 = _r((2, 4, 5, 3), 21)
+    out, _ = apply_layer(ZeroPadding2D(((1, 2), (0, 3))), x2)
+    np.testing.assert_array_equal(
+        out, np.pad(x2, ((0, 0), (1, 2), (0, 3), (0, 0))))
+    x3 = _r((2, 3, 4, 5, 2), 22)
+    out, _ = apply_layer(ZeroPadding3D(1), x3)
+    np.testing.assert_array_equal(
+        out, np.pad(x3, ((0, 0), (1, 1), (1, 1), (1, 1), (0, 0))))
+
+
+def test_upsampling_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        UpSampling1D,
+        UpSampling2D,
+        UpSampling3D,
+    )
+
+    x1 = _r((2, 5, 3), 23)
+    out, _ = apply_layer(UpSampling1D(3), x1)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(np.transpose(x1, (0, 2, 1))), scale_factor=3,
+        mode="nearest").numpy()
+    np.testing.assert_allclose(out, np.transpose(ref, (0, 2, 1)))
+    x2 = _r((2, 4, 5, 3), 24)
+    out, _ = apply_layer(UpSampling2D((2, 3)), x2)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(_nhwc_to_nchw(x2)), scale_factor=(2, 3),
+        mode="nearest").numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref))
+    x3 = _r((1, 3, 4, 2, 2), 25)
+    out, _ = apply_layer(UpSampling3D(2), x3)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(_nhwc_to_nchw(x3)), scale_factor=2,
+        mode="nearest").numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref))
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def test_pooling_1d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AveragePooling1D,
+        MaxPooling1D,
+    )
+
+    x = _r((2, 12, 3), 26)
+    xt = torch.from_numpy(np.transpose(x, (0, 2, 1)))
+    out, _ = apply_layer(MaxPooling1D(3, stride=2), x)
+    ref = torch.nn.functional.max_pool1d(xt, 3, stride=2).numpy()
+    np.testing.assert_allclose(out, np.transpose(ref, (0, 2, 1)),
+                               rtol=1e-6)
+    out, _ = apply_layer(AveragePooling1D(3, stride=2), x)
+    ref = torch.nn.functional.avg_pool1d(xt, 3, stride=2).numpy()
+    np.testing.assert_allclose(out, np.transpose(ref, (0, 2, 1)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_avgpool2d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AveragePooling2D,
+    )
+
+    x = _r((2, 9, 9, 3), 27)
+    out, _ = apply_layer(AveragePooling2D((3, 3), strides=(2, 2)), x)
+    ref = torch.nn.functional.avg_pool2d(
+        torch.from_numpy(_nhwc_to_nchw(x)), 3, stride=2).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_pooling_3d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        AveragePooling3D,
+        MaxPooling3D,
+    )
+
+    x = _r((2, 6, 6, 6, 2), 28)
+    xt = torch.from_numpy(_nhwc_to_nchw(x))
+    out, _ = apply_layer(MaxPooling3D(2), x)
+    ref = torch.nn.functional.max_pool3d(xt, 2).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-6)
+    out, _ = apply_layer(AveragePooling3D(2), x)
+    ref = torch.nn.functional.avg_pool3d(xt, 2).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_global_pooling():
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+    x1, x2, x3 = _r((2, 5, 3), 29), _r((2, 4, 5, 3), 30), \
+        _r((2, 3, 4, 5, 2), 31)
+    for layer, x, ref in [
+        (L.GlobalMaxPooling1D(), x1, x1.max(1)),
+        (L.GlobalAveragePooling1D(), x1, x1.mean(1)),
+        (L.GlobalMaxPooling2D(), x2, x2.max((1, 2))),
+        (L.GlobalAveragePooling2D(), x2, x2.mean((1, 2))),
+        (L.GlobalMaxPooling3D(), x3, x3.max((1, 2, 3))),
+        (L.GlobalAveragePooling3D(), x3, x3.mean((1, 2, 3))),
+    ]:
+        out, _ = apply_layer(layer, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=type(layer).__name__)
+
+
+# ---------------------------------------------------------------------------
+# recurrent
+# ---------------------------------------------------------------------------
+
+
+def test_gru_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import GRU
+
+    b, t, f, u = 3, 6, 5, 4
+    x = _r((b, t, f), 32)
+    layer = GRU(u, activation="tanh", inner_activation="sigmoid",
+                return_sequences=True)
+    out, params = apply_layer(layer, x)
+
+    ref_gru = torch.nn.GRU(f, u, batch_first=True)
+    k = np.asarray(params["kernel"])            # (f, 3u) order z|r|h
+    rk = np.asarray(params["recurrent_kernel"])  # (u, 3u) order z|r|h
+    bias = np.asarray(params["bias"])           # (3u,)  order z|r|h
+
+    def zrh_to_rzn(w):  # (in, 3u) -> torch rows (3u, in) order r|z|n
+        z, r, h = np.split(w, 3, axis=-1)
+        return np.concatenate([r, z, h], axis=-1).T
+
+    with torch.no_grad():
+        ref_gru.weight_ih_l0.copy_(torch.from_numpy(zrh_to_rzn(k).copy()))
+        ref_gru.weight_hh_l0.copy_(torch.from_numpy(zrh_to_rzn(rk).copy()))
+        # ours adds bias outside the reset gate product (hh = act(xh + b_h
+        # + r*hz)); torch puts b_hn INSIDE r*(...) — so all bias goes to
+        # b_ih and b_hh stays 0, which makes the two forms identical
+        z, r, h = np.split(bias, 3)
+        ref_gru.bias_ih_l0.copy_(torch.from_numpy(
+            np.concatenate([r, z, h]).copy()))
+        ref_gru.bias_hh_l0.zero_()
+        ref, _ = ref_gru(torch.from_numpy(x))
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_simple_rnn_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SimpleRNN
+
+    b, t, f, u = 2, 5, 4, 3
+    x = _r((b, t, f), 33)
+    layer = SimpleRNN(u, activation="tanh", return_sequences=True)
+    out, params = apply_layer(layer, x)
+    rnn = torch.nn.RNN(f, u, batch_first=True, nonlinearity="tanh")
+    with torch.no_grad():
+        rnn.weight_ih_l0.copy_(torch.from_numpy(
+            np.asarray(params["kernel"]).T))
+        rnn.weight_hh_l0.copy_(torch.from_numpy(
+            np.asarray(params["recurrent_kernel"]).T))
+        rnn.bias_ih_l0.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        rnn.bias_hh_l0.zero_()
+        ref, _ = rnn(torch.from_numpy(x))
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, k, rk, b):
+    """Numpy LSTM oracle, gate order i,f,g,o; returns (B, T, u) states."""
+    bsz, t, _ = x.shape
+    u = rk.shape[0]
+    h = np.zeros((bsz, u), np.float32)
+    c = np.zeros((bsz, u), np.float32)
+    seq = []
+    for step in range(t):
+        z = x[:, step] @ k + h @ rk + b
+        i, f, g, o = np.split(z, 4, axis=-1)
+        i, f, o = _np_sigmoid(i), _np_sigmoid(f), _np_sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        seq.append(h)
+    return np.stack(seq, 1)
+
+
+def test_bidirectional_modes_vs_manual():
+    """All four merge modes vs a NUMPY bidirectional LSTM oracle (the
+    previous test only checked the concat shape)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        LSTM,
+        Bidirectional,
+    )
+
+    x = _r((2, 5, 3), 34)
+    for mode in ("concat", "sum", "mul", "ave"):
+        layer = Bidirectional(
+            LSTM(4, activation="tanh", inner_activation="sigmoid",
+                 return_sequences=True), merge_mode=mode)
+        out, params = apply_layer(layer, x)
+        fwd = _np_lstm(x, np.asarray(params["fwd"]["kernel"]),
+                       np.asarray(params["fwd"]["recurrent_kernel"]),
+                       np.asarray(params["fwd"]["bias"]))
+        bwd = _np_lstm(x[:, ::-1], np.asarray(params["bwd"]["kernel"]),
+                       np.asarray(params["bwd"]["recurrent_kernel"]),
+                       np.asarray(params["bwd"]["bias"]))[:, ::-1]
+        ref = {"concat": np.concatenate([fwd, bwd], -1),
+               "sum": fwd + bwd, "mul": fwd * bwd,
+               "ave": (fwd + bwd) / 2}[mode]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=mode)
+
+
+def test_time_distributed_conv_vs_manual():
+    """TimeDistributed over a CONV layer (the previous test only wrapped
+    Dense) vs applying the conv per timestep."""
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        TimeDistributed,
+    )
+
+    x = _r((2, 3, 8, 8, 2), 35)  # (B, T, H, W, C)
+    layer = TimeDistributed(Convolution2D(4, 3, 3))
+    out, params = apply_layer(layer, x)
+    w = np.asarray(params["inner"]["kernel"])
+    conv = torch.nn.Conv2d(2, 4, 3)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.transpose(w, (3, 2, 0, 1))))
+        conv.bias.copy_(torch.from_numpy(
+            np.asarray(params["inner"]["bias"])))
+        refs = []
+        for step in range(3):
+            r = conv(torch.from_numpy(_nhwc_to_nchw(x[:, step]))).numpy()
+            refs.append(_nchw_to_nhwc(r))
+    np.testing.assert_allclose(out, np.stack(refs, 1), rtol=1e-4,
+                               atol=1e-5)
+
+
+def _np_conv(x, w, stride, rank):
+    """Tiny VALID/SAME torch conv helper for the ConvLSTM oracles."""
+    import torch
+
+    xt = torch.from_numpy(np.moveaxis(x, -1, 1))
+    wt = torch.from_numpy(
+        np.transpose(w, (rank + 1, rank) + tuple(range(rank))).copy())
+    fn = torch.nn.functional.conv2d if rank == 2 \
+        else torch.nn.functional.conv3d
+    k = w.shape[0]
+    pad = k // 2
+    out = fn(xt, wt, stride=stride, padding=pad).numpy()
+    return np.moveaxis(out, 1, -1)
+
+
+def _conv_lstm_oracle(x, params, nb_filter, rank):
+    b, t = x.shape[:2]
+    k = np.asarray(params["kernel"])
+    rk = np.asarray(params["recurrent_kernel"])
+    bias = np.asarray(params["bias"])
+    h = None
+    for step in range(t):
+        zx = _np_conv(x[:, step], k, 1, rank)
+        if h is None:
+            h = np.zeros(zx.shape[:-1] + (nb_filter,), np.float32)
+            c = np.zeros_like(h)
+        z = zx + _np_conv(h, rk, 1, rank) + bias
+        i, f, g, o = np.split(z, 4, axis=-1)
+        i, f, o = _np_sigmoid(i), _np_sigmoid(f), _np_sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+    return h
+
+
+def test_conv_lstm2d_vs_manual():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ConvLSTM2D
+
+    x = _r((2, 3, 6, 6, 2), 36, scale=0.5)
+    layer = ConvLSTM2D(3, 3, inner_activation="sigmoid",
+                       border_mode="same")
+    out, params = apply_layer(layer, x)
+    ref = _conv_lstm_oracle(x, params, 3, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_lstm3d_vs_manual():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ConvLSTM3D
+
+    x = _r((1, 2, 4, 4, 4, 2), 37, scale=0.5)
+    layer = ConvLSTM3D(2, 3, inner_activation="sigmoid",
+                       border_mode="same")
+    out, params = apply_layer(layer, x)
+    ref = _conv_lstm_oracle(x, params, 2, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# embedding / normalization
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_vs_numpy():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+
+    ids = np.array([[1, 4, 2], [0, 3, 3]], np.int32)
+    layer = Embedding(5, 6)
+    layer.ensure_built((3,))
+    params = layer.init_params(jax.random.PRNGKey(0))
+    out, _ = layer.apply(params, jnp.asarray(ids))
+    table = np.asarray(params["embeddings"])
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+
+
+def test_sparse_embedding():
+    """SparseEmbedding: same lookup semantics; gradient touches ONLY the
+    looked-up rows (the reference's sparse-gradient contract)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseEmbedding
+
+    ids = np.array([[1, 3]], np.int32)
+    layer = SparseEmbedding(6, 4)
+    layer.ensure_built((2,))
+    params = layer.init_params(jax.random.PRNGKey(1))
+    out, _ = layer.apply(params, jnp.asarray(ids))
+    table = np.asarray(params["embeddings"])
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+
+    g = jax.grad(lambda p: jnp.sum(
+        layer.apply(p, jnp.asarray(ids))[0]))(params)
+    ge = np.asarray(g["embeddings"])
+    touched = sorted(set(np.nonzero(ge.any(-1))[0].tolist()))
+    assert touched == [1, 3]
+
+
+def test_batchnorm_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization,
+    )
+
+    x = _r((8, 6, 6, 3), 38)
+    layer = BatchNormalization(epsilon=1e-3)
+    layer.ensure_built((6, 6, 3))
+    params = layer.init_params(jax.random.PRNGKey(2))
+    state = layer.init_state()
+    out, new_state = layer.apply(params, jnp.asarray(x), state=state,
+                                 training=True)
+    bn = torch.nn.BatchNorm2d(3, eps=1e-3)
+    with torch.no_grad():
+        bn.weight.copy_(torch.from_numpy(np.asarray(params["gamma"])))
+        bn.bias.copy_(torch.from_numpy(np.asarray(params["beta"])))
+    bn.train()
+    ref = bn(torch.from_numpy(_nhwc_to_nchw(x))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), _nchw_to_nhwc(ref),
+                               rtol=1e-4, atol=1e-4)
+    # eval mode with given moving stats
+    mm = np.array([0.3, -0.2, 0.1], np.float32)
+    mv = np.array([1.5, 0.7, 2.0], np.float32)
+    out_e, _ = layer.apply(params, jnp.asarray(x),
+                           state={"moving_mean": jnp.asarray(mm),
+                                  "moving_var": jnp.asarray(mv)},
+                           training=False)
+    with torch.no_grad():
+        bn.running_mean.copy_(torch.from_numpy(mm))
+        bn.running_var.copy_(torch.from_numpy(mv))
+    bn.eval()
+    ref_e = bn(torch.from_numpy(_nhwc_to_nchw(x))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out_e), _nchw_to_nhwc(ref_e),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        LayerNormalization,
+    )
+
+    x = _r((4, 7, 10), 39)
+    layer = LayerNormalization()
+    out, params = apply_layer(layer, x)
+    ln = torch.nn.LayerNorm(10, eps=1e-5)
+    with torch.no_grad():
+        ln.weight.copy_(torch.from_numpy(np.asarray(params["gamma"])))
+        ln.bias.copy_(torch.from_numpy(np.asarray(params["beta"])))
+        ref = ln(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_within_channel_lrn():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        WithinChannelLRN2D,
+    )
+
+    x = _r((1, 5, 5, 2), 40)
+    size, alpha, beta = 3, 1.5, 0.75
+    out, _ = apply_layer(WithinChannelLRN2D(size, alpha, beta), x)
+    # numpy oracle: SAME sum of squares over a size x size spatial window
+    sq = x ** 2
+    padded = np.pad(sq, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    summed = np.zeros_like(x)
+    for i in range(5):
+        for j in range(5):
+            summed[:, i, j] = padded[:, i:i + 3, j:j + 3].sum((1, 2))
+    ref = x / (1.0 + alpha * summed / (size * size)) ** beta
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# merge / advanced activations / tensor ops
+# ---------------------------------------------------------------------------
+
+
+def test_merge_modes_vs_numpy():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Merge
+
+    a, b = _r((3, 5), 41), _r((3, 5), 42)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+
+    def run(mode, **kw):
+        layer = Merge(mode=mode, **kw)
+        out = layer.call({}, [ja, jb])
+        return np.asarray(out)
+
+    np.testing.assert_allclose(run("sum"), a + b, rtol=1e-6)
+    np.testing.assert_allclose(run("mul"), a * b, rtol=1e-6)
+    np.testing.assert_allclose(run("max"), np.maximum(a, b), rtol=1e-6)
+    np.testing.assert_allclose(run("min"), np.minimum(a, b), rtol=1e-6)
+    np.testing.assert_allclose(run("ave"), (a + b) / 2, rtol=1e-6)
+    np.testing.assert_allclose(
+        run("concat", concat_axis=-1), np.concatenate([a, b], -1))
+    np.testing.assert_allclose(
+        run("dot"), (a * b).sum(-1, keepdims=True), rtol=1e-5, atol=1e-5)
+    an = a / np.linalg.norm(a, axis=-1, keepdims=True)
+    bn = b / np.linalg.norm(b, axis=-1, keepdims=True)
+    np.testing.assert_allclose(
+        run("cosine"), (an * bn).sum(-1, keepdims=True), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_advanced_activations_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+    x = _r((4, 6), 43, scale=2.0)
+    xt = torch.from_numpy(x)
+    out, _ = apply_layer(L.LeakyReLU(0.2), x)
+    np.testing.assert_allclose(
+        out, torch.nn.functional.leaky_relu(xt, 0.2).numpy(), rtol=1e-6)
+    out, _ = apply_layer(L.ELU(1.3), x)
+    np.testing.assert_allclose(
+        out, torch.nn.functional.elu(xt, 1.3).numpy(), rtol=1e-5,
+        atol=1e-6)
+    out, _ = apply_layer(L.ThresholdedReLU(0.7), x)
+    np.testing.assert_allclose(
+        out, torch.nn.functional.threshold(xt, 0.7, 0.0).numpy(),
+        rtol=1e-6)
+    out, params = apply_layer(L.PReLU(), x)
+    pr = torch.nn.PReLU(6)
+    with torch.no_grad():
+        pr.weight.copy_(torch.from_numpy(np.asarray(params["alpha"])))
+        ref = pr(xt).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    out, _ = apply_layer(L.Softmax(), x)
+    np.testing.assert_allclose(out, torch.softmax(xt, -1).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_srelu_vs_formula():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SReLU
+
+    x = _r((5, 4), 44, scale=2.0)
+    out, params = apply_layer(SReLU(), x)
+    tl = np.asarray(params["t_left"])
+    al = np.asarray(params["a_left"])
+    tr = np.asarray(params["t_right"])
+    ar = np.asarray(params["a_right"])
+    ref = np.where(x < tl, tl + al * (x - tl),
+                   np.where(x > tr, tr + ar * (x - tr), x))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_parametric_softplus_vs_formula():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        ParametricSoftPlus,
+    )
+
+    x = _r((3, 4), 45)
+    out, params = apply_layer(ParametricSoftPlus(0.3, 2.0), x)
+    a = np.asarray(params["alpha"])
+    b = np.asarray(params["beta"])
+    ref = a * np.log1p(np.exp(b * x))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mul_and_scale():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Mul
+
+    x = _r((3, 4), 46)
+    out, params = apply_layer(Mul(), x)
+    np.testing.assert_allclose(out, x * np.asarray(params["weight"]),
+                               rtol=1e-6)
+
+
+def test_shape_edit_ops():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Expand,
+        ExpandDim,
+        Squeeze,
+    )
+
+    x = _r((2, 1, 4, 1), 47)
+    out, _ = apply_layer(Squeeze((1, 3)), x)
+    np.testing.assert_array_equal(out, x.squeeze((1, 3)))
+    x2 = _r((2, 4), 48)
+    out, _ = apply_layer(ExpandDim(1), x2)
+    np.testing.assert_array_equal(out, x2[:, None, :])
+    x3 = _r((2, 1, 4), 49)
+    out, _ = apply_layer(Expand((3, 4)), x3)
+    np.testing.assert_array_equal(out, np.broadcast_to(x3, (2, 3, 4)))
+
+
+def test_max_reduce():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Max
+
+    x = _r((2, 5, 3), 50)
+    out, _ = apply_layer(Max(1), x)
+    np.testing.assert_allclose(out, x.max(1), rtol=1e-6)
+    out, _ = apply_layer(Max(2, keep_dim=True), x)
+    np.testing.assert_allclose(out, x.max(2, keepdims=True), rtol=1e-6)

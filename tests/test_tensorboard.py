@@ -73,3 +73,55 @@ def test_fit_writes_tensorboard(zoo_ctx, tmp_path):
     vs = ValidationSummary.__new__(ValidationSummary)
     vs.dir = str(tmp_path / "run1" / "validation")
     assert len(vs.read_scalar("accuracy")) == 3
+
+
+def test_inference_summary_roundtrip(tmp_path):
+    """InferenceSummary (reference inference/InferenceSummary.scala):
+    serving-side throughput scalars land under <log_dir>/<app>/inference
+    and read back via read_scalar — the getScalar API."""
+    from analytics_zoo_tpu.tensorboard import InferenceSummary
+
+    s = InferenceSummary(str(tmp_path), "serving-app")
+    for step, v in enumerate([10.0, 20.0, 15.0]):
+        s.add_scalar("Throughput", v, step)
+    s.close()
+    assert "inference" in s.dir
+    back = s.read_scalar("Throughput")
+    assert [(st, v) for st, v, _ in back] == [(0, 10.0), (1, 20.0),
+                                             (2, 15.0)]
+    # closed writer drops late events instead of raising (serving shutdown
+    # race) and reports closed
+    assert s.closed
+    s.add_scalar("Throughput", 99.0, 3)
+    assert len(s.read_scalar("Throughput")) == 3
+
+
+def test_serving_writes_inference_summary(tmp_path):
+    """The serving loop records Throughput to the inference summary dir
+    (ClusterServing.scala observability parity)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, ClusterServingHelper, InMemoryBroker, InputQueue,
+    )
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Flatten
+    from analytics_zoo_tpu.pipeline.api.keras.topology import Sequential
+
+    m = Sequential()
+    m.add(Flatten(input_shape=(2, 2, 1)))
+    m.add(Dense(3, activation="softmax"))
+    m.build_params()
+    mp = str(tmp_path / "model.zoo")
+    m.save(mp)
+    broker = InMemoryBroker()
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=mp, batch_size=2, top_n=1,
+                             data_shape=(2, 2, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(4):
+        inq.enqueue_image(f"u{i}", np.zeros((2, 2, 1), np.float32))
+    serving.run(max_records=4)
+    scalars = serving.summary.read_scalar("Throughput")
+    assert len(scalars) >= 1 and all(v > 0 for _, v, _ in scalars)
