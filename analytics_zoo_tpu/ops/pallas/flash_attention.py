@@ -145,6 +145,54 @@ def _attention_reference(q, k, v, causal, scale, bias=None, q_seg=None,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _attention_stats_reference(q, k, v, causal, scale):
+    """(out, m, l) with the kernel's exact streaming semantics — the
+    combinable-partial form used by ring attention's inner blocks."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        live = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)[None, None]
+        scores = jnp.where(live, scores, _NEG)
+    m = jnp.maximum(jnp.max(scores, axis=-1), _NEG)
+    p = jnp.exp(scores - m[..., None])
+    if causal:
+        p = jnp.where(live, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) \
+        / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype), m, l
+
+
+def attention_stats(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None):
+    """Partial attention with running-softmax stats: returns
+    ``(out, m, l)`` where ``out * l[..., None]`` is the unnormalized
+    accumulator — two partials over disjoint key sets combine exactly via
+    the flash update (ring attention's inner kernel).  Pallas on TPU, jnp
+    elsewhere.  NOT differentiable on the TPU path — callers (ring
+    attention) wrap it in their own custom_vjp."""
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    block_q, block_k = _resolve_blocks(q.shape[2], block_q, block_k)
+    if _pallas_available() and q.shape[-1] % 64 == 0 \
+            and q.shape[2] >= 128 and k.shape[2] >= 128:
+        try:
+            out = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                    block_k, interpret=_interpret_forced(),
+                                    return_stats=True)
+            invocation_counts["pallas"] += 1
+            return out
+        except Exception:
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                logging.getLogger("analytics_zoo_tpu").exception(
+                    "Pallas attention_stats kernel failed; jnp fallback. "
+                    "THIS IS A PERFORMANCE BUG.")
+    invocation_counts["fallback"] += 1
+    return _attention_stats_reference(q, k, v, causal, scale)
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward
 # ---------------------------------------------------------------------------
@@ -152,7 +200,7 @@ def _attention_reference(q, k, v, causal, scale, bias=None, q_seg=None,
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                       interpret=False, bias=None, q_seg=None, kv_seg=None,
-                      dropout_p=0.0, seed=None):
+                      dropout_p=0.0, seed=None, return_stats=False):
     """Streaming forward: K/V blocks are a GRID dimension.
 
     grid = (b, h, n_q, n_k) with the key-block index innermost; Pallas's
@@ -191,7 +239,13 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         if has_drop:
             seed_ref = refs[i]
             i += 1
-        o_ref, m_ref, l_ref, acc_ref = refs[i:i + 4]
+        if return_stats:
+            o_ref, m_out_ref, l_out_ref = refs[i:i + 3]
+            i += 3
+        else:
+            o_ref = refs[i]
+            i += 1
+        m_ref, l_ref, acc_ref = refs[i:i + 3]
 
         bi = pl.program_id(0)
         hi = pl.program_id(1)
@@ -270,6 +324,9 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
             o_ref[0, 0] = (
                 acc_ref[...] / jnp.maximum(l_ref[...], 1e-20)
             ).astype(o_ref.dtype)
+            if return_stats:
+                m_out_ref[0, 0] = m_ref[...]
+                l_out_ref[0, 0] = l_ref[...]
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
@@ -307,14 +364,23 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         args.append(seed.astype(jnp.int32))
 
     grid = (b, h, n_q, n_k)
-    return pl.pallas_call(
+    out_specs = pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                             memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if return_stats:
+        stat_spec = pl.BlockSpec((1, 1, block_q, 1),
+                                 lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                                 memory_space=pltpu.VMEM)
+        stat_shape = jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32)
+        out_specs = [out_specs, stat_spec, stat_spec]
+        out_shape = [out_shape, stat_shape, stat_shape]
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -326,6 +392,10 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         ),
         interpret=interpret,
     )(*args)
+    if return_stats:
+        out, m, l = res
+        return out, m[..., 0], l[..., 0]
+    return res
 
 
 def _resolve_blocks(lq: int, block_q, block_k,

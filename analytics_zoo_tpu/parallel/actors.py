@@ -1,0 +1,229 @@
+"""Generic distributed-Python actors — the RayOnSpark capability rebuilt
+for TPU-VM pods.
+
+Reference: ``RayContext`` launches a Ray cluster inside Spark executors
+(pyzoo/zoo/ray/util/raycontext.py:192-393, barrier-mode ``ray start`` +
+JVMGuard pid reaping) so users can run arbitrary distributed Python
+(parameter servers, RL) beside their training jobs.  On a TPU-VM pod the
+SPMD fabric is jax.distributed (parallel/multihost.py); what this module
+adds is the reference's OTHER capability: **actor-style arbitrary-Python
+compute** with a Ray-shaped API, scheduled onto local processes (one per
+actor, the analogue of raylets on the executor hosts):
+
+* ``ActorContext.init()`` ≈ RayContext.init — start the runtime;
+* ``@remote`` on a class ≈ ``@ray.remote`` — ``Cls.remote(...)`` spawns
+  the actor in its own process; ``actor.method.remote(...)`` returns an
+  :class:`ObjectRef`; ``get(ref_or_list)`` materializes results;
+* ``@remote`` on a function — runs on a shared process pool;
+* actors die with the parent (daemon processes — the JVMGuard role of
+  raycontext.py:32-50).
+
+Calls to one actor execute in order (the actor model); calls to different
+actors run concurrently.  Method args/results travel by pickle, so keep
+them arrays/pytrees (the plasma-store role is played by the OS pipe —
+right-sized for the parameter-server/RL patterns the reference ships as
+examples, not for shuffling datasets).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import traceback
+from typing import Any
+
+_CONTEXT: "ActorContext | None" = None
+
+
+class ActorError(RuntimeError):
+    """An exception raised inside an actor, re-raised at ``get``."""
+
+
+def _actor_loop(cls, args, kwargs, conn):
+    try:
+        obj = cls(*args, **kwargs)
+        conn.send(("ready", None))
+    except BaseException:
+        conn.send(("init_error", traceback.format_exc()))
+        return
+    while True:
+        msg = conn.recv()
+        if msg is None:  # shutdown
+            return
+        call_id, method, m_args, m_kwargs = msg
+        try:
+            result = getattr(obj, method)(*m_args, **m_kwargs)
+            conn.send((call_id, "ok", result))
+        except BaseException:
+            conn.send((call_id, "error", traceback.format_exc()))
+
+
+class ObjectRef:
+    """Future for one actor method call (the ray.ObjectRef role)."""
+
+    def __init__(self, actor: "ActorHandle", call_id: int):
+        self._actor = actor
+        self._call_id = call_id
+
+    def get(self, timeout: float | None = None):
+        return self._actor._wait_for(self._call_id, timeout)
+
+
+class _RemoteMethod:
+    def __init__(self, actor, name):
+        self._actor = actor
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._actor._call(self._name, args, kwargs)
+
+
+class ActorHandle:
+    """Client-side handle; one process per actor."""
+
+    def __init__(self, cls, args, kwargs, ctx):
+        self._ctx = ctx
+        parent, child = mp.get_context("fork").Pipe()
+        self._conn = parent
+        self._proc = mp.get_context("fork").Process(
+            target=_actor_loop, args=(cls, args, kwargs, child),
+            daemon=True)  # daemon: dies with the parent (JVMGuard role)
+        self._proc.start()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._results: dict[int, tuple[str, Any]] = {}
+        status, detail = self._conn.recv()
+        if status != "ready":
+            raise ActorError(f"actor {cls.__name__} failed to start:\n"
+                             f"{detail}")
+        ctx._actors.append(self)
+
+    def _call(self, method, args, kwargs) -> ObjectRef:
+        with self._lock:
+            call_id = self._next_id
+            self._next_id += 1
+            self._conn.send((call_id, method, args, kwargs))
+        return ObjectRef(self, call_id)
+
+    def _wait_for(self, call_id, timeout=None):
+        while True:
+            with self._lock:
+                if call_id in self._results:
+                    status, payload = self._results.pop(call_id)
+                    if status == "error":
+                        raise ActorError(payload)
+                    return payload
+                if timeout is not None and not self._conn.poll(timeout):
+                    raise TimeoutError(f"call {call_id} timed out")
+                got_id, status, payload = self._conn.recv()
+                self._results[got_id] = (status, payload)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _RemoteMethod(self, name)
+
+    def terminate(self):
+        try:
+            self._conn.send(None)
+            self._proc.join(timeout=5)
+        except (BrokenPipeError, OSError):
+            pass
+        if self._proc.is_alive():
+            self._proc.terminate()
+
+
+class _RemoteClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        ctx = ActorContext.current()
+        return ActorHandle(self._cls, args, kwargs, ctx)
+
+    def __call__(self, *args, **kwargs):
+        return self._cls(*args, **kwargs)  # local construction still works
+
+
+class _FnRef:
+    def __init__(self, future):
+        self._future = future
+
+    def get(self, timeout=None):
+        return self._future.result(timeout)
+
+
+def _resolve_and_call(module_name, qualname, args, kwargs):
+    """Pool-side trampoline: the @remote wrapper shadows the function's
+    module-level name, so pickling the inner function by reference fails —
+    resolve the (possibly wrapped) attribute in the child instead."""
+    import importlib
+
+    obj = importlib.import_module(module_name)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    if isinstance(obj, _RemoteFunction):
+        obj = obj._fn
+    return obj(*args, **kwargs)
+
+
+class _RemoteFunction:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs) -> _FnRef:
+        ctx = ActorContext.current()
+        return _FnRef(ctx._pool.submit(
+            _resolve_and_call, self._fn.__module__, self._fn.__qualname__,
+            args, kwargs))
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def remote(cls_or_fn):
+    """``@remote`` on a class or function (the ``@ray.remote`` surface)."""
+    if isinstance(cls_or_fn, type):
+        return _RemoteClass(cls_or_fn)
+    return _RemoteFunction(cls_or_fn)
+
+
+def get(refs, timeout: float | None = None):
+    """Materialize one ref or a list of refs (the ``ray.get`` surface)."""
+    if isinstance(refs, (list, tuple)):
+        return type(refs)(r.get(timeout) for r in refs)
+    return refs.get(timeout)
+
+
+class ActorContext:
+    """Runtime holder (the RayContext.init/stop surface)."""
+
+    def __init__(self, num_pool_workers: int = 2):
+        from concurrent.futures import ProcessPoolExecutor
+
+        self._actors: list[ActorHandle] = []
+        self._pool = ProcessPoolExecutor(
+            max_workers=num_pool_workers,
+            mp_context=mp.get_context("fork"))
+
+    @classmethod
+    def init(cls, num_pool_workers: int = 2) -> "ActorContext":
+        global _CONTEXT
+        if _CONTEXT is None:
+            _CONTEXT = cls(num_pool_workers)
+        return _CONTEXT
+
+    @classmethod
+    def current(cls) -> "ActorContext":
+        if _CONTEXT is None:
+            return cls.init()
+        return _CONTEXT
+
+    def stop(self):
+        global _CONTEXT
+        for a in self._actors:
+            a.terminate()
+        self._actors.clear()
+        self._pool.shutdown(wait=False)
+        if _CONTEXT is self:
+            _CONTEXT = None

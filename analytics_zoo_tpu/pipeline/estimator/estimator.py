@@ -183,7 +183,17 @@ class _Checkpointer:
                 err, self._pending_err = self._pending_err, None
                 raise err
 
+    FORMAT_VERSION = 1
+
     def save(self, tag: str, payload: dict) -> str:
+        fname = os.path.join(self.path, f"ckpt-{tag}.pkl")
+        # Multi-host: exactly one writer.  Every process calls save() (the
+        # payload is replicated SPMD state), but only process 0 touches the
+        # shared checkpoint dir — concurrent writers racing os.replace on
+        # shared storage would interleave half-written snapshots.
+        shard = _process_shard()
+        if shard is not None and shard[0] != 0:
+            return fname
         self._wait()
         os.makedirs(self.path, exist_ok=True)
         # Device-side copies: cheap dispatches; the live arrays stay free
@@ -191,11 +201,15 @@ class _Checkpointer:
         snap = jax.tree_util.tree_map(
             lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
             payload)
-        fname = os.path.join(self.path, f"ckpt-{tag}.pkl")
 
         def write():
             try:
                 host = jax.tree_util.tree_map(np.asarray, snap)
+                host["__ckpt_meta__"] = {
+                    "format_version": self.FORMAT_VERSION,
+                    "saved_unix": time.time(),
+                    "jax_version": jax.__version__,
+                }
                 tmp = fname + ".tmp"
                 with open(tmp, "wb") as f:
                     pickle.dump(host, f)
@@ -230,12 +244,35 @@ class _Checkpointer:
         return self._list_files()
 
     def latest(self) -> dict | None:
-        """Reference ``getLatestFile`` (Topology.scala:1511-1528)."""
+        """Reference ``getLatestFile`` (Topology.scala:1511-1528).
+
+        Multi-host: the checkpoint dir must be SHARED storage (the
+        reference's HDFS contract).  Process 0 is the only writer
+        (:meth:`save`), so before reading, process 0 joins its in-flight
+        writer and THEN all processes barrier — guaranteeing every host
+        resumes from the same completed snapshot instead of racing the
+        os.replace."""
+        if _process_shard() is not None:
+            self._wait()  # no-op on processes that never write
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("zoo-ckpt-latest")
         files = self.list()
         if not files:
             return None
         with open(files[-1], "rb") as f:
-            return safe_load(f)
+            payload = safe_load(f)
+        # schema check: refuse snapshots from a NEWER format (their layout
+        # is unknown); pre-versioning (r03) snapshots carry no meta and
+        # load as version 0
+        meta = payload.pop("__ckpt_meta__", {"format_version": 0})
+        if meta.get("format_version", 0) > self.FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {files[-1]} has format_version "
+                f"{meta['format_version']} > supported "
+                f"{self.FORMAT_VERSION}; upgrade the framework to resume "
+                "from it")
+        return payload
 
 
 class Estimator:

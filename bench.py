@@ -145,6 +145,19 @@ def main():
         "pure_step_vs_baseline": round(pure_per_chip / A100_IMAGES_PER_SEC,
                                        3),
         "infeed_fraction": round(r["infeed_fraction"], 3),
+        # What pins `value`: this harness's tunneled H2D link measures
+        # ~30 MB/s (PROFILE_r03/ANALYSIS.md) so e2e is an ENVIRONMENT
+        # ceiling, not framework speed — readers and gates keying on
+        # `value` must check bound_by first.  pure_step_* is the portable
+        # framework number; synthetic_infeed_* projects e2e on a healthy
+        # (real TPU-VM) link where the uint8 infeed hides behind compute.
+        "bound_by": ("infeed(env)" if r["infeed_fraction"] > 0.5
+                     else "compute"),
+        "synthetic_infeed_images_per_sec_per_chip": round(pure_per_chip, 1),
+        "synthetic_infeed_note": (
+            "e2e projection with device-resident data: on hardware whose "
+            "H2D sustains > batch_bytes/step_time the uint8 infeed is "
+            "fully hidden and e2e converges to pure_step"),
         "compiles_timed": r["compiles_timed"],
         "platform": ctx.platform,
         "devices": ctx.num_devices,

@@ -1,0 +1,127 @@
+"""Synchronous parameter server on the actor runtime.
+
+Mirror of the reference example
+pyzoo/zoo/examples/ray/parameter_server/sync_parameter_server.py (a
+``@ray.remote`` ParameterServer + Worker pair on RayOnSpark), rebuilt on
+``analytics_zoo_tpu.parallel.actors``: the PS actor owns the flat weight
+vector and applies averaged gradients; worker actors hold data shards and
+compute gradients at the current weights.  The model is a pure-numpy
+softmax regression on sklearn digits so actor processes stay jax-free
+(fork safety) — the point of this example is the DISTRIBUTION pattern,
+not the math.
+
+Usage: python examples/parameter_server/sync_parameter_server.py
+       [--num-workers 4] [--iterations 40]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from analytics_zoo_tpu.parallel.actors import (
+    ActorContext,
+    get,
+    remote,
+)
+
+DIM, CLASSES = 64, 10
+
+
+def softmax_grads(w_flat, x, y):
+    """loss + gradient of softmax regression, flat-vector weights."""
+    w = w_flat[:DIM * CLASSES].reshape(DIM, CLASSES)
+    b = w_flat[DIM * CLASSES:]
+    logits = x @ w + b
+    logits -= logits.max(1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(1, keepdims=True)
+    n = len(x)
+    loss = -np.log(p[np.arange(n), y] + 1e-12).mean()
+    dlogits = p
+    dlogits[np.arange(n), y] -= 1.0
+    dlogits /= n
+    gw = x.T @ dlogits
+    gb = dlogits.sum(0)
+    return loss, np.concatenate([gw.reshape(-1), gb])
+
+
+@remote
+class ParameterServer:
+    """Owns the weights; applies averaged worker gradients (reference
+    sync_parameter_server.py ParameterServer.apply_gradients)."""
+
+    def __init__(self, learning_rate=0.5):
+        self.lr = learning_rate
+        rng = np.random.default_rng(0)
+        self.w = (rng.normal(0, 0.01, DIM * CLASSES + CLASSES)
+                  .astype(np.float64))
+
+    def apply_gradients(self, *gradients):
+        self.w -= self.lr * np.mean(gradients, axis=0)
+        return self.w
+
+    def get_weights(self):
+        return self.w
+
+
+@remote
+class Worker:
+    """Holds a data shard; computes gradients at given weights (reference
+    Worker.compute_gradients)."""
+
+    def __init__(self, worker_index, num_workers, batch_size=128):
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        x = (d.images.reshape(-1, DIM) / 16.0).astype(np.float64)
+        y = d.target.astype(np.int64)
+        self.x = x[worker_index::num_workers]
+        self.y = y[worker_index::num_workers]
+        self.batch = batch_size
+        self.rng = np.random.default_rng(worker_index)
+        self.last_loss = None
+
+    def compute_gradients(self, weights):
+        idx = self.rng.integers(0, len(self.x), self.batch)
+        loss, g = softmax_grads(weights, self.x[idx], self.y[idx])
+        self.last_loss = float(loss)
+        return g
+
+    def loss_on_shard(self, weights):
+        loss, _ = softmax_grads(weights, self.x, self.y)
+        return float(loss)
+
+
+def run(num_workers=4, iterations=40, lr=0.5):
+    ctx = ActorContext.init()
+    ps = ParameterServer.remote(lr)
+    workers = [Worker.remote(i, num_workers) for i in range(num_workers)]
+
+    weights = ps.get_weights.remote().get()
+    loss0 = float(np.mean(get(
+        [w.loss_on_shard.remote(weights) for w in workers])))
+    for _ in range(iterations):
+        grads = get([w.compute_gradients.remote(weights) for w in workers])
+        weights = ps.apply_gradients.remote(*grads).get()
+    loss1 = float(np.mean(get(
+        [w.loss_on_shard.remote(weights) for w in workers])))
+    ctx.stop()
+    return loss0, loss1
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-workers", type=int, default=4)
+    p.add_argument("--iterations", type=int, default=40)
+    a = p.parse_args()
+    loss0, loss1 = run(a.num_workers, a.iterations)
+    print(f"loss {loss0:.4f} -> {loss1:.4f} "
+          f"({a.num_workers} workers, sync PS)")
+
+
+if __name__ == "__main__":
+    main()

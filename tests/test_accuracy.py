@@ -1,0 +1,33 @@
+"""CI re-check of the accuracy-parity configs (VERDICT r03 missing #1):
+small versions of the ACCURACY_r04.json runs — LeNet on the real sklearn
+digits to a convergence bar, and bit-exact checkpoint-resume curve
+reproduction (reference resume semantics, TrainImageNet.scala:104-118;
+exact iterator state resume is feature/dataset.py's contract)."""
+
+import numpy as np
+
+from tools.accuracy_bench import digits_data, run_lenet
+
+
+def test_lenet_digits_converges(zoo_ctx, tmp_path):
+    hist, acc, _ = run_lenet(epochs=12)
+    assert acc >= 0.95, acc
+    assert hist[-1] < 0.3 * hist[0]
+
+
+def test_resume_reproduces_curve_exactly(zoo_ctx, tmp_path):
+    full_hist, full_acc, _ = run_lenet(epochs=6)
+    res_hist, res_acc, _ = run_lenet(epochs=6,
+                                     ckpt_dir=str(tmp_path / "ck"),
+                                     stop_at=3)
+    tail = full_hist[-len(res_hist):]
+    np.testing.assert_allclose(tail, res_hist, atol=1e-5)
+    assert abs(full_acc - res_acc) < 1e-6
+
+
+def test_digits_split_is_real_data():
+    (xt, yt), (xv, yv) = digits_data()
+    assert xt.shape == (1536, 16, 16, 1) and len(xv) == 261
+    # all ten classes present in both splits
+    assert set(np.unique(yt)) == set(range(10))
+    assert set(np.unique(yv)) == set(range(10))

@@ -126,3 +126,36 @@ def test_async_checkpoint_roundtrip(tmp_path):
     m2.fit(x, y, batch_size=8, nb_epoch=3)  # absolute target reached: noop
     res = m2.evaluate(x, y, batch_size=8)
     assert abs(res["loss"] - ref["loss"]) < 1e-6
+
+
+def test_checkpoint_schema_version(tmp_path):
+    """Checkpoints carry a format_version (VERDICT r03 weak #9: bare
+    pickle with no schema); newer-format snapshots are refused, legacy
+    (unversioned) ones still load."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.estimator.estimator import _Checkpointer
+
+    ck = _Checkpointer(str(tmp_path / "ck"))
+    ck.save("0000", {"params": {"w": jnp.ones((2,))}, "step": 3})
+    ck._wait()
+    raw = pickle.load(open(ck.list()[-1], "rb"))
+    assert raw["__ckpt_meta__"]["format_version"] == 1
+    got = ck.latest()
+    assert "__ckpt_meta__" not in got and got["step"] == 3
+
+    # legacy snapshot (no meta) loads as version 0
+    legacy = str(tmp_path / "ck" / "ckpt-0001.pkl")
+    with open(legacy, "wb") as f:
+        pickle.dump({"step": 9}, f)
+    assert ck.latest()["step"] == 9
+
+    # future snapshot is refused with a clear error
+    future = str(tmp_path / "ck" / "ckpt-0002.pkl")
+    with open(future, "wb") as f:
+        pickle.dump({"__ckpt_meta__": {"format_version": 99},
+                     "step": 1}, f)
+    with pytest.raises(ValueError, match="format_version"):
+        ck.latest()

@@ -202,3 +202,23 @@ def test_streaming_objectdetection_example():
     # annotated copies keep image shape
     a = np.load(os.path.join(out_dir, outs[0]))
     assert a.shape == (64, 64, 3)
+
+
+def test_variational_autoencoder_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/variational_autoencoder.ipynb"))
+    assert ns["recon_err"] < 0.06
+
+
+def test_sentiment_analysis_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/sentiment_analysis.ipynb"))
+    assert ns["test_acc"] > 0.85
+
+
+def test_image_similarity_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/image_similarity.ipynb"))
+    assert ns["precision_at_10"] >= 0.8
+
+
+def test_wide_n_deep_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/wide_n_deep.ipynb"))
+    assert ns["test_acc"] > 0.8

@@ -341,3 +341,40 @@ def test_transformer_training_forward_routes_to_pallas(monkeypatch):
         "TransformerLayer training attention (causal + dropout) did not "
         "route to the Pallas kernel")
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_stats_matches_reference(causal):
+    """(out, m, l) partial form: kernel (interpret) vs jnp reference, and
+    the combine identity — two disjoint key halves merged with the flash
+    update must equal full attention."""
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        _attention_stats_reference,
+        _flash_fwd_pallas,
+    )
+
+    q = _rand((2, 2, 128, 64), 60)
+    k = _rand((2, 2, 128, 64), 61)
+    v = _rand((2, 2, 128, 64), 62)
+    got = _flash_fwd_pallas(q, k, v, causal, 0.125, 64, 64,
+                            interpret=True, return_stats=True)
+    want = _attention_stats_reference(q, k, v, causal, 0.125)
+    for a, b, name in zip(got, want, ("out", "m", "l")):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+    if not causal:
+        # combine two halves of the keys -> full attention
+        o1, m1, l1 = _attention_stats_reference(q, k[:, :, :64],
+                                                v[:, :, :64], False, 0.125)
+        o2, m2, l2 = _attention_stats_reference(q, k[:, :, 64:],
+                                                v[:, :, 64:], False, 0.125)
+        m12 = np.maximum(m1, m2)
+        a1, a2 = np.exp(m1 - m12), np.exp(m2 - m12)
+        l12 = l1 * a1 + l2 * a2
+        acc = (np.asarray(o1) * np.asarray(l1)[..., None] * a1[..., None]
+               + np.asarray(o2) * np.asarray(l2)[..., None]
+               * a2[..., None])
+        full = _attention_reference(q, k, v, False, 0.125)
+        np.testing.assert_allclose(acc / l12[..., None], full, rtol=1e-4,
+                                   atol=1e-4)

@@ -1,0 +1,257 @@
+"""Accuracy-parity evidence (VERDICT r03 missing #1): train flagship
+recipes to convergence, record the full curve, and prove checkpoint-resume
+reproduces it.  Writes ACCURACY_r04.json.
+
+Dataset reality in this sandbox: there is NO network egress and no
+MNIST/CIFAR archive on disk, so the reference configs are anchored as:
+
+* ``lenet_digits`` — LeNet on scikit-learn's bundled **real** handwritten
+  digits (1797 8x8 images, upscaled 2x), the closest available stand-in
+  for the LeNet/MNIST config (BASELINE.json config 1).
+* ``resnet_shapes`` — ResNet-20 (CIFAR topology, models/resnet.py:122)
+  on a procedurally generated 10-class 32x32x3 shapes dataset with
+  nuisance variation (position/scale/rotation/color/noise), trained with
+  the TrainImageNet.scala:36-120 recipe equivalent (linear warmup + epoch
+  decay, momentum, weight decay) scaled to the small run.
+
+* ``resume`` — the lenet run is repeated with a mid-training stop +
+  checkpoint-resume; the resumed loss curve must match the uninterrupted
+  one (exact (epoch, cursor, seed) iterator resume, feature/dataset.py).
+
+Usage: python tools/accuracy_bench.py [--configs lenet,resnet,resume]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def digits_data():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images / 16.0).astype(np.float32)
+    x = np.kron(x, np.ones((1, 2, 2), np.float32))[..., None]  # 16x16
+    y = d.target.astype(np.int32)
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(len(x))
+    x, y = x[idx], y[idx]
+    n_train = 1536
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def _lenet16():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        Flatten,
+        MaxPooling2D,
+    )
+
+    m = Sequential(name="lenet16")
+    m.add(Convolution2D(6, 5, 5, activation="tanh", border_mode="same",
+                        input_shape=(16, 16, 1)))
+    m.add(MaxPooling2D())
+    m.add(Convolution2D(16, 5, 5, activation="tanh"))
+    m.add(MaxPooling2D())
+    m.add(Flatten())
+    m.add(Dense(120, activation="tanh"))
+    m.add(Dense(84, activation="tanh"))
+    m.add(Dense(10, activation="softmax"))
+    return m
+
+
+def run_lenet(epochs=30, ckpt_dir=None, stop_at=None):
+    """Train LeNet on digits; returns (per-epoch history, final test acc,
+    model)."""
+    (xt, yt), (xv, yv) = digits_data()
+    m = _lenet16()
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    if ckpt_dir:
+        m.set_checkpoint(ckpt_dir)
+    m.fit(xt, yt, batch_size=64, nb_epoch=stop_at or epochs)
+    if stop_at and stop_at < epochs:
+        # fresh model resumes from the checkpoint dir (the crash-recovery
+        # path) and continues to the absolute epoch target
+        m = _lenet16()
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.set_checkpoint(ckpt_dir)
+        m.fit(xt, yt, batch_size=64, nb_epoch=epochs)
+    hist = [h["loss"] for h in m._estimator.history]
+    acc = float(m.evaluate(xv, yv, batch_size=87)["accuracy"])
+    return hist, acc, m
+
+
+def shapes_data(n=10000, seed=0):
+    """10-class procedural shapes with nuisance variation: the conv net
+    must generalize over position/scale/rotation/color/noise."""
+    rng = np.random.default_rng(seed)
+    n_cls = 10
+    y = rng.integers(0, n_cls, size=n).astype(np.int32)
+    x = rng.normal(0, 0.25, size=(n, 32, 32, 3)).astype(np.float32)
+    yy, xx = np.mgrid[0:32, 0:32]
+    for i in range(n):
+        k = y[i]
+        cx, cy = rng.uniform(10, 22, 2)
+        s = rng.uniform(5, 9)
+        th = rng.uniform(0, 2 * np.pi)
+        u = (xx - cx) * np.cos(th) + (yy - cy) * np.sin(th)
+        v = -(xx - cx) * np.sin(th) + (yy - cy) * np.cos(th)
+        if k == 0:      # disc
+            mask = u ** 2 + v ** 2 < s ** 2
+        elif k == 1:    # ring
+            r2 = u ** 2 + v ** 2
+            mask = (r2 < s ** 2) & (r2 > (0.55 * s) ** 2)
+        elif k == 2:    # square
+            mask = (np.abs(u) < s * 0.8) & (np.abs(v) < s * 0.8)
+        elif k == 3:    # hollow square
+            a, b = np.abs(u), np.abs(v)
+            mask = (np.maximum(a, b) < s * 0.8) & \
+                (np.maximum(a, b) > s * 0.45)
+        elif k == 4:    # bar
+            mask = (np.abs(u) < s) & (np.abs(v) < s * 0.3)
+        elif k == 5:    # cross
+            mask = ((np.abs(u) < s * 0.3) & (np.abs(v) < s)) | \
+                ((np.abs(v) < s * 0.3) & (np.abs(u) < s))
+        elif k == 6:    # triangle (half-plane cuts)
+            mask = (v > -s * 0.5) & (v < 2 * (s - np.abs(u)) - s * 0.5)
+        elif k == 7:    # diamond
+            mask = np.abs(u) + np.abs(v) < s
+        elif k == 8:    # two discs
+            mask = ((u - s * 0.6) ** 2 + v ** 2 < (0.45 * s) ** 2) | \
+                ((u + s * 0.6) ** 2 + v ** 2 < (0.45 * s) ** 2)
+        else:           # checker texture patch
+            mask = ((np.abs(u) < s) & (np.abs(v) < s)
+                    & (((u // 2).astype(int) + (v // 2).astype(int)) % 2
+                       == 0))
+        color = rng.uniform(0.6, 1.4, size=3).astype(np.float32)
+        x[i][mask] += color
+    return x, y
+
+
+def run_resnet(epochs=16, depth=20, n=10000, batch=128):
+    from analytics_zoo_tpu.models.resnet import ResNet
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD,
+        warmup_epoch_decay,
+    )
+
+    x, y = shapes_data(n)
+    n_train = int(n * 0.8) // batch * batch
+    xt, yt = x[:n_train], y[:n_train]
+    xv, yv = x[n_train:], y[n_train:]
+    steps = n_train // batch
+    m = ResNet.cifar(depth=depth, classes=10)
+    # TrainImageNet.scala recipe shape, scaled: 2-epoch linear warmup then
+    # 0.1x decay at 50%/75% of the run, momentum 0.9, weight decay 1e-4
+    sched = warmup_epoch_decay(
+        warmup_steps=2 * steps, steps_per_epoch=steps,
+        boundaries_epochs=(epochs // 2, (3 * epochs) // 4), decay=0.1)
+    m.compile(optimizer=SGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
+                            schedule=sched),
+              loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(xt, yt, batch_size=batch, nb_epoch=epochs)
+    hist = [h["loss"] for h in m._estimator.history]
+    acc = float(m.evaluate(xv, yv, batch_size=100)["accuracy"])
+    return hist, acc
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--configs", default="lenet,resume,resnet")
+    p.add_argument("--resnet-epochs", type=int, default=16)
+    p.add_argument("--out", default=None)
+    a = p.parse_args()
+    configs = a.configs.split(",")
+
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context(seed=0)
+    d = jax.devices()[0]
+    out = {"platform": d.platform, "device_kind": d.device_kind,
+           "notes": ("no network egress and no MNIST/CIFAR archives exist "
+                     "in this sandbox; lenet uses scikit-learn's bundled "
+                     "real digits, resnet uses procedural shapes with "
+                     "nuisance variation — see tools/accuracy_bench.py")}
+
+    if "lenet" in configs:
+        t0 = time.time()
+        hist, acc, _ = run_lenet(epochs=30)
+        out["lenet_digits"] = {
+            "model": "LeNet-5 (16x16 input)",
+            "dataset": "sklearn digits (1797 real 8x8 images, 2x upscale)",
+            "train_size": 1536, "test_size": 261, "epochs": 30,
+            "loss_curve": [round(v, 4) for v in hist],
+            "test_accuracy": round(acc, 4),
+            "target": ">= 0.98 (MNIST-parity stand-in)",
+            "passed": acc >= 0.98,
+            "seconds": round(time.time() - t0, 1),
+        }
+        print("lenet_digits acc", acc)
+
+    if "resume" in configs:
+        t0 = time.time()
+        full_hist, full_acc, _ = run_lenet(epochs=10)
+        ck = tempfile.mkdtemp()
+        res_hist, res_acc, _ = run_lenet(epochs=10, ckpt_dir=ck, stop_at=5)
+        # the resumed run only has epochs 6..10 in its own history; compare
+        # that tail against the uninterrupted curve
+        tail = full_hist[-len(res_hist):]
+        max_dev = float(np.max(np.abs(np.asarray(tail)
+                                      - np.asarray(res_hist))))
+        out["resume_reproduces_curve"] = {
+            "uninterrupted_tail": [round(v, 5) for v in tail],
+            "resumed_tail": [round(v, 5) for v in res_hist],
+            "max_abs_deviation": round(max_dev, 6),
+            "final_acc_uninterrupted": round(full_acc, 4),
+            "final_acc_resumed": round(res_acc, 4),
+            "passed": max_dev < 1e-3 and abs(full_acc - res_acc) < 0.02,
+            "seconds": round(time.time() - t0, 1),
+        }
+        print("resume max_dev", max_dev, "accs", full_acc, res_acc)
+
+    if "resnet" in configs:
+        t0 = time.time()
+        hist, acc = run_resnet(epochs=a.resnet_epochs)
+        out["resnet_shapes"] = {
+            "model": "ResNet-20 (CIFAR topology)",
+            "dataset": "procedural 10-class shapes 32x32x3 "
+                       "(position/scale/rotation/color/noise nuisance)",
+            "train_size": 7936, "test_size": 2064,
+            "epochs": a.resnet_epochs,
+            "recipe": "TrainImageNet.scala:36-120 equivalent: 2-epoch "
+                      "linear warmup, 0.1x decay at 50%/75%, momentum "
+                      "0.9, wd 1e-4",
+            "loss_curve": [round(v, 4) for v in hist],
+            "test_accuracy": round(acc, 4),
+            "target": ">= 0.93 (CIFAR-10/ResNet-56 parity stand-in)",
+            "passed": acc >= 0.93,
+            "seconds": round(time.time() - t0, 1),
+        }
+        print("resnet_shapes acc", acc)
+
+    path = a.out or os.path.join(os.path.dirname(__file__), "..",
+                                 "ACCURACY_r04.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: (v if not isinstance(v, dict) else
+                          {kk: vv for kk, vv in v.items()
+                           if kk != "loss_curve"})
+                      for k, v in out.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -125,6 +125,13 @@ def main():
     lats = np.array(sorted(
         (done_t[u] - enq_t[u]) * 1e3 for u in done_t))
     completed = len(lats)
+    if completed == 0:
+        print(json.dumps({
+            "error": "no records completed — server-side failure "
+                     "(check model path / broker); see serving logs",
+            "offered": a.n,
+        }))
+        sys.exit(1)
     d = jax.devices()[0]
     out = {
         "metric": "cluster_serving_latency_ms",
