@@ -145,18 +145,24 @@ def _attention_reference(q, k, v, causal, scale, bias=None, q_seg=None,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _attention_stats_reference(q, k, v, causal, scale):
+def _attention_stats_reference(q, k, v, causal, scale, mask=None):
     """(out, m, l) with the kernel's exact streaming semantics — the
-    combinable-partial form used by ring attention's inner blocks."""
+    combinable-partial form used by ring attention's inner blocks.
+    ``mask``: optional boolean keep-mask broadcastable to the score shape
+    (ring attention's per-hop global-position mask); combines with
+    ``causal``."""
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    live = mask
     if causal:
         lq, lk = scores.shape[-2], scores.shape[-1]
-        live = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)[None, None]
+        tri = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)[None, None]
+        live = tri if live is None else live & tri
+    if live is not None:
         scores = jnp.where(live, scores, _NEG)
     m = jnp.maximum(jnp.max(scores, axis=-1), _NEG)
     p = jnp.exp(scores - m[..., None])
-    if causal:
+    if live is not None:
         p = jnp.where(live, p, 0.0)
     l = jnp.sum(p, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) \

@@ -79,7 +79,13 @@ class _RemoteMethod:
 
 
 class ActorHandle:
-    """Client-side handle; one process per actor."""
+    """Client-side handle; one process per actor.
+
+    Thread-safe: sends serialize on a send lock (so concurrent
+    ``.remote()`` calls never interleave pipe writes or block behind an
+    in-flight ``get``); one waiter at a time drains the pipe under a recv
+    lock while others sleep on a condition variable, and ``get(timeout)``
+    is a TOTAL deadline, not per-message."""
 
     def __init__(self, cls, args, kwargs, ctx):
         self._ctx = ctx
@@ -89,7 +95,9 @@ class ActorHandle:
             target=_actor_loop, args=(cls, args, kwargs, child),
             daemon=True)  # daemon: dies with the parent (JVMGuard role)
         self._proc.start()
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._cv = threading.Condition()
         self._next_id = 0
         self._results: dict[int, tuple[str, Any]] = {}
         status, detail = self._conn.recv()
@@ -99,24 +107,53 @@ class ActorHandle:
         ctx._actors.append(self)
 
     def _call(self, method, args, kwargs) -> ObjectRef:
-        with self._lock:
+        with self._send_lock:
             call_id = self._next_id
             self._next_id += 1
             self._conn.send((call_id, method, args, kwargs))
         return ObjectRef(self, call_id)
 
+    def _take(self, call_id):
+        status, payload = self._results.pop(call_id)
+        if status == "error":
+            raise ActorError(payload)
+        return payload
+
     def _wait_for(self, call_id, timeout=None):
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
-            with self._lock:
+            with self._cv:
                 if call_id in self._results:
-                    status, payload = self._results.pop(call_id)
-                    if status == "error":
-                        raise ActorError(payload)
-                    return payload
-                if timeout is not None and not self._conn.poll(timeout):
-                    raise TimeoutError(f"call {call_id} timed out")
-                got_id, status, payload = self._conn.recv()
-                self._results[got_id] = (status, payload)
+                    return self._take(call_id)
+            remaining = None if deadline is None \
+                else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(f"call {call_id} timed out")
+            if self._recv_lock.acquire(blocking=False):
+                try:
+                    # became the reader; re-check first (a prior reader may
+                    # have delivered our result between checks)
+                    with self._cv:
+                        if call_id in self._results:
+                            return self._take(call_id)
+                    if remaining is not None and \
+                            not self._conn.poll(remaining):
+                        raise TimeoutError(f"call {call_id} timed out")
+                    got_id, status, payload = self._conn.recv()
+                    with self._cv:
+                        self._results[got_id] = (status, payload)
+                        self._cv.notify_all()
+                finally:
+                    self._recv_lock.release()
+            else:
+                # another thread is reading; sleep until it posts a result
+                with self._cv:
+                    if call_id in self._results:
+                        return self._take(call_id)
+                    self._cv.wait(timeout=0.05 if remaining is None
+                                  else min(0.05, remaining))
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -182,7 +219,17 @@ class _RemoteFunction:
 
 
 def remote(cls_or_fn):
-    """``@remote`` on a class or function (the ``@ray.remote`` surface)."""
+    """``@remote`` on a class or function (the ``@ray.remote`` surface).
+
+    Functions/classes must be MODULE-LEVEL (importable by qualified name
+    in the worker process) — nested functions, lambdas and methods are
+    rejected up front instead of failing obscurely in the pool child."""
+    qn = getattr(cls_or_fn, "__qualname__", "")
+    if "<locals>" in qn or "<lambda>" in qn:
+        raise ValueError(
+            f"@remote target {qn!r} is not module-level; workers resolve "
+            "remote functions/classes by import path, so define it at "
+            "module scope")
     if isinstance(cls_or_fn, type):
         return _RemoteClass(cls_or_fn)
     return _RemoteFunction(cls_or_fn)

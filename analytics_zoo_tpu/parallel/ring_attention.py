@@ -35,27 +35,12 @@ from jax.sharding import PartitionSpec as P
 
 from analytics_zoo_tpu.common.engine import SEQ_AXIS, get_zoo_context
 from analytics_zoo_tpu.ops.pallas.flash_attention import (
+    _attention_stats_reference,
     _pallas_available,
     attention_stats,
 )
 
 _NEG = -1e30
-
-
-def _block_stats_jnp(ql, k_blk, v_blk, mask, scale):
-    """(out, m, l) partials for one hop — jnp inner (CPU / small shapes /
-    backward rematerialization)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", ql, k_blk) * scale
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG)
-    m = jnp.maximum(jnp.max(s, axis=-1), _NEG)
-    p = jnp.exp(s - m[..., None])
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v_blk) \
-        / jnp.maximum(l, 1e-20)[..., None]
-    return out, m, l
 
 
 def _use_pallas_inner(ql) -> bool:
@@ -87,13 +72,15 @@ def _hop_stats(ql, k_blk, v_blk, kv_idx, my, causal, scale, lc):
 
         branch = jnp.where(kv_idx < my, 0, jnp.where(kv_idx == my, 1, 2))
         return lax.switch(branch, (full, diag, skip), None)
-    # jnp inner: one general mask covers all three cases
+    # jnp inner: one general global-position mask covers all three cases
+    # (shared streaming-stats semantics live in _attention_stats_reference)
     mask = None
     if causal:
         q_pos = my * lc + jnp.arange(lc)
         k_pos = kv_idx * lc + jnp.arange(lc)
-        mask = q_pos[:, None] >= k_pos[None, :]
-    return _block_stats_jnp(ql, k_blk, v_blk, mask, scale)
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    return _attention_stats_reference(ql, k_blk, v_blk, False, scale,
+                                      mask=mask)
 
 
 def _ring_fwd_scan(ql, kl, vl, axis_name, n_shards, causal, scale):
@@ -163,14 +150,17 @@ def _ring_vjp_bwd(axis_name, n_shards, causal, scale, res, g):
     big_d = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
     q_pos = my * lc + jnp.arange(lc)
+    # the last chunk is zero-PADDED (not widened): the O(lc*chunk) memory
+    # bound must hold for every lc, incl. lengths with no divisor <= 256
     ck = min(_BWD_CHUNK, lc)
-    n_ck = lc // ck if lc % ck == 0 else 1
-    if lc % ck:
-        ck = lc
+    n_ck = -(-lc // ck)
+    pad = n_ck * ck - lc
 
     def hop_grads(kv_idx, k_blk, v_blk):
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
+        kf = jnp.pad(k_blk.astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(v_blk.astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, pad), (0, 0)))
         k_base = kv_idx * lc
 
         def chunk(dq, ci):
@@ -178,13 +168,13 @@ def _ring_vjp_bwd(axis_name, n_shards, causal, scale, res, g):
             kc = lax.dynamic_slice_in_dim(kf, ks, ck, axis=2)
             vc = lax.dynamic_slice_in_dim(vf, ks, ck, axis=2)
             s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc) * scale
+            local_pos = ks + jnp.arange(ck)
+            live = (local_pos < lc)[None, :]  # mask the zero padding
             if causal:
-                k_pos = k_base + ks + jnp.arange(ck)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                s = jnp.where(mask, s, _NEG)
-            p = jnp.exp(s - m[..., None])
-            if causal:
-                p = jnp.where(mask, p, 0.0)
+                k_pos = k_base + local_pos
+                live = live & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(live, s, _NEG)
+            p = jnp.where(live, jnp.exp(s - m[..., None]), 0.0)
             p = p / l_safe[..., None]
             dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vc)
             ds = p * (dp - big_d[..., None])
@@ -195,8 +185,10 @@ def _ring_vjp_bwd(axis_name, n_shards, causal, scale, res, g):
 
         dq_h, (dk_s, dv_s) = lax.scan(
             chunk, jnp.zeros(ql.shape, jnp.float32), jnp.arange(n_ck))
-        dk_h = jnp.moveaxis(dk_s, 0, 2).reshape(b, h, lc, d)
-        dv_h = jnp.moveaxis(dv_s, 0, 2).reshape(b, h, lc, d)
+        dk_h = jnp.moveaxis(dk_s, 0, 2).reshape(
+            b, h, n_ck * ck, d)[:, :, :lc]
+        dv_h = jnp.moveaxis(dv_s, 0, 2).reshape(
+            b, h, n_ck * ck, d)[:, :, :lc]
         return dq_h, dk_h, dv_h
 
     def step(carry, i):

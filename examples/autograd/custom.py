@@ -1,0 +1,58 @@
+"""Custom layer via the autograd Lambda facade (reference
+pyzoo/zoo/examples/autograd/custom.py: a Lambda-built ``add_one_layer``
+inside a Sequential trained on a synthetic regression).
+
+Usage: python examples/autograd/custom.py [--epochs 30]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(epochs=30, n=512):
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api import autograd as A
+    from analytics_zoo_tpu.pipeline.api.autograd import CustomLoss, Lambda
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    init_zoo_context("autograd custom layer", seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w_true = rng.normal(size=(4, 1)).astype(np.float32)
+    y = (x @ w_true + 1.0).astype(np.float32)  # the +1 the Lambda learns
+
+    inp = Input(shape=(4,))
+    h = Dense(1)(inp)
+    # the reference's "add_one_layer": a custom op with no weights
+    out = Lambda(lambda v: v + 1.0)(h)
+    model = Model(inp, out)
+
+    def mae(y_true, y_pred):
+        return A.mean(A.abs(y_true - y_pred), axis=1)
+
+    model.compile(optimizer=Adam(lr=0.05), loss=CustomLoss(mae, [1]))
+    model.fit(x, y, batch_size=32, nb_epoch=epochs)
+    pred = np.asarray(model.predict(x))
+    err = float(np.mean(np.abs(pred - y)))
+    print(f"mean abs error after {epochs} epochs: {err:.4f}")
+    return err
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=30)
+    a = p.parse_args()
+    run(epochs=a.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""DataFrame image inference (reference
+pyzoo/zoo/examples/nnframes/imageInference/ImageInferenceExample.py:
+NNImageReader.readImages -> preprocessing chain -> NNModel.transform
+appends a prediction column).
+
+Generates a small on-disk image set, trains a tiny classifier on the same
+distribution, then runs the reference's inference flow over the
+DataFrame.
+
+Usage: python examples/nnframes/image_inference.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _write_images(root, n=24, size=24, seed=0):
+    """Class 0 = dark image, class 1 = bright image (PNG on disk)."""
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=n)
+    os.makedirs(root, exist_ok=True)
+    for i, lab in enumerate(labels):
+        base = 60 if lab == 0 else 190
+        img = np.clip(base + rng.normal(0, 20, (size, size, 3)), 0,
+                      255).astype(np.uint8)
+        cv2.imwrite(os.path.join(root, f"img_{i:03d}_{lab}.png"), img)
+    return labels
+
+
+def run():
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D,
+        Dense,
+        Flatten,
+    )
+    from analytics_zoo_tpu.pipeline.nnframes import NNImageReader, NNModel
+
+    init_zoo_context("nnframes image inference", seed=0)
+    root = tempfile.mkdtemp()
+    labels = _write_images(root)
+
+    # train a tiny brightness classifier on the same generator
+    rng = np.random.default_rng(1)
+    ytr = rng.integers(0, 2, size=64).astype(np.int32)
+    xtr = np.stack([
+        np.clip((60 if lab == 0 else 190)
+                + rng.normal(0, 20, (24, 24, 3)), 0, 255) / 255.0
+        for lab in ytr
+    ]).astype(np.float32)
+    net = Sequential()
+    net.add(Convolution2D(4, 3, 3, activation="relu",
+                          input_shape=(24, 24, 3)))
+    net.add(Flatten())
+    net.add(Dense(2, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    net.fit(xtr, ytr, batch_size=16, nb_epoch=30)
+
+    # the reference inference flow: read a DataFrame of images, transform
+    df = NNImageReader.read_images(root)
+    df["features"] = df["image"].map(
+        lambda im: (np.asarray(im, np.float32) / 255.0))
+    nn_model = NNModel(net).set_features_col("features").set_batch_size(8)
+    out = nn_model.transform(df)
+    pred = np.stack(out["prediction"].to_numpy())
+    classes = pred.argmax(1)
+    # file names carry the truth: img_<i>_<label>.png
+    truth = np.array([int(os.path.basename(p).split("_")[2][0])
+                      for p in df["origin"]])
+    acc = float((classes == truth).mean())
+    print(f"DataFrame inference accuracy over {len(df)} images: {acc:.2f}")
+    return acc
+
+
+def main():
+    argparse.ArgumentParser(description=__doc__).parse_args()
+    run()
+
+
+if __name__ == "__main__":
+    main()

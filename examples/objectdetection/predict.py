@@ -1,0 +1,70 @@
+"""Object-detection inference + visualization (reference
+pyzoo/zoo/examples/objectdetection/predict.py: load an ObjectDetector,
+predict an image set, draw boxes with the Visualizer).
+
+Trains the tiny SSD on the checked-in VOCmini fixture first (no
+pretrained-model downloads in this sandbox), then runs the reference's
+predict->visualize flow and writes annotated images.
+
+Usage: python examples/objectdetection/predict.py [--out-dir /tmp/dets]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from examples.objectdetection.train_ssd import MINI_CLASSES, VOC_MINI, run
+
+
+def predict_and_visualize(out_dir=None, epochs=30, conf=0.3):
+    out_dir = out_dir or tempfile.mkdtemp()
+    os.makedirs(out_dir, exist_ok=True)
+    # train the tiny detector on the fixture (stands in for load_model)
+    _, det = run(epochs=epochs)
+
+    from analytics_zoo_tpu.feature.image import ssd_val_set
+    from analytics_zoo_tpu.models.image.objectdetection import PascalVoc
+
+    class_map = {c: float(i + 1) for i, c in enumerate(MINI_CLASSES)}
+    recs = PascalVoc(VOC_MINI, "2007", "val",
+                     class_to_ind=class_map).roidb()
+    val = ssd_val_set(recs, resolution=64, max_boxes=4, label_offset=-1)
+    batches = list(val.batches(4, shuffle=False, drop_last=False))
+    images = np.concatenate([b["x"] for b in batches])
+
+    detections = det.predict_image_set(images, conf_threshold=conf)
+    written = []
+    for i, (img, dets_i) in enumerate(zip(images, detections)):
+        img8 = np.clip(np.asarray(img) * 255.0, 0, 255).astype(np.uint8) \
+            if np.asarray(img).dtype != np.uint8 else np.asarray(img)
+        annotated = det.visualize(img8, dets_i)
+        path = os.path.join(out_dir, f"det_{i:03d}.png")
+        try:
+            import cv2
+
+            cv2.imwrite(path, np.asarray(annotated)[..., ::-1])
+            written.append(path)
+        except ImportError:
+            np.save(path.replace(".png", ".npy"), np.asarray(annotated))
+            written.append(path.replace(".png", ".npy"))
+    n_boxes = sum(len(d["boxes"]) for d in detections)
+    print(f"wrote {len(written)} annotated images ({n_boxes} boxes) "
+          f"to {out_dir}")
+    return written, detections
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-dir", default=None)
+    p.add_argument("--epochs", type=int, default=30)
+    a = p.parse_args()
+    predict_and_visualize(out_dir=a.out_dir, epochs=a.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Asynchronous parameter server on the actor runtime.
+
+Mirror of the reference example
+pyzoo/zoo/examples/ray/parameter_server/async_parameter_server.py: workers
+pull weights, compute a gradient and push it back independently — the PS
+applies updates as they arrive (Hogwild-style), no global barrier.  Built
+on ``analytics_zoo_tpu.parallel.actors`` with the same numpy softmax
+model as the sync variant.
+
+Usage: python examples/parameter_server/async_parameter_server.py
+       [--num-workers 4] [--updates-per-worker 40]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from analytics_zoo_tpu.parallel.actors import ActorContext, get, remote
+from examples.parameter_server.sync_parameter_server import (
+    CLASSES,
+    DIM,
+    softmax_grads,
+)
+
+
+@remote
+class ParameterServer:
+    def __init__(self, learning_rate=0.3):
+        self.lr = learning_rate
+        rng = np.random.default_rng(0)
+        self.w = (rng.normal(0, 0.01, DIM * CLASSES + CLASSES)
+                  .astype(np.float64))
+        self.updates = 0
+
+    def push(self, grad):
+        """Apply ONE worker's gradient immediately (async semantics)."""
+        self.w -= self.lr * grad
+        self.updates += 1
+        return self.updates
+
+    def pull(self):
+        return self.w
+
+    def update_count(self):
+        return self.updates
+
+
+@remote
+class AsyncWorker:
+    def __init__(self, worker_index, num_workers, batch_size=128):
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        x = (d.images.reshape(-1, DIM) / 16.0).astype(np.float64)
+        y = d.target.astype(np.int64)
+        self.x = x[worker_index::num_workers]
+        self.y = y[worker_index::num_workers]
+        self.batch = batch_size
+        self.rng = np.random.default_rng(100 + worker_index)
+
+    def grad_at(self, weights):
+        idx = self.rng.integers(0, len(self.x), self.batch)
+        _, g = softmax_grads(weights, self.x[idx], self.y[idx])
+        return g
+
+    def loss_on_shard(self, weights):
+        loss, _ = softmax_grads(weights, self.x, self.y)
+        return float(loss)
+
+
+def run(num_workers=4, updates_per_worker=40, lr=0.3):
+    ctx = ActorContext.init()
+    ps = ParameterServer.remote(lr)
+    workers = [AsyncWorker.remote(i, num_workers)
+               for i in range(num_workers)]
+    w0 = ps.pull.remote().get()
+    loss0 = float(np.mean(get(
+        [w.loss_on_shard.remote(w0) for w in workers])))
+
+    # async loop: each worker's next gradient is computed at whatever
+    # weights it happens to pull — pushes interleave without a barrier
+    pending = {w: w.grad_at.remote(w0) for w in workers}
+    done = {w: 0 for w in workers}
+    while pending:
+        for w, ref in list(pending.items()):
+            g = ref.get()
+            ps.push.remote(g)
+            done[w] += 1
+            if done[w] < updates_per_worker:
+                fresh = ps.pull.remote().get()
+                pending[w] = w.grad_at.remote(fresh)
+            else:
+                del pending[w]
+
+    wN = ps.pull.remote().get()
+    loss1 = float(np.mean(get(
+        [w.loss_on_shard.remote(wN) for w in workers])))
+    total = ps.update_count.remote().get()
+    ctx.stop()
+    assert total == num_workers * updates_per_worker
+    return loss0, loss1
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-workers", type=int, default=4)
+    p.add_argument("--updates-per-worker", type=int, default=40)
+    a = p.parse_args()
+    loss0, loss1 = run(a.num_workers, a.updates_per_worker)
+    print(f"loss {loss0:.4f} -> {loss1:.4f} (async PS, "
+          f"{a.num_workers} workers)")
+
+
+if __name__ == "__main__":
+    main()

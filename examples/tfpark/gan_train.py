@@ -1,0 +1,71 @@
+"""GANEstimator training + sampling (reference
+pyzoo/zoo/examples/tensorflow/tfpark/gan/{gan_train.py,gan_eval.py}:
+train a GAN with TFGAN-style losses, then generate from the checkpoint).
+
+The data is a shifted 2-D Gaussian so CI can assert the generator's
+distribution moved; swap in image batches for a DCGAN.
+
+Usage: python examples/tfpark/gan_train.py [--steps 600]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(steps=600, model_dir=None):
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.tfpark.gan import GANEstimator
+
+    init_zoo_context("tfpark gan", seed=0)
+    rng = np.random.default_rng(0)
+    n = 512
+    noise = rng.normal(size=(n, 4)).astype(np.float32)
+    real = (3.0 + 0.5 * rng.normal(size=(n, 2))).astype(np.float32)
+
+    def generator_fn(z):
+        h = Dense(16, activation="relu")(z)
+        return Dense(2)(h)
+
+    def discriminator_fn(x):
+        h = Dense(16, activation="relu")(x)
+        return Dense(1)(h)
+
+    def g_loss(fake_logits):  # non-saturating generator loss
+        return jnp.mean(jnp.logaddexp(0.0, -fake_logits))
+
+    def d_loss(real_logits, fake_logits):
+        return jnp.mean(jnp.logaddexp(0.0, -real_logits)) + \
+            jnp.mean(jnp.logaddexp(0.0, fake_logits))
+
+    est = GANEstimator(
+        generator_fn, discriminator_fn, g_loss, d_loss,
+        generator_optimizer="adam", discriminator_optimizer="adam",
+        model_dir=model_dir or tempfile.mkdtemp())
+    est.train((noise, real), steps=steps, batch_size=64)
+
+    # gan_eval role: sample the trained generator
+    samples = est.generate(noise[:256])
+    mean = float(np.asarray(samples).mean())
+    print(f"generator sample mean after {steps} steps: {mean:.2f} "
+          f"(real mean 3.0)")
+    return mean
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=600)
+    a = p.parse_args()
+    run(steps=a.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""tfpark KerasModel on in-memory ndarrays (reference
+pyzoo/zoo/examples/tensorflow/tfpark/keras/keras_ndarray.py: wrap a keras
+model in tfpark.KerasModel, fit/evaluate/predict on numpy arrays).
+
+Usage: python examples/tfpark/keras_ndarray.py [--epochs 8]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(epochs=20):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Dropout
+    from analytics_zoo_tpu.tfpark import KerasModel
+
+    init_zoo_context("tfpark keras_ndarray", seed=0)
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images.reshape(-1, 64) / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    perm = np.random.default_rng(0).permutation(len(x))
+    x, y = x[perm], y[perm]
+    n = (len(x) // 64) * 64
+    x, y = x[:n], y[:n]
+    n_train = int(n * 0.8) // 64 * 64
+
+    net = Sequential()
+    net.add(Dense(64, activation="relu", input_shape=(64,)))
+    net.add(Dropout(0.2))
+    net.add(Dense(10, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+
+    model = KerasModel(net)
+    model.fit(x[:n_train], y[:n_train], batch_size=64, epochs=epochs)
+    metrics = model.evaluate(x[n_train:], y[n_train:], batch_per_thread=64)
+    preds = model.predict(x[n_train:], batch_per_thread=64)
+    classes = model.predict_classes(x[n_train:])
+    acc = float((classes == y[n_train:]).mean())
+    print(f"eval: {metrics} | predict {preds.shape} | acc {acc:.3f}")
+    return acc
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=20)
+    a = p.parse_args()
+    run(epochs=a.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -112,3 +112,46 @@ def test_parameter_server_example_learns():
 
     loss0, loss1 = run(num_workers=3, iterations=30)
     assert loss1 < 0.4 * loss0, (loss0, loss1)
+
+
+def test_get_timeout_is_total_deadline(ctx):
+    c = Counter.remote()
+    ref = c.slow_echo.remote("x", 1.0)
+    import time as _t
+
+    t0 = _t.perf_counter()
+    with pytest.raises(TimeoutError):
+        ref.get(timeout=0.2)
+    assert _t.perf_counter() - t0 < 0.8
+    assert ref.get(timeout=5) == "x"  # still retrievable afterwards
+
+
+def test_concurrent_getters_on_one_actor(ctx):
+    import threading
+
+    c = Counter.remote()
+    refs = [c.slow_echo.remote(i, 0.15) for i in range(4)]
+    results = {}
+
+    def getter(i):
+        results[i] = refs[i].get(timeout=10)
+
+    threads = [threading.Thread(target=getter, args=(i,))
+               for i in reversed(range(4))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: 0, 1: 1, 2: 2, 3: 3}
+
+
+def test_remote_rejects_non_module_level():
+    def make():
+        @remote
+        def f(x):
+            return x
+
+    with pytest.raises(ValueError, match="module-level"):
+        make()
+    with pytest.raises(ValueError, match="module-level"):
+        remote(lambda x: x)

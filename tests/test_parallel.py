@@ -503,3 +503,23 @@ class TestRingAttentionPallasInner:
             q, k, v, causal=False, use_flash=False) ** 2))(k)
         np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                    atol=1e-4)
+
+
+def test_ring_backward_chunk_padding(seq_ctx):
+    """lc not a multiple of the 256 backward chunk (here lc=320): the
+    padded last chunk must not corrupt dK/dV (zero-padding masked)."""
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+    from analytics_zoo_tpu.parallel import ring_attention
+
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1, 1280, 8))
+                           .astype(np.float32) * 0.5) for _ in range(3))
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        ring_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+            q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+        q, k, v, causal=True, use_flash=False) ** 2), argnums=(0, 1, 2))(
+            q, k, v)
+    for a, b, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, err_msg=name)
