@@ -59,14 +59,42 @@ def _actor_loop(cls, args, kwargs, conn):
 
 
 class ObjectRef:
-    """Future for one actor method call (the ray.ObjectRef role)."""
+    """Future for one actor method call (the ray.ObjectRef role).
+
+    ``get`` may be called repeatedly and from multiple threads: the first
+    successful wait caches the outcome on the ref, later calls return it
+    without touching the pipe."""
 
     def __init__(self, actor: "ActorHandle", call_id: int):
         self._actor = actor
         self._call_id = call_id
+        self._lock = threading.Lock()
+        self._done = False
+        self._outcome: tuple[str, Any] | None = None
 
     def get(self, timeout: float | None = None):
-        return self._actor._wait_for(self._call_id, timeout)
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        remaining = -1 if timeout is None else timeout
+        if not self._lock.acquire(timeout=remaining):
+            raise TimeoutError(f"call {self._call_id} timed out")
+        try:
+            if not self._done:
+                remaining = None if deadline is None \
+                    else max(deadline - _time.monotonic(), 0.0)
+                value = self._actor._wait_for(self._call_id, remaining)
+                self._outcome = ("ok", value)
+                self._done = True
+        except ActorError as e:
+            self._outcome = ("error", e)
+            self._done = True
+        finally:
+            self._lock.release()
+        status, payload = self._outcome
+        if status == "error":
+            raise payload
+        return payload
 
 
 class _RemoteMethod:
@@ -95,11 +123,17 @@ class ActorHandle:
             target=_actor_loop, args=(cls, args, kwargs, child),
             daemon=True)  # daemon: dies with the parent (JVMGuard role)
         self._proc.start()
+        import weakref
+
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
         self._cv = threading.Condition()
         self._next_id = 0
         self._results: dict[int, tuple[str, Any]] = {}
+        # live refs by call id: replies whose ref was never created or has
+        # been dropped (fire-and-forget .remote()) are discarded instead of
+        # accumulating in _results forever
+        self._refs = weakref.WeakValueDictionary()
         status, detail = self._conn.recv()
         if status != "ready":
             raise ActorError(f"actor {cls.__name__} failed to start:\n"
@@ -111,7 +145,9 @@ class ActorHandle:
             call_id = self._next_id
             self._next_id += 1
             self._conn.send((call_id, method, args, kwargs))
-        return ObjectRef(self, call_id)
+        ref = ObjectRef(self, call_id)
+        self._refs[call_id] = ref
+        return ref
 
     def _take(self, call_id):
         status, payload = self._results.pop(call_id)
@@ -143,7 +179,11 @@ class ActorHandle:
                         raise TimeoutError(f"call {call_id} timed out")
                     got_id, status, payload = self._conn.recv()
                     with self._cv:
-                        self._results[got_id] = (status, payload)
+                        # drop replies nobody holds a ref to (the
+                        # fire-and-forget pattern) so _results is bounded
+                        # by outstanding refs, not total call count
+                        if got_id == call_id or got_id in self._refs:
+                            self._results[got_id] = (status, payload)
                         self._cv.notify_all()
                 finally:
                     self._recv_lock.release()
@@ -224,14 +264,16 @@ def remote(cls_or_fn):
     Functions/classes must be MODULE-LEVEL (importable by qualified name
     in the worker process) — nested functions, lambdas and methods are
     rejected up front instead of failing obscurely in the pool child."""
+    if isinstance(cls_or_fn, type):
+        # classes travel to the child by fork inheritance (no import-path
+        # resolution), so nested classes are fine
+        return _RemoteClass(cls_or_fn)
     qn = getattr(cls_or_fn, "__qualname__", "")
     if "<locals>" in qn or "<lambda>" in qn:
         raise ValueError(
-            f"@remote target {qn!r} is not module-level; workers resolve "
-            "remote functions/classes by import path, so define it at "
-            "module scope")
-    if isinstance(cls_or_fn, type):
-        return _RemoteClass(cls_or_fn)
+            f"@remote target {qn!r} is not module-level; pool workers "
+            "resolve remote FUNCTIONS by import path, so define it at "
+            "module scope (classes may be nested)")
     return _RemoteFunction(cls_or_fn)
 
 

@@ -155,3 +155,48 @@ def test_remote_rejects_non_module_level():
         make()
     with pytest.raises(ValueError, match="module-level"):
         remote(lambda x: x)
+
+
+def test_same_ref_concurrent_and_repeated_gets(ctx):
+    import threading
+
+    c = Counter.remote()
+    ref = c.slow_echo.remote("v", 0.3)
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(ref.get(timeout=10)))
+        for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == ["v", "v", "v"]
+    assert ref.get() == "v"  # repeated get returns the cached outcome
+
+
+def test_fire_and_forget_replies_do_not_accumulate(ctx):
+    import gc
+
+    c = Counter.remote()
+    for _ in range(50):
+        c.incr.remote()          # refs dropped immediately
+    gc.collect()
+    assert c.value.remote().get(timeout=10) == 50
+    # replies for the dropped refs were discarded by the reader
+    assert len(c._results) == 0
+
+
+def test_nested_actor_class_allowed(ctx):
+    def make():
+        @remote
+        class Inner:
+            def __init__(self):
+                self.v = 7
+
+            def get_v(self):
+                return self.v
+
+        return Inner
+
+    handle = make().remote()
+    assert handle.get_v.remote().get(timeout=10) == 7

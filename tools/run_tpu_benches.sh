@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 TPU bench queue: waits for the axon tunnel to answer, then runs
+# every TPU-dependent artifact producer sequentially (ONE process on the
+# chip at a time — concurrent clients wedge the tunnel).
+# Usage: bash tools/run_tpu_benches.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_benches}
+mkdir -p "$LOG"
+
+probe() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+
+echo "$(date) waiting for TPU..." | tee -a "$LOG/queue.log"
+until probe; do
+  sleep 120
+done
+echo "$(date) TPU is back — running queue" | tee -a "$LOG/queue.log"
+
+run() {
+  name=$1; shift
+  echo "$(date) START $name" | tee -a "$LOG/queue.log"
+  timeout 3000 "$@" >"$LOG/$name.log" 2>&1
+  echo "$(date) DONE $name rc=$?" | tee -a "$LOG/queue.log"
+}
+
+# 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
+run flash python tools/flash_bench.py
+
+# 2. transformer at the honest config -> TRANSFORMER_r04.json
+run transformer python tools/transformer_bench.py \
+  --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
+  --remat --out TRANSFORMER_r04.json
+
+# 3. serving latency on the real chip -> SERVING_r04.json
+run serving python tools/serving_bench.py --rate 200 --n 2000
+
+# 4. pure-step probe (the Task-4 number)
+run perf python tools/perf_probe.py --batch 256 --steps 20
+
+# 5. headline bench line
+run bench python bench.py
+
+echo "$(date) queue complete" | tee -a "$LOG/queue.log"

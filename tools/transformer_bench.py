@@ -28,7 +28,7 @@ import numpy as np
 
 
 def run(seq=1024, batch=8, blocks=12, hidden=768, heads=12, vocab=32768,
-        steps=10, remat=False):
+        steps=10, remat=False, attn_drop=0.1, hidden_drop=0.1):
     import jax
 
     from analytics_zoo_tpu import init_zoo_context
@@ -42,7 +42,8 @@ def run(seq=1024, batch=8, blocks=12, hidden=768, heads=12, vocab=32768,
     tokens = Input(shape=(seq,), name="tokens")
     h = TransformerLayer(vocab=vocab, seq_len=seq, n_block=blocks,
                          n_head=heads, hidden_size=hidden,
-                         embedding_drop=0.0, remat=remat)(tokens)
+                         embedding_drop=0.0, attn_drop=attn_drop,
+                         hidden_drop=hidden_drop, remat=remat)(tokens)
     logits = Dense(vocab, name="lm_head")(h)
     net = Model(tokens, logits, name="gpt_bench")
     net.compile(optimizer="adam",
@@ -95,7 +96,8 @@ def run(seq=1024, batch=8, blocks=12, hidden=768, heads=12, vocab=32768,
         "compile_s": round(compile_s, 1),
         "params_m": round(n_all / 1e6, 1),
         "batch": batch, "seq": seq, "blocks": blocks, "hidden": hidden,
-        "remat": remat, "loss": round(float(loss), 3),
+        "remat": remat, "attn_drop": attn_drop,
+        "hidden_drop": hidden_drop, "loss": round(float(loss), 3),
         "platform": d.platform, "device_kind": d.device_kind,
         "train_flops_per_step": train_flops,
     }
@@ -119,10 +121,13 @@ def main():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint per transformer block")
+    p.add_argument("--attn-drop", type=float, default=0.1)
+    p.add_argument("--hidden-drop", type=float, default=0.1)
     p.add_argument("--out", default=None)
     a = p.parse_args()
     r = run(seq=a.seq, batch=a.batch, blocks=a.blocks, hidden=a.hidden,
-            heads=a.heads, steps=a.steps, remat=a.remat)
+            heads=a.heads, steps=a.steps, remat=a.remat,
+            attn_drop=a.attn_drop, hidden_drop=a.hidden_drop)
     print(json.dumps(r))
     if a.out:
         with open(a.out, "w") as f:
