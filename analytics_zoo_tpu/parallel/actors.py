@@ -144,9 +144,12 @@ class ActorHandle:
         with self._send_lock:
             call_id = self._next_id
             self._next_id += 1
+            # register the ref BEFORE the request leaves: otherwise a fast
+            # reply drained by a concurrent reader sees no live ref and
+            # discards the result this caller is about to wait on
+            ref = ObjectRef(self, call_id)
+            self._refs[call_id] = ref
             self._conn.send((call_id, method, args, kwargs))
-        ref = ObjectRef(self, call_id)
-        self._refs[call_id] = ref
         return ref
 
     def _take(self, call_id):
@@ -180,10 +183,15 @@ class ActorHandle:
                     got_id, status, payload = self._conn.recv()
                     with self._cv:
                         # drop replies nobody holds a ref to (the
-                        # fire-and-forget pattern) so _results is bounded
-                        # by outstanding refs, not total call count
+                        # fire-and-forget pattern), and purge stored
+                        # results whose ref has since been dropped without
+                        # get() — _results stays bounded by LIVE refs
                         if got_id == call_id or got_id in self._refs:
                             self._results[got_id] = (status, payload)
+                        for stale in [i for i in self._results
+                                      if i != call_id
+                                      and i not in self._refs]:
+                            del self._results[stale]
                         self._cv.notify_all()
                 finally:
                     self._recv_lock.release()

@@ -267,3 +267,18 @@ def test_objectdetection_predict_example(tmp_path):
     written, dets = predict_and_visualize(out_dir=str(tmp_path),
                                           epochs=12)
     assert written and all(os.path.exists(p) for p in written)
+
+
+def test_fraud_detection_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/fraud_detection.ipynb"))
+    assert ns["auc_value"] > 0.9 and ns["f1"] > 0.5
+
+
+def test_image_augmentation_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/image_augmentation.ipynb"))
+    assert ns["done"] and ns["out3d"].shape == (12, 12, 12)
+
+
+def test_recommendation_ncf_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/recommendation_ncf.ipynb"))
+    assert ns["test_acc"] > 0.75 and ns["hit"] >= 0.6

@@ -23,7 +23,8 @@ run() {
   name=$1; shift
   echo "$(date) START $name" | tee -a "$LOG/queue.log"
   timeout 3000 "$@" >"$LOG/$name.log" 2>&1
-  echo "$(date) DONE $name rc=$?" | tee -a "$LOG/queue.log"
+  rc=$?  # capture BEFORE $(date) resets $?
+  echo "$(date) DONE $name rc=$rc" | tee -a "$LOG/queue.log"
 }
 
 # 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
