@@ -34,7 +34,8 @@ QUICK_FILES = {
     "test_tensorboard.py", "test_dataset.py", "test_minimum_slice.py",
     "test_onnx.py", "test_image_ops.py", "test_inference.py",
     "test_serving.py", "test_keras2.py", "test_caffe.py",
-    "test_layer_oracle_enforcement.py",
+    "test_layer_oracle_enforcement.py", "test_actors.py",
+    "test_textset.py", "test_image3d.py",
 }
 
 
