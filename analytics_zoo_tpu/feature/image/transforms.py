@@ -449,3 +449,59 @@ class ImageAspectScale(Preprocessing):
         scale = min(self.min_size / short, self.max_size / long)
         nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
         return ImageResize(nh, nw).transform(img)
+
+
+def assemble_crop_batch(images, out_h, out_w, rng=None, offsets=None,
+                        flips=None, mirror=True, n_threads=None):
+    """Pack variable-size HWC uint8 images into one (N, oh, ow, C) uint8
+    batch with per-image random crop + horizontal flip — the host-side
+    batch-assembly hot loop that feeds the per-chip infeed (SURVEY.md
+    §2.3's justified native component).  Runs on C++ threads when the
+    native library is built (``native.build_native()``), numpy otherwise;
+    both paths are bit-identical.
+
+    Either pass a seeded ``rng`` (offsets/flips are drawn from it — the
+    deterministic-replay contract of the preprocessing chains) or pass
+    explicit ``offsets`` (N, 2) and ``flips`` (N,).
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu import native
+
+    n = len(images)
+    need_rng = offsets is None or (flips is None and mirror)
+    if need_rng and rng is None:
+        raise ValueError(
+            "pass a seeded rng (random crops/flips) or explicit "
+            "offsets/flips — a hidden fixed seed would silently repeat "
+            "the same augmentation every batch")
+    if offsets is None:
+        offsets = np.stack([
+            [rng.integers(0, im.shape[0] - out_h + 1),
+             rng.integers(0, im.shape[1] - out_w + 1)]
+            for im in images
+        ]).astype(np.int32)
+    if flips is None:
+        flips = (rng.random(n) < 0.5) if mirror else np.zeros(n, bool)
+    offsets = np.asarray(offsets, np.int32).reshape(n, 2)
+    flips = np.asarray(flips, bool).reshape(n)
+    # validate BEFORE dispatch: the C++ path would otherwise read out of
+    # bounds where the numpy path raises — same inputs must behave the same
+    for i, im in enumerate(images):
+        y0, x0 = int(offsets[i, 0]), int(offsets[i, 1])
+        if y0 < 0 or x0 < 0 or y0 + out_h > im.shape[0] \
+                or x0 + out_w > im.shape[1]:
+            raise ValueError(
+                f"image {i} ({im.shape[0]}x{im.shape[1]}): crop "
+                f"({out_h}x{out_w} at {y0},{x0}) out of bounds")
+    if native.lib is not None:
+        return native.lib.assemble_batch(images, offsets,
+                                         flips.astype(np.uint8),
+                                         out_h, out_w, n_threads=n_threads)
+    ch = images[0].shape[-1]
+    out = np.empty((n, out_h, out_w, ch), np.uint8)
+    for i, im in enumerate(images):
+        y0, x0 = int(offsets[i, 0]), int(offsets[i, 1])
+        crop = np.asarray(im, np.uint8)[y0:y0 + out_h, x0:x0 + out_w]
+        out[i] = crop[:, ::-1] if flips[i] else crop
+    return out

@@ -38,6 +38,14 @@ class _NativeLib:
             ctypes.c_size_t, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ]
+        self._dll.zoo_assemble_batch.restype = None
+        self._dll.zoo_assemble_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,
+        ]
 
     def crc32c(self, data: bytes) -> int:
         return self._dll.zoo_crc32c(data, len(data))
@@ -60,6 +68,36 @@ class _NativeLib:
         )
         return out
 
+    def assemble_batch(self, images, offsets, flips, out_h, out_w,
+                       n_threads=None):
+        """Pack variable-size HWC uint8 images into one (N, oh, ow, C)
+        uint8 batch with per-image crop offsets + horizontal flips, on C++
+        threads.  ``offsets``/``flips`` come from the caller's seeded RNG
+        so augmentation replay stays exact."""
+        import numpy as np
+
+        n = len(images)
+        ch = images[0].shape[-1]
+        imgs = [np.ascontiguousarray(im, dtype=np.uint8) for im in images]
+        ptrs = (ctypes.c_void_p * n)(
+            *[im.ctypes.data_as(ctypes.c_void_p).value for im in imgs])
+        hw = np.ascontiguousarray(
+            [[im.shape[0], im.shape[1]] for im in imgs], dtype=np.int32)
+        off = np.ascontiguousarray(offsets, dtype=np.int32)
+        flp = np.ascontiguousarray(flips, dtype=np.uint8)
+        out = np.empty((n, out_h, out_w, ch), dtype=np.uint8)
+        if n_threads is None:
+            n_threads = min(8, os.cpu_count() or 1)
+        self._dll.zoo_assemble_batch(
+            ptrs,
+            hw.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            off.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, out_h, out_w, ch, int(n_threads),
+        )
+        return out
+
 
 def build_native(force: bool = False):
     """Compile the C++ library with g++ (no external deps)."""
@@ -67,24 +105,63 @@ def build_native(force: bool = False):
     if os.path.exists(_SO) and not force:
         pass
     else:
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-march=native",
-               "-o", _SO, _SRC]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True)
-        except Exception as e:  # no compiler / failed build → fallback
-            logger.warning("native build failed: %s", e)
+        if not _compile(_SO):
             return None
     try:
         lib = _NativeLib(ctypes.CDLL(_SO))
+        return lib
+    except AttributeError:
+        # a stale .so from an older source (missing a new symbol).  glibc
+        # dlopen caches by path string IN-PROCESS, so rebuilding at the
+        # same path cannot replace the already-loaded stale mapping:
+        # compile to a UNIQUE path for this process's load, and install a
+        # canonical copy at _SO for future imports.
+        if force:
+            logger.warning("native lib missing symbols even after rebuild")
+            return None
+        import tempfile
+
+        uniq = os.path.join(tempfile.mkdtemp(prefix="zoonative-"),
+                            "libzoonative.so")
+        if not _compile(uniq):
+            return None
+        try:
+            lib = _NativeLib(ctypes.CDLL(uniq))
+        except (OSError, AttributeError) as e:
+            logger.warning("native reload failed: %s", e)
+            return None
+        try:  # refresh the canonical .so so the NEXT process loads fresh
+            import shutil
+
+            shutil.copy(uniq, _SO + ".new")
+            os.replace(_SO + ".new", _SO)
+        except OSError:
+            pass
         return lib
     except OSError as e:
         logger.warning("native load failed: %s", e)
         return None
 
 
+def _compile(out_path: str) -> bool:
+    # compile to a temp then rename: atomic for concurrent builders
+    tmp = out_path + ".build"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-march=native",
+           "-pthread", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, out_path)
+        return True
+    except Exception as e:  # no compiler / failed build → fallback
+        logger.warning("native build failed: %s", e)
+        return False
+
+
 lib = None
 if os.path.exists(_SO):
     try:
         lib = _NativeLib(ctypes.CDLL(_SO))
-    except OSError:
+    except (OSError, AttributeError):
+        # unreadable or STALE .so (older source without a new symbol) —
+        # keep the import-never-fails guarantee; build_native() rebuilds
         lib = None

@@ -9,6 +9,9 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
 
 extern "C" {
 
@@ -68,6 +71,57 @@ void zoo_normalize_u8(const uint8_t* in, float* out, size_t n,
     size_t ch = i % channels;
     out[i] = ((float)in[i] - mean[ch]) * inv[ch];
   }
+}
+
+// ---------------------------------------------------------------------------
+// Threaded batch assembly: N variable-size HWC uint8 images -> one
+// contiguous (N, oh, ow, ch) uint8 batch with per-image crop offsets and
+// horizontal flips.  This is the host-side hot loop that keeps the
+// per-chip infeed fed (SURVEY.md §2.3: "high-throughput host-side
+// decode/augment feeding infeed" — the one justified native component).
+// Crop offsets / flip flags come from the CALLER (seeded Python RNG), so
+// augmentation replay after checkpoint-resume stays exact.
+// ---------------------------------------------------------------------------
+
+void zoo_assemble_batch(const uint8_t* const* imgs,
+                        const int32_t* hw,    // (N, 2): src h, w
+                        const int32_t* off,   // (N, 2): crop y0, x0
+                        const uint8_t* flip,  // (N,): 1 = mirror
+                        uint8_t* out, int32_t n, int32_t oh, int32_t ow,
+                        int32_t ch, int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+  auto work = [&](int32_t start, int32_t end) {
+    for (int32_t i = start; i < end; ++i) {
+      const uint8_t* src = imgs[i];
+      const int32_t w = hw[2 * i + 1];
+      const int32_t y0 = off[2 * i], x0 = off[2 * i + 1];
+      uint8_t* dst_img = out + (size_t)i * oh * ow * ch;
+      for (int32_t y = 0; y < oh; ++y) {
+        const uint8_t* srow = src + ((size_t)(y0 + y) * w + x0) * ch;
+        uint8_t* drow = dst_img + (size_t)y * ow * ch;
+        if (!flip[i]) {
+          memcpy(drow, srow, (size_t)ow * ch);
+        } else {
+          for (int32_t x = 0; x < ow; ++x)
+            memcpy(drow + (size_t)x * ch,
+                   srow + (size_t)(ow - 1 - x) * ch, (size_t)ch);
+        }
+      }
+    }
+  };
+  if (n_threads == 1) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int32_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int32_t s = t * per, e = s + per < n ? s + per : n;
+    if (s >= e) break;
+    pool.emplace_back(work, s, e);
+  }
+  for (auto& th : pool) th.join();
 }
 
 }  // extern "C"

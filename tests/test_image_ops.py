@@ -139,3 +139,100 @@ def test_random_cropper_rejects_small_input():
     img = _img(8, 8)
     with pytest.raises(ValueError, match="smaller than crop"):
         ImageRandomCropper(16, 16)(img)
+
+
+class TestNativeBatchAssembly:
+    """C++ threaded batch assembly vs the numpy path (bit-identical), on
+    variable-size images with crops + flips."""
+
+    def _images(self, n=12, seed=0):
+        rng = np.random.default_rng(seed)
+        return [rng.integers(0, 256, size=(
+            int(rng.integers(40, 64)), int(rng.integers(40, 64)), 3),
+            dtype=np.uint8) for _ in range(n)]
+
+    def test_native_matches_numpy(self):
+        from analytics_zoo_tpu import native
+        from analytics_zoo_tpu.feature.image.transforms import (
+            assemble_crop_batch,
+        )
+
+        imgs = self._images()
+        rng = np.random.default_rng(7)
+        offsets = np.stack([
+            [rng.integers(0, im.shape[0] - 32 + 1),
+             rng.integers(0, im.shape[1] - 32 + 1)] for im in imgs
+        ]).astype(np.int32)
+        flips = rng.random(len(imgs)) < 0.5
+        assert flips.any() and (~flips).any()
+
+        lib = native.build_native()
+        if lib is None:
+            import pytest
+
+            pytest.skip("no C++ compiler available")
+        got = assemble_crop_batch(imgs, 32, 32, offsets=offsets,
+                                  flips=flips)
+        # numpy oracle path (force fallback)
+        saved, native.lib = native.lib, None
+        try:
+            want = assemble_crop_batch(imgs, 32, 32, offsets=offsets,
+                                       flips=flips)
+        finally:
+            native.lib = saved
+        assert got.shape == (12, 32, 32, 3) and got.dtype == np.uint8
+        np.testing.assert_array_equal(got, want)
+
+    def test_seeded_rng_reproducible(self):
+        from analytics_zoo_tpu.feature.image.transforms import (
+            assemble_crop_batch,
+        )
+
+        imgs = self._images(seed=1)
+        a = assemble_crop_batch(imgs, 24, 24,
+                                rng=np.random.default_rng(3))
+        b = assemble_crop_batch(imgs, 24, 24,
+                                rng=np.random.default_rng(3))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_assemble_crop_batch_validation():
+    from analytics_zoo_tpu.feature.image.transforms import (
+        assemble_crop_batch,
+    )
+
+    imgs = [np.zeros((30, 30, 3), np.uint8)]
+    # randomness without an rng is an error (no hidden fixed seed)
+    with pytest.raises(ValueError, match="rng"):
+        assemble_crop_batch(imgs, 24, 24)
+    # out-of-bounds explicit offsets fail loudly on BOTH paths
+    with pytest.raises(ValueError, match="out of bounds"):
+        assemble_crop_batch(imgs, 24, 24, offsets=[[10, 0]],
+                            flips=[False])
+    # explicit flips without offsets are honored (not overwritten)
+    out1 = assemble_crop_batch(imgs, 24, 24,
+                               rng=np.random.default_rng(0),
+                               flips=np.asarray([True]))
+    assert out1.shape == (1, 24, 24, 3)
+
+
+def test_stale_native_lib_rebuilds(tmp_path, monkeypatch):
+    """A .so built from older source (missing a new symbol) must not
+    crash import or build_native — it rebuilds from current source."""
+    import subprocess
+
+    from analytics_zoo_tpu import native
+
+    old_src = tmp_path / "old.cpp"
+    old_src.write_text(
+        'extern "C" { unsigned zoo_crc32c(const char*, unsigned long)'
+        "{ return 0; } }")
+    stale = tmp_path / "libzoonative.so"
+    r = subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o",
+                        str(stale), str(old_src)], capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("no compiler")
+    monkeypatch.setattr(native, "_SO", str(stale))
+    # build_native sees an existing-but-stale .so: must rebuild, not raise
+    lib = native.build_native()
+    assert lib is not None and hasattr(lib._dll, "zoo_assemble_batch")
