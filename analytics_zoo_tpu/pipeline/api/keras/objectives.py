@@ -102,6 +102,7 @@ def binary_crossentropy(y_true, y_pred):
 
 
 def binary_crossentropy_from_logits(y_true, logits):
+    logits = logits.astype(jnp.float32)  # f32 CE under bf16 compute
     return _reduce_rest(
         jnp.maximum(logits, 0) - logits * y_true
         + jnp.log1p(jnp.exp(-jnp.abs(logits)))
@@ -133,7 +134,10 @@ def sparse_categorical_crossentropy(y_true, y_pred):
 
 
 def sparse_categorical_crossentropy_from_logits(y_true, logits):
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # f32 softmax-CE regardless of compute dtype: a bf16 log-softmax over
+    # a 32k-vocab axis loses the tail of the normalizer; the upcast fuses
+    # into the reduction while the lm-head matmul stays bf16
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     labels = y_true.astype(jnp.int32)
     if labels.ndim == logp.ndim:
         labels = labels.squeeze(-1)
