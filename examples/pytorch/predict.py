@@ -1,0 +1,67 @@
+"""Inference through a PyTorch model (reference
+pyzoo/zoo/examples/pytorch/inference/predict.py: wrap a torchvision model
+in TorchNet and run distributed predict over images).
+
+TPU-native version: the torch module executes host-side via
+``pure_callback`` inside the jitted graph; the surrounding batching /
+mesh-sharded predict is the framework's.  Offline-safe: a small
+deterministic CNN stands in for the torchvision download.
+
+Usage: python examples/pytorch/predict.py [--n 64]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def make_model(classes=5):
+    import torch
+
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, stride=2), torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(8, classes), torch.nn.Softmax(dim=1),
+    ).eval()
+
+
+def run(n=64, size=32):
+    import torch
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    init_zoo_context("pytorch predict", seed=0)
+    module = make_model()
+    net = TorchNet.from_pytorch(module, input_shape=(3, size, size))
+    m = Sequential()
+    m.add(net)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 3, size, size)).astype(np.float32)
+    probs = np.asarray(m.predict(x))
+
+    with torch.no_grad():
+        ref = module(torch.from_numpy(x)).numpy()
+    err = float(np.max(np.abs(probs - ref)))
+    agree = float((probs.argmax(1) == ref.argmax(1)).mean())
+    print(f"predicted {probs.shape}; max |zoo - torch| = {err:.2e}; "
+          f"argmax agreement {agree:.2f}")
+    return err, agree
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=64)
+    a = p.parse_args()
+    run(n=a.n)
+
+
+if __name__ == "__main__":
+    main()

@@ -282,3 +282,10 @@ def test_image_augmentation_notebook_runs():
 def test_recommendation_ncf_notebook_runs():
     ns = _run_notebook(os.path.join(REPO, "apps/recommendation_ncf.ipynb"))
     assert ns["test_acc"] > 0.75 and ns["hit"] >= 0.6
+
+
+def test_pytorch_predict_example():
+    from examples.pytorch.predict import run
+
+    err, agree = run(n=32)
+    assert err < 1e-4 and agree == 1.0
