@@ -54,6 +54,11 @@ class Broker:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
+    def keys(self, prefix: str) -> list:
+        """Hash keys starting with ``prefix`` (the SCAN role needed by
+        OutputQueue.dequeue)."""
+        raise NotImplementedError
+
     def memory_ratio(self) -> float:
         """used_memory / maxmemory in [0,1]; brokers that cannot tell
         return 0.0 (no backpressure)."""
@@ -125,6 +130,10 @@ class InMemoryBroker(Broker):
     def delete(self, key):
         with self._cv:
             self._hashes.pop(key, None)
+
+    def keys(self, prefix):
+        with self._cv:
+            return [k for k in self._hashes if k.startswith(prefix)]
 
     def memory_ratio(self):
         n = sum(len(s) for s in self._streams.values())
@@ -268,6 +277,19 @@ class FileBroker(Broker):
         except OSError:
             pass
 
+    def keys(self, prefix):
+        # filenames are the mangled keys ("/" -> "_"); the mangle is
+        # idempotent, so returned keys round-trip through hgetall/delete
+        # (uris containing "/" come back with "_")
+        d = os.path.join(self.root, "hash")
+        pfx = prefix.replace("/", "_")
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        return [n[:-5] for n in names
+                if n.endswith(".json") and n.startswith(pfx)]
+
 
 class RedisBroker(Broker):
     """The reference transport (Jedis in ClusterServing.scala:119).  Gated
@@ -312,6 +334,11 @@ class RedisBroker(Broker):
 
     def delete(self, key):  # pragma: no cover
         self._r.delete(key)
+
+    def keys(self, prefix):  # pragma: no cover
+        # _type="hash": a shared db may hold non-hash keys under the same
+        # prefix; hgetall on those would raise WRONGTYPE mid-dequeue
+        return list(self._r.scan_iter(match=prefix + "*", _type="hash"))
 
     def memory_ratio(self):  # pragma: no cover
         info = self._r.info("memory")

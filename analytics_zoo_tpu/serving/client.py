@@ -72,9 +72,19 @@ class OutputQueue(API):
         return _decode_result(h)
 
     def dequeue(self) -> dict:
-        """All finished results, removing them (client.py:131)."""
-        raise NotImplementedError(
-            "dequeue requires key-scan support; use query(uri)")
+        """All finished results keyed by uri, removing them from the
+        broker (reference client.py:131 ``dequeue``)."""
+        out = {}
+        for key in self.db.keys(RESULT_PREFIX):
+            h = self.db.hgetall(key)
+            if not h:
+                continue
+            # key on the uri stored IN the hash: broker key names may be
+            # transport-mangled (FileBroker replaces "/")
+            uri = h.get("uri", key[len(RESULT_PREFIX):])
+            out[uri] = _decode_result(h)
+            self.db.delete(key)
+        return out
 
 
 def _decode_result(h: dict):

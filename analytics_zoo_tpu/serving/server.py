@@ -90,14 +90,16 @@ class ClusterServing:
 
     def _postprocess(self, uri: str, out: np.ndarray) -> dict:
         """Top-N (class, prob) json for vectors, tensor payload otherwise
-        (reference writes top-N class records back to redis)."""
+        (reference writes top-N class records back to redis).  The
+        original uri rides along so dequeue() can key results on it even
+        over transports whose key names are mangled (FileBroker)."""
         out = np.asarray(out)
         if out.ndim == 1 and self.helper.top_n:
             n = min(self.helper.top_n, out.shape[0])
             top = np.argsort(out)[::-1][:n]
-            return {"value": json.dumps(
+            return {"uri": uri, "value": json.dumps(
                 [[int(i), float(out[i])] for i in top])}
-        return {"tensor": encode_ndarray(out)}
+        return {"uri": uri, "tensor": encode_ndarray(out)}
 
     def process_batch(self, records) -> int:
         if not records:

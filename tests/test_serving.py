@@ -165,3 +165,50 @@ def test_filebroker_memory_ratio_and_server_trim(tmp_path):
     assert broker.xlen("image_stream") < before
     broker.xtrim("image_stream", 0)
     assert broker.memory_ratio() < 1.0
+
+
+def test_output_queue_dequeue(tmp_path, broker):
+    """OutputQueue.dequeue drains ALL finished results and removes them
+    (reference client.py:131) — previously NotImplementedError."""
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4, top_n=1,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        inq.enqueue_image(f"d-{i}", rng.normal(
+            size=(4, 4, 1)).astype(np.float32))
+    serving.run(max_records=6)
+    got = outq.dequeue()
+    assert sorted(got) == [f"d-{i}" for i in range(6)]
+    assert all("uri" not in str(v) for v in got.values())  # decoded value
+    for res in got.values():
+        cls, prob = res[0]
+        assert 0 <= cls < 5 and 0.0 <= prob <= 1.0
+    # removed: a second dequeue is empty and query misses
+    assert outq.dequeue() == {}
+    assert outq.query("d-0") is None
+
+
+def test_dequeue_keys_on_original_uri_with_slashes(tmp_path):
+    """FileBroker mangles '/' in key FILENAMES; dequeue must still key
+    results on the uri the client enqueued (stored in the hash)."""
+    broker = FileBroker(str(tmp_path / "spool"))
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=2, top_n=1,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    uris = ["s3://imgs/cat.jpg", "dir/sub/dog.png"]
+    for u in uris:
+        inq.enqueue_image(u, np.zeros((4, 4, 1), np.float32))
+    serving.run(max_records=2)
+    got = outq.dequeue()
+    assert sorted(got) == sorted(uris)
