@@ -289,3 +289,13 @@ def test_pytorch_predict_example():
 
     err, agree = run(n=32)
     assert err < 1e-4 and agree == 1.0
+
+
+def test_tfnet_predict_example():
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    from examples.tfnet.predict import run
+
+    err, agree = run(n=16)
+    assert err < 1e-4 and agree == 1.0
