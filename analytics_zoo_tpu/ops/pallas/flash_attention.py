@@ -179,7 +179,7 @@ def attention_stats(q, k, v, causal=False, scale=None, block_q=None,
     elsewhere.  NOT differentiable on the TPU path — callers (ring
     attention) wrap it in their own custom_vjp."""
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
-    block_q, block_k = _resolve_blocks(q.shape[2], block_q, block_k)
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     if _pallas_available() and q.shape[-1] % 64 == 0 \
             and q.shape[2] >= 128 and k.shape[2] >= 128:
         try:
@@ -404,18 +404,25 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     return res
 
 
-def _resolve_blocks(lq: int, block_q, block_k,
-                    full_bias: bool = False) -> tuple[int, int]:
-    """Tuned defaults (v5e sweep, FLASH_r03.json): big blocks amortize
-    grid-step overhead; VMEM caps block_q at 1024 once lq >= 8192.  A full
-    (…, Lq, Lk) bias streams an extra (block_q, block_k) f32 tile, so its
-    blocks drop to 512² to stay inside the ~16 MB VMEM budget."""
+def _resolve_blocks(block_q, block_k,
+                    full_bias: bool = False,
+                    dropout: bool = False) -> tuple[int, int]:
+    """Block defaults sized against the v5e ~16 MB scoped-VMEM budget.
+
+    The dominant live buffers are the (block_q, block_k) f32 score and
+    prob tiles; in-kernel dropout adds a PRNG-bits tile of the same shape
+    and a full (…, Lq, Lk) bias streams an extra f32 tile.  The r03-tuned
+    2048-row blocks left <1% headroom and went over once those operands
+    landed (measured: 16.09M/16M clean @4k d=64, 22.73M/16M dropout @2k
+    d=128 — both hard compile failures on the chip), so: 1024x1024 clean
+    (~10 MB live), block_k 512 under dropout/full-bias (~8 MB live).
+    Explicit block_q/block_k arguments always win."""
     if full_bias:
         return block_q or 512, block_k or 512
     if block_q is None:
-        block_q = 2048 if lq <= 4096 else 1024
+        block_q = 1024
     if block_k is None:
-        block_k = 1024
+        block_k = 512 if dropout else 1024
     return block_q, block_k
 
 
@@ -632,10 +639,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
       dropout_p: attention-prob dropout; requires ``dropout_seed`` (int,
         PRNG key, or (2,) int array).  The mask is hash-derived in-kernel.
 
-    Default blocks are tuned from the v5e sweep in FLASH_r03.json:
-    (2048, 1024) sustains 112 TF vs 24 TF at 256x256 (grid-step overheads
-    dominate small blocks), but the scoped-VMEM budget caps block_q at
-    1024 for sequences >= 8192 — ``_resolve_blocks`` encodes both."""
+    Default blocks come from ``_resolve_blocks``: 1024x1024 (clean),
+    1024x512 (dropout), 512x512 (full (Lq, Lk) bias), sized against the
+    v5e ~16 MB scoped-VMEM budget — see that function's docstring for the
+    measured limits that set them."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
@@ -654,6 +661,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
         raise ValueError("dropout_p > 0 requires dropout_seed")
     seed = _normalize_seed(dropout_seed) if dropout_p > 0.0 else None
     full_bias = bias is not None and bias.shape[2] > 1
-    block_q, block_k = _resolve_blocks(lq, block_q, block_k, full_bias)
+    block_q, block_k = _resolve_blocks(block_q, block_k, full_bias,
+                                       dropout=dropout_p > 0.0)
     return _flash_core(q, k, v, bias, q_segment_ids, kv_segment_ids, seed,
                        causal, scale, float(dropout_p), block_q, block_k)

@@ -491,7 +491,7 @@ class GraphFunction:
                 state=new_state.get(layer.name),
                 training=training, rng=lrng,
             )
-            if s is not None:
+            if s:  # {} stays omitted, mirroring init's `if s:` filter
                 new_state[layer.name] = s
             outs = out if isinstance(out, (list, tuple)) else [out]
             for v, o in zip(node.outputs, outs):

@@ -43,6 +43,18 @@ from analytics_zoo_tpu.pipeline.api.keras.engine import (
 )
 
 
+def _copy_tree(tree):
+    """Fresh device buffers for every leaf (donation-safe adoption)."""
+    return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree)
+
+
+def _normalize_names(names) -> tuple:
+    """Accept both freeze("a", "b") and freeze(["a", "b"])."""
+    if len(names) == 1 and isinstance(names[0], (list, tuple)):
+        return tuple(names[0])
+    return tuple(names)
+
+
 class KerasNet(_ContainerBase):
     """Base for trainable containers (reference KerasNet,
     Topology.scala:63-600)."""
@@ -57,6 +69,7 @@ class KerasNet(_ContainerBase):
         self._grad_clip = None    # ("l2norm", v) | ("const", lo, hi)
         self._estimator = None
         self._predict_fn = None   # cached jitted forward (shape-keyed by jit)
+        self._frozen: set = set()  # layer names excluded from training
 
     # ------------------------------------------------------------------
     # parameter materialization
@@ -65,6 +78,8 @@ class KerasNet(_ContainerBase):
         """Materialize params/state pytrees (idempotent)."""
         if self.params is not None and not force:
             return self.params, self.state
+        if force:
+            self.params = self.state = None
         rng = rng if rng is not None else jax.random.PRNGKey(
             get_zoo_context().seed
         )
@@ -150,7 +165,21 @@ class KerasNet(_ContainerBase):
             train_set, batch_size=batch_size, nb_epoch=nb_epoch,
             validation_set=val_set,
         )
+        self._sync_nested()
         return self
+
+    def _sync_nested(self):
+        """Copy trained subtrees back into nested KerasNet layers
+        (pretrained backbones) so backbone.predict sees post-fit weights.
+        Copies, not aliases: the nested net may later be fit() directly,
+        and its donated buffers must not be this model's live params."""
+        for ly in self.layers:
+            if isinstance(ly, KerasNet):
+                if self.params is not None and ly.name in self.params:
+                    ly.params = _copy_tree(self.params[ly.name])
+                if self.state is not None and ly.name in self.state:
+                    ly.state = _copy_tree(self.state[ly.name])
+                ly._sync_nested()
 
     def evaluate(self, x, y=None, batch_size=32):
         """Reference ``evaluate`` Topology.scala:472-501; returns a dict of
@@ -213,6 +242,50 @@ class KerasNet(_ContainerBase):
         return cls if zero_based_label else cls + 1
 
     # ------------------------------------------------------------------
+    # transfer learning: freeze / unfreeze
+    # (reference NetUtils.scala freeze/unFreeze + the dogs-vs-cats app's
+    # freeze_up_to recipe; here frozen layers get their optimizer updates
+    # masked to zero inside the jitted train step — no graph surgery)
+    # ------------------------------------------------------------------
+    def _validate_layer_names(self, names):
+        avail = {ly.name for ly in self.layers}
+        unknown = [n for n in names if n not in avail]
+        if unknown:
+            raise ValueError(
+                f"unknown layer(s) {unknown}; available: {sorted(avail)}"
+            )
+
+    def freeze(self, *names) -> "KerasNet":
+        """Mark the named layers (all layers if none given) non-trainable.
+
+        Reference ``Net.freeze`` (NetUtils.scala): frozen layers keep their
+        weights through ``fit``.  Takes effect on the next fit().
+        """
+        names = _normalize_names(names)
+        if not names:
+            names = tuple(ly.name for ly in self.layers)
+        self._validate_layer_names(names)
+        self._frozen.update(names)
+        self._estimator = None  # train step must be rebuilt with the mask
+        return self
+
+    def unfreeze(self, *names) -> "KerasNet":
+        """Reference ``Net.unFreeze``: re-enable training for the named
+        layers (all if none given)."""
+        names = _normalize_names(names)
+        if not names:
+            self._frozen.clear()
+        else:
+            self._validate_layer_names(names)
+            self._frozen.difference_update(names)
+        self._estimator = None
+        return self
+
+    @property
+    def frozen_layers(self) -> list[str]:
+        return sorted(self._frozen)
+
+    # ------------------------------------------------------------------
     # weights / persistence
     # ------------------------------------------------------------------
     def get_weights(self):
@@ -242,29 +315,43 @@ class KerasNet(_ContainerBase):
             treedef, [jnp.asarray(a) for a in flat]
         )
 
+    def _nets(self) -> list["KerasNet"]:
+        """Self plus every nested KerasNet, recursively."""
+        nets, stack = [self], list(self.layers)
+        while stack:
+            ly = stack.pop()
+            if isinstance(ly, KerasNet):
+                nets.append(ly)
+                stack.extend(ly.layers)
+        return nets
+
     def save(self, path, over_write=True):
         """Whole-model save (reference ZooModel.saveModel /
-        KerasNet.saveModule): config + weights in one pickle."""
+        KerasNet.saveModule): config + weights in one pickle.  Device
+        arrays and runtime state are stripped from EVERY net in the tree
+        (nested backbones carry their own param copies after
+        ``_sync_nested``; leaving them in would pickle each backbone's
+        weights twice)."""
         if os.path.exists(path) and not over_write:
             raise IOError(f"{path} exists and over_write=False")
-        est, self._estimator = self._estimator, None
-        compiled, self._compiled = self._compiled, None
-        pfn, self._predict_fn = getattr(self, "_predict_fn", None), None
+        weights = (
+            jax.tree_util.tree_map(np.asarray, (self.params, self.state))
+            if self.params is not None else None
+        )
+        stashed = []
+        for net in self._nets():
+            stashed.append((net, net.params, net.state, net._estimator,
+                            net._compiled, getattr(net, "_predict_fn", None)))
+            net.params = net.state = None
+            net._estimator = net._compiled = net._predict_fn = None
         try:
-            weights = (
-                jax.tree_util.tree_map(np.asarray, (self.params, self.state))
-                if self.params is not None else None
-            )
-            params, state = self.params, self.state
-            self.params = self.state = None
-            try:
-                with open(path, "wb") as f:
-                    pickle.dump({"net": self, "weights": weights}, f)
-            finally:
-                self.params, self.state = params, state
+            with open(path, "wb") as f:
+                pickle.dump({"net": self, "weights": weights}, f)
         finally:
-            self._estimator, self._compiled = est, compiled
-            self._predict_fn = pfn
+            for net, params, state, est, compiled, pfn in stashed:
+                net.params, net.state = params, state
+                net._estimator, net._compiled = est, compiled
+                net._predict_fn = pfn
 
     @staticmethod
     def load(path) -> "KerasNet":
@@ -275,6 +362,7 @@ class KerasNet(_ContainerBase):
             net.params, net.state = jax.tree_util.tree_map(
                 jnp.asarray, blob["weights"]
             )
+            net._sync_nested()  # repopulate nested backbones' copies
         return net
 
     # ------------------------------------------------------------------
@@ -342,7 +430,23 @@ class Sequential(KerasNet):
         self._output_shape = tuple(out_full[1:])
         self._layers.append(layer)
         canonicalize_names(self._layers)
-        self.params = None  # invalidate materialized params
+        if self.params is not None:
+            # Weights already materialized (a new_graph'd pretrained stack
+            # being extended with a fresh head): keep them and init only
+            # the new layer — nulling params here would silently retrain
+            # the "pretrained" backbone from scratch.
+            if not isinstance(layer, InputLayer):
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(get_zoo_context().seed),
+                    len(self._layers) - 1)
+                p = layer.init_params(rng)  # KerasNet adopts its copy here
+                if p:
+                    self.params[layer.name] = p
+                s = layer.init_state()
+                if s:
+                    if self.state is None:
+                        self.state = {}
+                    self.state[layer.name] = s
         self._predict_fn = None  # a cached jitted forward is stale now
         return self
 
@@ -363,6 +467,14 @@ class Sequential(KerasNet):
         return (None,) + tuple(first._build_shape or ())
 
     def init_params(self, rng):
+        # A nested KerasNet that already materialized weights (a pretrained
+        # backbone from new_graph / load) contributes a COPY of those
+        # weights — the transfer-learning contract.  A copy, because the
+        # outer model's train step donates its param buffers to XLA; shared
+        # arrays would leave the backbone holding deleted buffers after the
+        # first step.
+        if self.params is not None:
+            return _copy_tree(self.params)
         params = {}
         for i, layer in enumerate(self._layers):
             if isinstance(layer, InputLayer):
@@ -373,6 +485,8 @@ class Sequential(KerasNet):
         return params
 
     def init_state(self):
+        if self.state is not None:
+            return _copy_tree(self.state)
         state = {}
         for layer in self._layers:
             s = layer.init_state()
@@ -391,7 +505,8 @@ class Sequential(KerasNet):
                 state=new_state.get(layer.name),
                 training=training, rng=lrng,
             )
-            if s is not None:
+            if s:  # {} stays omitted — must mirror init_state's filter or
+                # a nested stateless KerasNet changes the state treedef
                 new_state[layer.name] = s
         return y, new_state
 
@@ -403,6 +518,57 @@ class Sequential(KerasNet):
         self.built = True
         self._build_shape = input_shape
         return input_shape
+
+    # ------------------------------------------------------------------
+    # transfer learning (reference dogs-vs-cats app recipe:
+    # Net.load(...).new_graph(out).freeze_up_to(layer))
+    # ------------------------------------------------------------------
+    def freeze_up_to(self, *names) -> "Sequential":
+        """Freeze every layer from the input up to and including the named
+        layer(s) (reference ``freezeUpTo``, NetUtils.scala)."""
+        names = _normalize_names(names)
+        if not names:
+            raise ValueError("freeze_up_to requires at least one layer "
+                             "name (use freeze() to freeze everything)")
+        self._validate_layer_names(names)
+        idx = {ly.name: i for i, ly in enumerate(self._layers)}
+        cut = max(idx[n] for n in names)
+        return self.freeze(*[ly.name for ly in self._layers[:cut + 1]])
+
+    def new_graph(self, outputs) -> "Sequential":
+        """Truncate at the named layer: a new Sequential ending there,
+        SHARING layer objects and (if materialized) their weights — the
+        reference's ``new_graph(output)`` feature-extraction surgery
+        (NetUtils.scala newGraph)."""
+        names = [outputs] if isinstance(outputs, str) else list(outputs)
+        if len(names) != 1:
+            raise ValueError("Sequential.new_graph takes exactly one output"
+                             " layer name")
+        self._validate_layer_names(names)
+        idx = {ly.name: i for i, ly in enumerate(self._layers)}
+        cut = idx[names[0]]
+        sub = Sequential(name=f"{self.name}_graph")
+        sub._layers = list(self._layers[:cut + 1])
+        for ly in sub._layers:   # pin: a later sub.add() must not renumber
+            ly._auto_named = False
+        last = self._layers[cut]
+        sub._output_shape = tuple(
+            last.compute_output_shape(
+                (None,) + tuple(last._build_shape or ())
+            )[1:]
+        )
+        sub.built = True
+        sub._build_shape = (self._layers[0]._build_shape
+                            if self._layers else None)
+        if self.params is not None:
+            # Copies: either model may later fit() (donating its buffers);
+            # shared arrays would leave the other holding deleted buffers.
+            keep = {ly.name for ly in sub._layers}
+            sub.params = _copy_tree(
+                {k: v for k, v in self.params.items() if k in keep})
+            sub.state = _copy_tree(
+                {k: v for k, v in (self.state or {}).items() if k in keep})
+        return sub
 
 
 class Model(KerasNet):
@@ -441,10 +607,14 @@ class Model(KerasNet):
         return shapes[0] if len(shapes) == 1 else shapes
 
     def init_params(self, rng):
+        if self.params is not None:   # pretrained: adopt a copy (donation
+            return _copy_tree(self.params)   # safety — see Sequential)
         params, _ = self._graph.init(rng)
         return params
 
     def init_state(self):
+        if self.state is not None:
+            return _copy_tree(self.state)
         _, state = self._graph.init(jax.random.PRNGKey(0))
         return state
 
@@ -455,6 +625,55 @@ class Model(KerasNet):
     def compute_output_shape(self, input_shape):
         shapes = [v.shape for v in self._graph.outputs]
         return shapes[0] if len(shapes) == 1 else shapes
+
+    # ------------------------------------------------------------------
+    # transfer learning (reference NetUtils.scala newGraph/freezeUpTo on
+    # the static graph)
+    # ------------------------------------------------------------------
+    def freeze_up_to(self, *names) -> "Model":
+        """Freeze the named layers and every graph ancestor of them."""
+        names = _normalize_names(names)
+        if not names:
+            raise ValueError("freeze_up_to requires at least one layer "
+                             "name (use freeze() to freeze everything)")
+        self._validate_layer_names(names)
+        stack = [n for n in self._graph.nodes if n.layer.name in set(names)]
+        seen, frozen = set(), set()
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if not isinstance(node.layer, InputLayer):
+                frozen.add(node.layer.name)
+            for v in node.inbound:
+                stack.append(v.node)
+        return self.freeze(*sorted(frozen))
+
+    def new_graph(self, outputs) -> "Model":
+        """A new Model over the same graph, re-rooted at the named layers'
+        outputs; weights (if materialized) are shared for retained layers."""
+        names = [outputs] if isinstance(outputs, str) else list(outputs)
+        self._validate_layer_names(names)
+        by_name: dict[str, Any] = {}
+        for node in self._graph.nodes:
+            by_name.setdefault(node.layer.name, node)
+        out_vars = [by_name[n].outputs[0] for n in names]
+        # Names were canonicalized when THIS model was built; pin them so
+        # the sub-model's canonicalize_names pass can't renumber shared
+        # layers (which would corrupt both models' param keys).
+        for ly in self.layers:
+            ly._auto_named = False
+        sub = Model(input=self._graph.inputs, output=out_vars,
+                    name=f"{self.name}_graph")
+        if self.params is not None:
+            # Copies — donation safety, see Sequential.new_graph.
+            keep = {ly.name for ly in sub.layers}
+            sub.params = _copy_tree(
+                {k: v for k, v in self.params.items() if k in keep})
+            sub.state = _copy_tree(
+                {k: v for k, v in (self.state or {}).items() if k in keep})
+        return sub
 
 
 def merge(inputs, mode="sum", concat_axis=-1, name=None):

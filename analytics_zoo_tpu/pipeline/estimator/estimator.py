@@ -327,6 +327,17 @@ class Estimator:
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
         compute_dtype = self.ctx.compute_dtype
+        # Transfer learning (KerasNet.freeze/freeze_up_to): frozen layers'
+        # grads AND optimizer updates are masked to zero — updates too, so
+        # decoupled weight decay (adamw) cannot drift frozen weights.
+        frozen = frozenset(getattr(model, "_frozen", ()) or ())
+
+        def _mask_frozen(tree):
+            return {
+                k: (jax.tree_util.tree_map(jnp.zeros_like, v)
+                    if k in frozen else v)
+                for k, v in tree.items()
+            }
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, state, seed, step, batch):
@@ -363,8 +374,12 @@ class Estimator:
             # replicated, XLA partitions this program SPMD and inserts the
             # gradient all-reduce (reduce-scatter + all-gather over ICI) —
             # the role of BigDL's AllReduceParameter (Topology.scala:1119).
+            if frozen:
+                grads = _mask_frozen(grads)
             grads = _clip_grads(grads, grad_clip)
             updates, opt_state = opt.update(grads, opt_state, params)
+            if frozen:
+                updates = _mask_frozen(updates)
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, l
 

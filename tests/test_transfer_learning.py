@@ -1,0 +1,305 @@
+"""Transfer-learning API: freeze / unfreeze / freeze_up_to / new_graph.
+
+Reference surface: NetUtils.scala (freeze/unFreeze/freezeUpTo/newGraph)
+as used by the dogs-vs-cats app
+(/root/reference/apps/dogs-vs-cats/transfer-learning.ipynb): truncate a
+pretrained net at a feature layer, freeze the backbone, train a new head.
+Here frozen layers are masked out of the optimizer update inside the
+jitted SPMD train step.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+def _data(n=128, dim=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 2.0, size=(classes, dim))
+    y = rng.integers(classes, size=n)
+    x = (centers[y] + rng.normal(0, 0.3, (n, dim))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_zoo_context("transfer-learning-test", seed=0)
+
+
+def _leaves(tree):
+    import jax
+    return [np.asarray(a) for a in jax.tree_util.tree_leaves(tree)]
+
+
+def test_freeze_masks_updates_sequential():
+    x, y = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,), name="backbone"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.build_params()
+    before_backbone = _leaves(m.params["backbone"])
+    before_head = _leaves(m.params["head"])
+
+    m.freeze("backbone")
+    assert m.frozen_layers == ["backbone"]
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=3)
+
+    after_backbone = _leaves(m.params["backbone"])
+    after_head = _leaves(m.params["head"])
+    for a, b in zip(before_backbone, after_backbone):
+        np.testing.assert_array_equal(a, b)
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(before_head, after_head))
+
+
+def test_unfreeze_restores_training():
+    x, y = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,), name="backbone"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.build_params()
+    m.freeze("backbone")
+    m.unfreeze()
+    assert m.frozen_layers == []
+    before = _leaves(m.params["backbone"])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _leaves(m.params["backbone"])
+    assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+
+
+def test_freeze_adamw_weight_decay_does_not_drift():
+    # updates (not just grads) are masked: decoupled weight decay must not
+    # move frozen weights either.
+    x, y = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,), name="backbone"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.build_params()
+    before = _leaves(m.params["backbone"])
+    m.freeze("backbone")
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        AdamWeightDecay,
+    )
+
+    m.compile(optimizer=AdamWeightDecay(lr=1e-2, weight_decay=0.1),
+              loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    for a, b in zip(before, _leaves(m.params["backbone"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_freeze_up_to_sequential():
+    m = Sequential()
+    m.add(Dense(16, input_shape=(8,), name="f0"))
+    m.add(Dense(16, name="f1"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.freeze_up_to("f1")
+    assert m.frozen_layers == ["f0", "f1"]
+
+
+def test_freeze_unknown_layer_raises():
+    m = Sequential()
+    m.add(Dense(4, input_shape=(8,)))
+    with pytest.raises(ValueError, match="unknown layer"):
+        m.freeze("nope")
+
+
+def test_sequential_new_graph_shares_weights():
+    x, _ = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,), name="feat"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.build_params()
+    feats_model = m.new_graph("feat")
+    assert [ly.name for ly in feats_model.layers] == ["feat"]
+    out = feats_model.predict(x, batch_size=64)
+    assert out.shape == (128, 16)
+    # weights are shared (same arrays), not re-initialized
+    for a, b in zip(_leaves(m.params["feat"]),
+                    _leaves(feats_model.params["feat"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_model_new_graph_and_freeze_up_to():
+    x, y = _data()
+    inp = Input(shape=(8,))
+    h1 = Dense(16, activation="relu", name="enc1")(inp)
+    h2 = Dense(8, activation="relu", name="enc2")(h1)
+    out = Dense(3, activation="softmax", name="cls")(h2)
+    m = Model(inp, out)
+    m.build_params()
+
+    # re-root at enc2: ancestors only, shared weights
+    feat = m.new_graph("enc2")
+    names = {ly.name for ly in feat.layers}
+    assert "enc2" in names and "cls" not in names
+    emb = feat.predict(x, batch_size=64)
+    assert emb.shape == (128, 8)
+    for a, b in zip(_leaves(m.params["enc1"]),
+                    _leaves(feat.params["enc1"])):
+        np.testing.assert_array_equal(a, b)
+    # parent model is untouched by the surgery
+    probs = m.predict(x, batch_size=64)
+    assert probs.shape == (128, 3)
+
+    # freeze_up_to enc2 freezes enc1+enc2 but not the classifier
+    m.freeze_up_to("enc2")
+    assert m.frozen_layers == ["enc1", "enc2"]
+    before_enc = _leaves({k: m.params[k] for k in ("enc1", "enc2")})
+    before_cls = _leaves(m.params["cls"])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=3)
+    for a, b in zip(before_enc,
+                    _leaves({k: m.params[k] for k in ("enc1", "enc2")})):
+        np.testing.assert_array_equal(a, b)
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(before_cls, _leaves(m.params["cls"])))
+
+
+def test_new_graph_fit_does_not_delete_parent_buffers():
+    """new_graph copies weights: fine-tuning the sub-model (whose train
+    step DONATES its param buffers) must leave the parent usable."""
+    x, y = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,), name="feat"))
+    m.add(Dense(3, activation="softmax", name="head"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    sub = m.new_graph("feat")
+    sub.compile(optimizer="adam", loss="mse")
+    emb_target = np.zeros((len(x), 16), np.float32)
+    sub.fit(x, emb_target, batch_size=32, nb_epoch=1)  # donates sub buffers
+    out = m.predict(x, batch_size=64)   # parent must still be alive
+    assert out.shape == (128, 3)
+
+
+def test_nested_backbone_direct_fit_after_outer_fit():
+    """_sync_nested hands the backbone COPIES; fitting the backbone
+    directly afterwards must not delete the outer model's params."""
+    x, y = _data()
+    base = Sequential()
+    base.add(Dense(16, activation="relu", input_shape=(8,), name="b0"))
+    base.add(Dense(3, activation="softmax", name="h0"))
+    base.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    base.fit(x, y, batch_size=32, nb_epoch=1)
+    feat = base.new_graph("b0")
+    outer = Sequential()
+    outer.add(feat)
+    outer.add(Dense(3, activation="softmax", name="h1"))
+    outer.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    outer.fit(x, y, batch_size=32, nb_epoch=1)
+    # backbone sees post-fit weights and can itself be trained
+    feat.compile(optimizer="adam", loss="mse")
+    feat.fit(x, np.zeros((len(x), 16), np.float32), batch_size=32,
+             nb_epoch=1)
+    out = outer.predict(x, batch_size=64)
+    assert out.shape == (128, 3)
+
+
+def test_new_graph_then_add_keeps_pretrained_weights():
+    """Extending a truncated pretrained stack with add() must keep the
+    backbone weights instead of silently re-initializing them."""
+    x, y = _data()
+    base = Sequential()
+    base.add(Dense(16, activation="relu", input_shape=(8,), name="b0"))
+    base.add(Dense(3, activation="softmax", name="h0"))
+    base.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    base.fit(x, y, batch_size=32, nb_epoch=2)
+    trained_b0 = _leaves(base.params["b0"])
+
+    sub = base.new_graph("b0")
+    sub.add(Dense(3, activation="softmax", name="new_head"))
+    sub.build_params()
+    for a, b in zip(trained_b0, _leaves(sub.params["b0"])):
+        np.testing.assert_array_equal(a, b)
+    assert "new_head" in sub.params
+    probs = sub.predict(x, batch_size=64)
+    assert probs.shape == (128, 3)
+
+
+def test_freeze_up_to_no_args_raises():
+    m = Sequential()
+    m.add(Dense(4, input_shape=(8,)))
+    with pytest.raises(ValueError, match="at least one layer"):
+        m.freeze_up_to()
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    inp = Input(shape=(8,))
+    gm = Model(inp, Dense(4)(inp))
+    with pytest.raises(ValueError, match="at least one layer"):
+        gm.freeze_up_to()
+
+
+def test_save_load_with_nested_backbone(tmp_path):
+    """save() strips nested device arrays (no double-pickled weights);
+    load() restores both the outer tree and the nested backbone copies."""
+    from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+    x, y = _data()
+    base = Sequential()
+    base.add(Dense(16, activation="relu", input_shape=(8,), name="b0"))
+    base.add(Dense(3, activation="softmax", name="h0"))
+    base.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    base.fit(x, y, batch_size=32, nb_epoch=1)
+    feat = base.new_graph("b0")
+    outer = Sequential()
+    outer.add(feat)
+    outer.add(Dense(3, activation="softmax", name="h1"))
+    outer.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    outer.fit(x, y, batch_size=32, nb_epoch=1)
+    ref = outer.predict(x, batch_size=64)
+
+    solo = tmp_path / "solo.zoo"
+    nested = tmp_path / "nested.zoo"
+    feat.save(str(solo))
+    outer.save(str(nested))
+    # the nested file holds feat's weights once (inside the outer tree),
+    # so it must not be ~2x the backbone-only file heavier than the head
+    # warrants; a loose structural check: stripped nets pickle no jax
+    # arrays, so nested < solo + 64KB of head/config
+    assert nested.stat().st_size < solo.stat().st_size + 65536
+    # save() must restore live state afterwards
+    assert outer.params is not None and feat.params is not None
+
+    loaded = KerasNet.load(str(nested))
+    np.testing.assert_allclose(loaded.predict(x, batch_size=64), ref,
+                               rtol=1e-6, atol=1e-6)
+    inner = [ly for ly in loaded.layers if isinstance(ly, KerasNet)][0]
+    emb = inner.predict(x[:16], batch_size=16)   # nested copies restored
+    assert emb.shape == (16, 16)
+
+
+def test_transfer_learning_end_to_end():
+    """The dogs-vs-cats recipe: pretrain, truncate, freeze, retrain head."""
+    xs, ys = _data(n=512, classes=4, seed=1)   # "source" task
+    # target task: distinguish source classes {0,1} — the dogs-vs-cats
+    # setup (subset of the pretraining domain), so frozen features transfer
+    keep = ys < 2
+    xt, yt = xs[keep][:256], ys[keep][:256]
+    base = Sequential()
+    base.add(Dense(32, activation="relu", input_shape=(8,), name="b0"))
+    base.add(Dense(16, activation="relu", name="b1"))
+    base.add(Dense(4, activation="softmax", name="src_head"))
+    base.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    base.fit(xs, ys, batch_size=32, nb_epoch=5)
+
+    feat = base.new_graph("b1")
+    model = Sequential()
+    model.add(feat)
+    model.add(Dense(2, activation="softmax", name="tgt_head"))
+    model.freeze(feat.name)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    frozen_before = _leaves(base.params["b0"])
+    model.fit(xt, yt, batch_size=32, nb_epoch=25)
+    acc = model.evaluate(xt, yt, batch_size=64)["accuracy"]
+    assert acc > 0.8
+    for a, b in zip(frozen_before, _leaves(model.params[feat.name]["b0"])):
+        np.testing.assert_array_equal(a, b)

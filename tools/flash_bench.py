@@ -79,7 +79,6 @@ def main():
             [[0] * (L // 2) + [1] * (L - L // 2)], B, 0).astype(np.int32))
         seed = jnp.asarray([3, 11], jnp.int32)
 
-        bq, bk = _resolve_blocks(L, None, None)
         variants = {
             "clean": dict(),
             "causal": dict(causal=True),
@@ -91,6 +90,10 @@ def main():
 
         def make_flash(kw):
             causal = kw.get("causal", False)
+            # blocks resolved per-variant: dropout narrows block_k to fit
+            # the PRNG-bits tile in scoped VMEM
+            bq, bk = _resolve_blocks(None, None,
+                                     dropout=kw.get("dropout_p", 0) > 0)
             return lambda q, k, v: _flash_fwd_pallas(
                 q, k, v, causal, scale, bq, bk,
                 bias=kw.get("bias"), q_seg=kw.get("q_seg"),
@@ -98,7 +101,8 @@ def main():
                 seed=kw.get("seed"))
 
         row = {"seq_len": L, "batch": B, "heads": H, "head_dim": D,
-               "block_q": bq, "block_k": bk}
+               "blocks_clean": _resolve_blocks(None, None),
+               "blocks_dropout": _resolve_blocks(None, None, dropout=True)}
         flops = 4 * B * H * L * L * D  # 2 matmuls, 2*L*L*D each
         for name, kw in variants.items():
             t = timed(make_flash(kw), q, k, v)
