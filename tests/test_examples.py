@@ -284,6 +284,40 @@ def test_recommendation_ncf_notebook_runs():
     assert ns["test_acc"] > 0.75 and ns["hit"] >= 0.6
 
 
+def test_dogs_vs_cats_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/dogs_vs_cats.ipynb"))
+    assert ns["done"] and ns["acc"] > 0.9 and ns["src_acc"] > 0.9
+
+
+def test_object_detection_notebook_runs():
+    ns = _run_notebook(os.path.join(REPO, "apps/object_detection.ipynb"))
+    assert ns["done"] and ns["n_boxes"] > 0
+
+
+def test_anomaly_detection_hd_notebook_runs():
+    ns = _run_notebook(
+        os.path.join(REPO, "apps/anomaly_detection_hd.ipynb"))
+    assert ns["done"] and ns["auc"] > 0.9
+
+
+def test_pytorch_face_generation_notebook_runs():
+    ns = _run_notebook(
+        os.path.join(REPO, "apps/pytorch_face_generation.ipynb"))
+    assert ns["done"] and ns["faces"].shape == (40, 3, 16, 16)
+
+
+def test_tfnet_image_classification_notebook_runs():
+    ns = _run_notebook(
+        os.path.join(REPO, "apps/tfnet_image_classification.ipynb"))
+    assert ns["done"] and len(ns["top5"]) == 24
+
+
+def test_ray_parameter_server_notebook_runs():
+    ns = _run_notebook(
+        os.path.join(REPO, "apps/ray_parameter_server.ipynb"))
+    assert ns["done"] and ns["acc"] > 0.85
+
+
 def test_pytorch_predict_example():
     from examples.pytorch.predict import run
 

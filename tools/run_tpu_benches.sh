@@ -1,7 +1,18 @@
 #!/bin/bash
 # Round-4 TPU bench queue: waits for the axon tunnel to answer, then runs
 # every TPU-dependent artifact producer sequentially (ONE process on the
-# chip at a time — concurrent clients wedge the tunnel).
+# chip at a time — concurrent clients wedge the tunnel; a client killed
+# mid-compile wedges it for hours).
+#
+# Lessons encoded here:
+# - serialize chip access; never run an ad-hoc python on the chip while
+#   this queue runs (JAX_PLATFORMS env alone does NOT keep a script off
+#   the axon plugin — only jax.config.update("jax_platforms", "cpu")).
+# - bench.py's e2e path needs the HOST core for infeed generation: do not
+#   run the pytest suite concurrently or e2e crawls ~10x (measured
+#   2026-07-30: 50 min vs ~4 min idle).
+# - serving on the tunneled chip sustains ~143 rps at batch 16; offer 100
+#   for a stable-queue latency artifact (200 measures saturation only).
 # Usage: bash tools/run_tpu_benches.sh [logdir]
 set -u
 cd "$(dirname "$0")/.."
@@ -20,28 +31,25 @@ done
 echo "$(date) TPU is back — running queue" | tee -a "$LOG/queue.log"
 
 run() {
-  name=$1; shift
+  name=$1; tmo=$2; shift 2
   echo "$(date) START $name" | tee -a "$LOG/queue.log"
-  timeout 3000 "$@" >"$LOG/$name.log" 2>&1
+  timeout "$tmo" "$@" >"$LOG/$name.log" 2>&1
   rc=$?  # capture BEFORE $(date) resets $?
   echo "$(date) DONE $name rc=$rc" | tee -a "$LOG/queue.log"
 }
 
 # 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
-run flash python tools/flash_bench.py
+run flash 3000 python tools/flash_bench.py
 
 # 2. transformer at the honest config -> TRANSFORMER_r04.json
-run transformer python tools/transformer_bench.py \
+run transformer 3600 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
   --remat --out TRANSFORMER_r04.json
 
-# 3. serving latency on the real chip -> SERVING_r04.json
-run serving python tools/serving_bench.py --rate 200 --n 2000
+# 3. serving latency on the real chip at a sustainable offered load
+run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
 
-# 4. pure-step probe (the Task-4 number)
-run perf python tools/perf_probe.py --batch 256 --steps 20
-
-# 5. headline bench line
-run bench python bench.py
+# 4. headline bench line (host-infeed heavy: keep the core free)
+run bench 4800 python bench.py
 
 echo "$(date) queue complete" | tee -a "$LOG/queue.log"
