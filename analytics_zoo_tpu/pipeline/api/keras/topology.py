@@ -325,6 +325,23 @@ class KerasNet(_ContainerBase):
                 stack.extend(ly.layers)
         return nets
 
+    def load_checkpoint(self, path) -> "KerasNet":
+        """Restore weights/state from the LATEST training checkpoint in
+        ``path`` (as written by ``set_checkpoint`` during fit) without
+        training — the reference's evaluate-from-checkpoint flow
+        (tf_optimizer/evaluate_lenet.py; Net.load for .bigdl snapshots)."""
+        from analytics_zoo_tpu.pipeline.estimator.estimator import (
+            _Checkpointer,
+        )
+
+        blob = _Checkpointer(path).latest()
+        if blob is None:
+            raise FileNotFoundError(f"no checkpoint found under {path}")
+        self.params = jax.tree_util.tree_map(jnp.asarray, blob["params"])
+        self.state = jax.tree_util.tree_map(jnp.asarray, blob["state"])
+        self._sync_nested()
+        return self
+
     def save(self, path, over_write=True):
         """Whole-model save (reference ZooModel.saveModel /
         KerasNet.saveModule): config + weights in one pickle.  Device

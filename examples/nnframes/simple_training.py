@@ -1,0 +1,73 @@
+"""Minimal DataFrame-native training (reference
+pyzoo/zoo/examples/nnframes/tensorflow/SimpleTraining.py: an NNEstimator
+over a two-column Spark DataFrame with a TF model; pandas is the
+DataFrame substrate here, the model is zoo keras layers).
+
+The smallest end-to-end nnframes flow: DataFrame in → NNEstimator.fit →
+NNModel.transform adds the prediction column.
+
+Usage: python examples/nnframes/simple_training.py [--epochs 20]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def make_df(n=384, seed=0):
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for _ in range(n):
+        v = rng.uniform(-1, 1, size=2).astype(np.float32)
+        xs.append(v)
+        ys.append(int(v[0] * v[1] > 0))   # XOR-quadrant: needs the hidden
+    return pd.DataFrame({"features": xs, "label": ys})
+
+
+def run(epochs=40, batch_size=64):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+
+    init_zoo_context("nnframes simple training", seed=0)
+    df = make_df()
+    train_df, val_df = df[:320], df[320:]
+
+    net = Sequential()
+    net.add(Dense(16, activation="relu", input_shape=(2,)))
+    net.add(Dense(2, activation="softmax"))
+
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    clf = (NNClassifier(net)
+           .set_optim_method(Adam(lr=0.01))
+           .set_batch_size(batch_size)
+           .set_max_epoch(epochs)
+           .set_features_col("features")
+           .set_label_col("label"))
+    model = clf.fit(train_df)
+
+    out = model.transform(val_df)
+    acc = float((out["prediction"].to_numpy()
+                 == val_df["label"].to_numpy()).mean())
+    print("held-out accuracy:", round(acc, 3))
+    print(out.head(3))
+    return acc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=40)
+    a = ap.parse_args()
+    acc = run(epochs=a.epochs)
+    assert acc > 0.85, acc
+
+
+if __name__ == "__main__":
+    main()

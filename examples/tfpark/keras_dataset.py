@@ -1,0 +1,76 @@
+"""tfpark KerasModel trained from a DATASET (reference
+pyzoo/zoo/examples/tensorflow/tfpark/keras/keras_dataset.py: mnist via
+TFDataset.from_rdd feeding a tf.keras model; its sibling
+keras_ndarray.py feeds ndarrays — see examples/tfpark/keras_ndarray.py).
+
+Here the dataset role is played by :class:`FeatureSet` — the framework's
+TFDataset equivalent — streaming batches (with exact-resume iterator
+state) into the jit-compiled train step.
+
+Usage: python examples/tfpark/keras_dataset.py [--epochs 12]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def digits_data():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images[..., None] / 16.0).astype(np.float32)  # (N, 8, 8, 1)
+    y = d.target.astype(np.int32)
+    n = (int(len(x) * 0.85) // 64) * 64
+    return (x[:n], y[:n]), (x[n:], y[n:])
+
+
+def run(epochs=12, batch_size=64):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.dataset import FeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten,
+    )
+    from analytics_zoo_tpu.tfpark import KerasModel
+
+    init_zoo_context("tfpark keras_dataset", seed=0)
+    (xt, yt), (xv, yv) = digits_data()
+    train_set = FeatureSet.of(xt, yt)   # the TFDataset.from_rdd role
+
+    net = Sequential()
+    net.add(Convolution2D(8, 3, 3, activation="relu",
+                          input_shape=(8, 8, 1)))
+    net.add(Flatten())
+    net.add(Dense(32, activation="relu"))
+    net.add(Dense(10, activation="softmax"))
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    net.compile(optimizer=Adam(lr=0.01),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+
+    km = KerasModel(net)
+    km.fit(train_set, batch_size=batch_size, epochs=epochs)
+    metrics = km.evaluate(xv, yv, batch_size=batch_size)
+    preds = km.predict(xv[:16], batch_size=16)
+    print("val metrics:", {k: round(float(v), 4) for k, v in
+                           metrics.items()})
+    print("pred shape:", np.asarray(preds).shape)
+    return metrics
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=12)
+    a = ap.parse_args()
+    m = run(epochs=a.epochs)
+    assert m["accuracy"] > 0.9, m
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Train-then-evaluate LeNet with checkpointing (reference
+pyzoo/zoo/examples/tensorflow/tfpark/tf_optimizer/{train_lenet.py,
+evaluate_lenet.py}: TFOptimizer drives a tf graph, checkpoints to
+model_dir, and a separate evaluate run restores the checkpoint).
+
+Two phases, mirroring the reference's two scripts:
+  train:    fit LeNet on digits, checkpointing every epoch;
+  evaluate: a FRESH process/model restores the latest checkpoint via the
+            estimator resume path and evaluates without training.
+
+Usage: python examples/tfpark/tf_optimizer_lenet.py [--epochs 10]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def digits_data():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images[..., None] / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    n = (int(len(x) * 0.85) // 64) * 64
+    return (x[:n], y[:n]), (x[n:], y[n:])
+
+
+def build_lenet():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten, MaxPooling2D,
+    )
+
+    m = Sequential()
+    m.add(Convolution2D(6, 3, 3, activation="relu", border_mode="same",
+                        input_shape=(8, 8, 1)))
+    m.add(MaxPooling2D((2, 2)))
+    m.add(Convolution2D(16, 3, 3, activation="relu"))
+    m.add(Flatten())
+    m.add(Dense(32, activation="relu"))
+    m.add(Dense(10, activation="softmax"))
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    m.compile(optimizer=Adam(lr=0.01),
+              loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    return m
+
+
+def train(model_dir, epochs=10, batch_size=64):
+    """The train_lenet.py role: fit + checkpoint to model_dir."""
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context("tf_optimizer train_lenet", seed=0)
+    (xt, yt), _ = digits_data()
+    m = build_lenet()
+    m.set_checkpoint(model_dir)
+    m.fit(xt, yt, batch_size=batch_size, nb_epoch=epochs)
+    return m
+
+
+def evaluate(model_dir, batch_size=64):
+    """The evaluate_lenet.py role: fresh model, restore latest
+    checkpoint, evaluate — no training."""
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context("tf_optimizer evaluate_lenet", seed=0)
+    _, (xv, yv) = digits_data()
+    m = build_lenet()
+    m.load_checkpoint(model_dir)
+    metrics = m.evaluate(xv, yv, batch_size=batch_size)
+    print("restored-checkpoint val:",
+          {k: round(float(v), 4) for k, v in metrics.items()})
+    return metrics
+
+
+def run(epochs=10, model_dir=None):
+    model_dir = model_dir or tempfile.mkdtemp()
+    train(model_dir, epochs=epochs)
+    return evaluate(model_dir)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--model-dir", default=None)
+    a = ap.parse_args()
+    m = run(epochs=a.epochs, model_dir=a.model_dir)
+    assert m["accuracy"] > 0.9, m
+
+
+if __name__ == "__main__":
+    main()

@@ -333,3 +333,62 @@ def test_tfnet_predict_example():
 
     err, agree = run(n=16)
     assert err < 1e-4 and agree == 1.0
+
+
+def test_gan_eval_example_restores_checkpoint():
+    from examples.tfpark.gan_eval import run
+
+    mean, spread = run(train_steps=400)
+    assert mean > 1.2, mean   # generator moved toward the real mean (3.0)
+
+
+def test_tfpark_keras_dataset_example():
+    from examples.tfpark.keras_dataset import run
+
+    m = run(epochs=18)
+    assert m["accuracy"] > 0.9, m
+
+
+def test_tfpark_estimator_inception_example():
+    from examples.tfpark.estimator_inception import run
+
+    m = run(steps=120)
+    assert m["accuracy"] > 0.8, m
+
+
+def test_tf_optimizer_lenet_train_then_evaluate():
+    from examples.tfpark.tf_optimizer_lenet import run
+
+    m = run(epochs=16)
+    assert m["accuracy"] > 0.9, m
+
+
+def test_pytorch_train_lenet_example():
+    from examples.pytorch.train_lenet import run
+
+    m = run(epochs=25)
+    assert m["accuracy"] > 0.9, m
+
+
+def test_pytorch_simple_training_example():
+    from examples.pytorch.simple_training import run
+
+    assert run(epochs=25) < 0.05
+
+
+def test_nnframes_simple_training_example():
+    from examples.nnframes.simple_training import run
+
+    assert run(epochs=40) > 0.85
+
+
+def test_nnframes_transfer_learning_example():
+    from examples.nnframes.transfer_learning import run
+
+    assert run(epochs=15) > 0.85
+
+
+def test_openvino_predict_example():
+    from examples.openvino.predict import run
+
+    assert run(n=32) > 0.9
