@@ -151,11 +151,39 @@ def main():
         "semantics": "enqueue->result-available, open-loop offered load "
                      "(ClusterServing.scala:103-139 path)",
     }
+    if out["achieved_rps"] < 0.95 * a.rate:
+        out["note"] = ("SATURATED: offered load exceeds capacity, latency "
+                       "is queueing delay, not service time — see a "
+                       "stable-queue run for the latency number")
     print(json.dumps(out))
     path = a.out or os.path.join(os.path.dirname(__file__), "..",
                                  "SERVING_r04.json")
+    # Merge, don't clobber: the artifact keeps one run per
+    # (platform, offered_rate) and fronts the best STABLE-queue run, so a
+    # saturation probe can never replace the latency headline.
+    runs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            old = json.load(f)
+        runs = old.get("runs") or ([{k: v for k, v in old.items()
+                                     if k != "runs"}] if "p50" in old
+                                   else [])
+    runs = [r for r in runs
+            if (r.get("platform"), r.get("offered_rate_rps"))
+            != (out["platform"], out["offered_rate_rps"])]
+    runs.append(out)
+
+    def stable(r):
+        return r.get("achieved_rps", 0) >= 0.95 * r.get(
+            "offered_rate_rps", float("inf"))
+
+    primary = max([r for r in runs if stable(r)] or runs,
+                  key=lambda r: (r.get("platform") == "tpu",
+                                 r.get("offered_rate_rps", 0)))
+    doc = dict(primary)
+    doc["runs"] = runs
     with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+        json.dump(doc, f, indent=1)
 
 
 if __name__ == "__main__":
