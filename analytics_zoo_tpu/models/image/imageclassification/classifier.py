@@ -106,17 +106,43 @@ class ImageClassifier(ZooModel):
     def build_model(self):
         if self._provided is not None:
             return self._provided
-        if self.model_name.startswith("resnet"):
+        # The reference's "<model>-quantize"/"-int8" variants
+        # (ImageClassificationConfig.scala:31-50) are a deployment pass
+        # here: build the same graph, then
+        # InferenceModel.optimize("int8", ...) quantizes it.
+        name = self.model_name
+        for suffix in ("-quantize", "-int8"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+        shape = (self.config.crop, self.config.crop, 3)
+        if name.startswith("resnet"):
             from analytics_zoo_tpu.models.resnet import ResNet
 
-            depth = int(self.model_name.split("-")[1])
-            return ResNet.image_net(
-                depth, classes=self.classes,
-                input_shape=(self.config.crop, self.config.crop, 3))
-        if self.model_name == "lenet":
+            depth = int(name.split("-")[1])
+            return ResNet.image_net(depth, classes=self.classes,
+                                    input_shape=shape)
+        if name == "lenet":
             from analytics_zoo_tpu.models.lenet import build_lenet
 
             return build_lenet(classes=self.classes)
+        if name == "inception-v1":
+            from analytics_zoo_tpu.models.inception import Inception
+
+            return Inception.v1(classes=self.classes, input_shape=shape)
+        from analytics_zoo_tpu.models import imagenet_zoo as zoo_nets
+
+        factories = {
+            "alexnet": zoo_nets.alexnet,
+            "vgg-16": lambda **kw: zoo_nets.vgg(16, **kw),
+            "vgg-19": lambda **kw: zoo_nets.vgg(19, **kw),
+            "densenet-121": lambda **kw: zoo_nets.densenet(121, **kw),
+            "densenet-161": lambda **kw: zoo_nets.densenet(161, **kw),
+            "squeezenet": zoo_nets.squeezenet,
+            "mobilenet": zoo_nets.mobilenet,
+            "mobilenet-v2": zoo_nets.mobilenet_v2,
+        }
+        if name in factories:
+            return factories[name](classes=self.classes, input_shape=shape)
         raise ValueError(f"unknown model {self.model_name!r}")
 
     def predict_image_set(self, image_set: ImageSet, top_k: int = 5,

@@ -38,6 +38,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (  # noqa: F401
     Cropping2D,
     Cropping3D,
     Deconvolution2D,
+    DepthwiseConvolution2D,
     LocallyConnected1D,
     LocallyConnected2D,
     SeparableConvolution2D,
@@ -135,4 +136,5 @@ Conv1D = Convolution1D
 Conv2D = Convolution2D
 Conv3D = Convolution3D
 SeparableConv2D = SeparableConvolution2D
+DepthwiseConv2D = DepthwiseConvolution2D
 Conv2DTranspose = Deconvolution2D

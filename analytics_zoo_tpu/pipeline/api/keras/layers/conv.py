@@ -32,9 +32,15 @@ def _conv_out_dim(size, k, stride, mode, dilation=1):
     if size is None:
         return None
     eff = (k - 1) * dilation + 1
-    if mode == "same":
-        return (size + stride - 1) // stride
-    return (size - eff) // stride + 1
+    out = ((size + stride - 1) // stride if mode == "same"
+           else (size - eff) // stride + 1)
+    if out <= 0:
+        raise ValueError(
+            f"spatial dim collapses to {out}: input size {size} is too "
+            f"small for kernel {k} (stride {stride}, dilation {dilation}, "
+            f"border_mode={mode!r}) — use a larger input or 'same' padding"
+        )
+    return out
 
 
 _DIMNUMS = {1: ("NWC", "WIO", "NWC"),
@@ -155,6 +161,19 @@ class AtrousConvolution2D(Convolution2D):
                          **kwargs)
 
 
+def _depthwise_lower(inputs, kernel, subsample, border_mode):
+    """The grouped-conv lowering shared by DepthwiseConvolution2D and
+    SeparableConvolution2D: kernel layout (kh, kw, 1, in*dm),
+    feature_group_count = in_ch."""
+    return lax.conv_general_dilated(
+        inputs, kernel,
+        window_strides=subsample,
+        padding=border_mode.upper(),
+        dimension_numbers=_DIMNUMS[2],
+        feature_group_count=inputs.shape[-1],
+    )
+
+
 class SeparableConvolution2D(Layer):
     """Depthwise + pointwise conv (reference
     SeparableConvolution2D.scala), NHWC."""
@@ -187,14 +206,8 @@ class SeparableConvolution2D(Layer):
             self.add_weight("bias", (self.nb_filter,), "zero")
 
     def call(self, params, inputs, state=None, training=False, rng=None):
-        in_ch = inputs.shape[-1]
-        y = lax.conv_general_dilated(
-            inputs, params["depthwise_kernel"],
-            window_strides=self.subsample,
-            padding=self.border_mode.upper(),
-            dimension_numbers=_DIMNUMS[2],
-            feature_group_count=in_ch,
-        )
+        y = _depthwise_lower(inputs, params["depthwise_kernel"],
+                             self.subsample, self.border_mode)
         y = lax.conv_general_dilated(
             y, params["pointwise_kernel"], window_strides=(1, 1),
             padding="VALID", dimension_numbers=_DIMNUMS[2],
@@ -210,6 +223,52 @@ class SeparableConvolution2D(Layer):
             for s, k, st in zip(spatial, self.kernel_size, self.subsample)
         )
         return (input_shape[0],) + out + (self.nb_filter,)
+
+
+class DepthwiseConvolution2D(Layer):
+    """Depthwise-only conv, NHWC (the depthwise half of
+    SeparableConvolution2D — standalone so MobileNet-style blocks can put
+    BatchNorm/activation BETWEEN the depthwise and pointwise stages;
+    reference mobilenet config, ImageClassificationConfig.scala:48-49)."""
+
+    def __init__(self, nb_row, nb_col=None, subsample=(1, 1),
+                 border_mode="valid", depth_multiplier=1, activation=None,
+                 bias=True, init="glorot_uniform", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name, **kwargs)
+        self.kernel_size = _ntuple((nb_row, nb_col) if nb_col else nb_row, 2)
+        self.subsample = _ntuple(subsample, 2)
+        self.border_mode = border_mode
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = get_activation(activation)
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        in_ch = int(input_shape[-1])
+        self.add_weight(
+            "depthwise_kernel",
+            self.kernel_size + (1, in_ch * self.depth_multiplier), self.init
+        )
+        if self.bias:
+            self.add_weight("bias", (in_ch * self.depth_multiplier,),
+                            "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = _depthwise_lower(inputs, params["depthwise_kernel"],
+                             self.subsample, self.border_mode)
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        spatial = input_shape[1:-1]
+        out = tuple(
+            _conv_out_dim(s, k, st, self.border_mode)
+            for s, k, st in zip(spatial, self.kernel_size, self.subsample)
+        )
+        return (input_shape[0],) + out + (
+            int(input_shape[-1]) * self.depth_multiplier,)
 
 
 class Deconvolution2D(Layer):

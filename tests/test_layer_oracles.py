@@ -336,6 +336,32 @@ def test_separable_conv2d_vs_torch():
                                atol=1e-5)
 
 
+def test_depthwise_conv2d_vs_torch():
+    import torch
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        DepthwiseConvolution2D,
+    )
+
+    x = _r((2, 9, 9, 3), 16)
+    layer = DepthwiseConvolution2D(3, 3, depth_multiplier=2,
+                                   subsample=(2, 2))
+    _, params = apply_layer(layer, x)
+    # non-zero bias so the bias path is actually exercised (the default
+    # init is zeros, which would compare vacuously)
+    params = dict(params, bias=_r((6,), 17))
+    out, _ = apply_layer(layer, x, params=params)
+    dw = np.asarray(params["depthwise_kernel"])  # (kh, kw, 1, in*dm)
+    depth = torch.nn.Conv2d(3, 6, 3, stride=2, groups=3)
+    with torch.no_grad():
+        wd = np.transpose(dw[:, :, 0, :], (2, 0, 1))[:, None, :, :]
+        depth.weight.copy_(torch.from_numpy(wd))
+        depth.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        ref = depth(torch.from_numpy(_nhwc_to_nchw(x))).numpy()
+    np.testing.assert_allclose(out, _nchw_to_nhwc(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_locally_connected_1d_vs_manual():
     from analytics_zoo_tpu.pipeline.api.keras.layers import (
         LocallyConnected1D,
