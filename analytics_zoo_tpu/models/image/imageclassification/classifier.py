@@ -60,9 +60,18 @@ def ImagenetConfig(crop: int = 224) -> ImageClassificationConfig:
 _CONFIGS = {
     "resnet-50": ImagenetConfig(224),
     "resnet-18": ImagenetConfig(224),
+    # canonical input plans per family (reference ImageClassificationConfig
+    # preprocess chains): alexnet 227, inception-v3 299
+    "alexnet": ImageClassificationConfig(resize=256, crop=227),
+    "inception-v3": ImageClassificationConfig(resize=320, crop=299),
     "lenet": ImageClassificationConfig(resize=28, crop=28, mean=(0,),
                                        std=(255.0,)),
 }
+
+
+def _config_for(model_name: str) -> ImageClassificationConfig:
+    base = model_name.removesuffix("-quantize").removesuffix("-int8")
+    return _CONFIGS.get(base, ImagenetConfig())
 
 
 class LabelOutput:
@@ -100,7 +109,7 @@ class ImageClassifier(ZooModel):
         self.model_name = model_name
         self.classes = classes
         self._provided = model
-        self.config = config or _CONFIGS.get(model_name, ImagenetConfig())
+        self.config = config or _config_for(model_name)
         super().__init__()
 
     def build_model(self):
@@ -129,6 +138,10 @@ class ImageClassifier(ZooModel):
             from analytics_zoo_tpu.models.inception import Inception
 
             return Inception.v1(classes=self.classes, input_shape=shape)
+        if name == "inception-v3":
+            from analytics_zoo_tpu.models.inception import inception_v3
+
+            return inception_v3(classes=self.classes, input_shape=shape)
         from analytics_zoo_tpu.models import imagenet_zoo as zoo_nets
 
         factories = {

@@ -43,13 +43,19 @@ def alexnet(classes: int = 1000, input_shape=(227, 227, 3),
     def c(ch):
         return max(int(ch * width), 4)
 
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LRN2D
+
     m = Sequential(name="alexnet")
     m.add(Convolution2D(c(96), 11, 11, subsample=(4, 4), activation="relu",
                         input_shape=input_shape, name="conv1"))
     m.add(MaxPooling2D((3, 3), strides=(2, 2), name="pool1"))
+    # LRN placement matches the reference net (bvlc_alexnet: norm1/norm2
+    # after the first two pooling stages)
+    m.add(LRN2D(alpha=1e-4, k=1.0, beta=0.75, n=5, name="norm1"))
     m.add(Convolution2D(c(256), 5, 5, border_mode="same",
                         activation="relu", name="conv2"))
     m.add(MaxPooling2D((3, 3), strides=(2, 2), name="pool2"))
+    m.add(LRN2D(alpha=1e-4, k=1.0, beta=0.75, n=5, name="norm2"))
     m.add(Convolution2D(c(384), 3, 3, border_mode="same",
                         activation="relu", name="conv3"))
     m.add(Convolution2D(c(384), 3, 3, border_mode="same",

@@ -99,3 +99,148 @@ class Inception:
         out = Dense(classes, activation="softmax",
                     name="loss3/classifier")(x)
         return Model(inp, out, name="inception_v1")
+
+
+# ---------------------------------------------------------------------------
+# Inception-v3 (reference inception-v3 config,
+# ImageClassificationConfig.scala:35-36; Szegedy 2015 "Rethinking the
+# Inception Architecture" — factorized 7x7 and asymmetric 1xN/Nx1 convs,
+# BN after every conv)
+# ---------------------------------------------------------------------------
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import (  # noqa: E402
+    Activation,
+    BatchNormalization,
+    GlobalAveragePooling2D,
+)
+
+
+def _cbn(x, filters, kr, kc=None, stride=1, mode="same", name=None,
+         bn_momentum=0.99):
+    """conv (no bias) + BN + relu — the v3 building unit."""
+    kc = kc if kc is not None else kr
+    y = Convolution2D(filters, kr, kc, subsample=(stride, stride),
+                      border_mode=mode, bias=False, name=f"{name}/conv")(x)
+    y = BatchNormalization(momentum=bn_momentum, name=f"{name}/bn")(y)
+    return Activation("relu", name=f"{name}/relu")(y)
+
+
+def _v3_pool_proj(x, ch, name, bn_momentum):
+    p = AveragePooling2D(pool_size=(3, 3), strides=(1, 1),
+                         border_mode="same", name=f"{name}/pool")(x)
+    return _cbn(p, ch, 1, name=f"{name}/pool_proj",
+                bn_momentum=bn_momentum)
+
+
+def _v3_block_a(x, c, pool_ch, name, m):
+    """35x35 module: 1x1 | 5x5 | double-3x3 | pool-proj."""
+    b1 = _cbn(x, c(64), 1, name=f"{name}/1x1", bn_momentum=m)
+    b5 = _cbn(x, c(48), 1, name=f"{name}/5x5_reduce", bn_momentum=m)
+    b5 = _cbn(b5, c(64), 5, name=f"{name}/5x5", bn_momentum=m)
+    b3 = _cbn(x, c(64), 1, name=f"{name}/3x3dbl_reduce", bn_momentum=m)
+    b3 = _cbn(b3, c(96), 3, name=f"{name}/3x3dbl_1", bn_momentum=m)
+    b3 = _cbn(b3, c(96), 3, name=f"{name}/3x3dbl_2", bn_momentum=m)
+    bp = _v3_pool_proj(x, c(pool_ch), name, m)
+    return Merge(mode="concat", concat_axis=-1, name=f"{name}/concat")(
+        [b1, b5, b3, bp])
+
+
+def _v3_reduction_a(x, c, name, m):
+    b3 = _cbn(x, c(384), 3, stride=2, mode="valid",
+              name=f"{name}/3x3", bn_momentum=m)
+    bd = _cbn(x, c(64), 1, name=f"{name}/3x3dbl_reduce", bn_momentum=m)
+    bd = _cbn(bd, c(96), 3, name=f"{name}/3x3dbl_1", bn_momentum=m)
+    bd = _cbn(bd, c(96), 3, stride=2, mode="valid",
+              name=f"{name}/3x3dbl_2", bn_momentum=m)
+    bp = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                      name=f"{name}/pool")(x)
+    return Merge(mode="concat", concat_axis=-1, name=f"{name}/concat")(
+        [b3, bd, bp])
+
+
+def _v3_block_b(x, c, mid, name, m):
+    """17x17 module: 1x1 | 1x7-7x1 | double 7x7 | pool-proj (factorized
+    asymmetric convolutions — the paper's signature)."""
+    b1 = _cbn(x, c(192), 1, name=f"{name}/1x1", bn_momentum=m)
+    b7 = _cbn(x, c(mid), 1, name=f"{name}/7x7_reduce", bn_momentum=m)
+    b7 = _cbn(b7, c(mid), 1, 7, name=f"{name}/7x7_1x7", bn_momentum=m)
+    b7 = _cbn(b7, c(192), 7, 1, name=f"{name}/7x7_7x1", bn_momentum=m)
+    bd = _cbn(x, c(mid), 1, name=f"{name}/7x7dbl_reduce", bn_momentum=m)
+    bd = _cbn(bd, c(mid), 7, 1, name=f"{name}/7x7dbl_1", bn_momentum=m)
+    bd = _cbn(bd, c(mid), 1, 7, name=f"{name}/7x7dbl_2", bn_momentum=m)
+    bd = _cbn(bd, c(mid), 7, 1, name=f"{name}/7x7dbl_3", bn_momentum=m)
+    bd = _cbn(bd, c(192), 1, 7, name=f"{name}/7x7dbl_4", bn_momentum=m)
+    bp = _v3_pool_proj(x, c(192), name, m)
+    return Merge(mode="concat", concat_axis=-1, name=f"{name}/concat")(
+        [b1, b7, bd, bp])
+
+
+def _v3_reduction_b(x, c, name, m):
+    b3 = _cbn(x, c(192), 1, name=f"{name}/3x3_reduce", bn_momentum=m)
+    b3 = _cbn(b3, c(320), 3, stride=2, mode="valid",
+              name=f"{name}/3x3", bn_momentum=m)
+    b7 = _cbn(x, c(192), 1, name=f"{name}/7x7_reduce", bn_momentum=m)
+    b7 = _cbn(b7, c(192), 1, 7, name=f"{name}/7x7_1x7", bn_momentum=m)
+    b7 = _cbn(b7, c(192), 7, 1, name=f"{name}/7x7_7x1", bn_momentum=m)
+    b7 = _cbn(b7, c(192), 3, stride=2, mode="valid",
+              name=f"{name}/7x7_3x3", bn_momentum=m)
+    bp = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                      name=f"{name}/pool")(x)
+    return Merge(mode="concat", concat_axis=-1, name=f"{name}/concat")(
+        [b3, b7, bp])
+
+
+def _v3_block_c(x, c, name, m):
+    """8x8 module: 1x1 | 3x3-split(1x3 + 3x1) | dbl-3x3-split | pool."""
+    b1 = _cbn(x, c(320), 1, name=f"{name}/1x1", bn_momentum=m)
+    b3 = _cbn(x, c(384), 1, name=f"{name}/3x3_reduce", bn_momentum=m)
+    b3a = _cbn(b3, c(384), 1, 3, name=f"{name}/3x3_1x3", bn_momentum=m)
+    b3b = _cbn(b3, c(384), 3, 1, name=f"{name}/3x3_3x1", bn_momentum=m)
+    bd = _cbn(x, c(448), 1, name=f"{name}/dbl_reduce", bn_momentum=m)
+    bd = _cbn(bd, c(384), 3, name=f"{name}/dbl_3x3", bn_momentum=m)
+    bda = _cbn(bd, c(384), 1, 3, name=f"{name}/dbl_1x3", bn_momentum=m)
+    bdb = _cbn(bd, c(384), 3, 1, name=f"{name}/dbl_3x1", bn_momentum=m)
+    bp = _v3_pool_proj(x, c(192), name, m)
+    return Merge(mode="concat", concat_axis=-1, name=f"{name}/concat")(
+        [b1, b3a, b3b, bda, bdb, bp])
+
+
+def inception_v3(classes: int = 1000, input_shape=(299, 299, 3),
+                 width: float = 1.0, has_dropout: bool = True,
+                 bn_momentum: float = 0.99) -> Model:
+    """Inception-v3 (299x299 canonical; any input >= ~75px works).
+    ``width`` scales every tower's filter count for toy-scale CI."""
+    def c(ch):
+        return max(int(ch * width), 4)
+
+    m = bn_momentum
+    inp = Input(shape=input_shape, name="input")
+    x = _cbn(inp, c(32), 3, stride=2, mode="valid", name="stem/conv1",
+             bn_momentum=m)
+    x = _cbn(x, c(32), 3, mode="valid", name="stem/conv2", bn_momentum=m)
+    x = _cbn(x, c(64), 3, name="stem/conv3", bn_momentum=m)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     name="stem/pool1")(x)
+    x = _cbn(x, c(80), 1, mode="valid", name="stem/conv4", bn_momentum=m)
+    x = _cbn(x, c(192), 3, mode="valid", name="stem/conv5", bn_momentum=m)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     name="stem/pool2")(x)
+    x = _v3_block_a(x, c, 32, "mixed_5b", m)
+    x = _v3_block_a(x, c, 64, "mixed_5c", m)
+    x = _v3_block_a(x, c, 64, "mixed_5d", m)
+    x = _v3_reduction_a(x, c, "mixed_6a", m)
+    x = _v3_block_b(x, c, 128, "mixed_6b", m)
+    x = _v3_block_b(x, c, 160, "mixed_6c", m)
+    x = _v3_block_b(x, c, 160, "mixed_6d", m)
+    x = _v3_block_b(x, c, 192, "mixed_6e", m)
+    x = _v3_reduction_b(x, c, "mixed_7a", m)
+    x = _v3_block_c(x, c, "mixed_7b", m)
+    x = _v3_block_c(x, c, "mixed_7c", m)
+    x = GlobalAveragePooling2D(name="pool")(x)
+    if has_dropout:
+        x = Dropout(0.2, name="dropout")(x)
+    out = Dense(classes, activation="softmax", name="classifier")(x)
+    return Model(inp, out, name="inception_v3")
+
+
+Inception.v3 = staticmethod(inception_v3)

@@ -174,16 +174,20 @@ def _depthwise_lower(inputs, kernel, subsample, border_mode):
     )
 
 
-class SeparableConvolution2D(Layer):
-    """Depthwise + pointwise conv (reference
-    SeparableConvolution2D.scala), NHWC."""
+class DepthwiseConvolution2D(Layer):
+    """Depthwise-only conv, NHWC — standalone so MobileNet-style blocks
+    can put BatchNorm/activation BETWEEN the depthwise and pointwise
+    stages (reference mobilenet config,
+    ImageClassificationConfig.scala:48-49).  Also the base class of
+    SeparableConvolution2D, which adds the pointwise projection."""
 
-    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+    def __init__(self, nb_row, nb_col=None, subsample=(1, 1),
                  border_mode="valid", depth_multiplier=1, activation=None,
                  bias=True, init="glorot_uniform", input_shape=None,
                  name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
-        self.nb_filter = int(nb_filter)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode {border_mode!r}")
         self.kernel_size = _ntuple((nb_row, nb_col) if nb_col else nb_row, 2)
         self.subsample = _ntuple(subsample, 2)
         self.border_mode = border_mode
@@ -192,12 +196,49 @@ class SeparableConvolution2D(Layer):
         self.bias = bias
         self.init = init
 
-    def build(self, input_shape):
+    def _add_depthwise_kernel(self, input_shape):
         in_ch = int(input_shape[-1])
         self.add_weight(
             "depthwise_kernel",
             self.kernel_size + (1, in_ch * self.depth_multiplier), self.init
         )
+        return in_ch
+
+    def build(self, input_shape):
+        in_ch = self._add_depthwise_kernel(input_shape)
+        if self.bias:
+            self.add_weight("bias", (in_ch * self.depth_multiplier,),
+                            "zero")
+
+    def call(self, params, inputs, state=None, training=False, rng=None):
+        y = _depthwise_lower(inputs, params["depthwise_kernel"],
+                             self.subsample, self.border_mode)
+        if self.bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def _spatial_out(self, input_shape):
+        return tuple(
+            _conv_out_dim(s, k, st, self.border_mode)
+            for s, k, st in zip(input_shape[1:-1], self.kernel_size,
+                                self.subsample)
+        )
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._spatial_out(input_shape) + (
+            int(input_shape[-1]) * self.depth_multiplier,)
+
+
+class SeparableConvolution2D(DepthwiseConvolution2D):
+    """Depthwise + pointwise conv (reference
+    SeparableConvolution2D.scala), NHWC."""
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, **kwargs):
+        super().__init__(nb_row, nb_col, **kwargs)
+        self.nb_filter = int(nb_filter)
+
+    def build(self, input_shape):
+        in_ch = self._add_depthwise_kernel(input_shape)
         self.add_weight(
             "pointwise_kernel",
             (1, 1, in_ch * self.depth_multiplier, self.nb_filter), self.init
@@ -217,58 +258,8 @@ class SeparableConvolution2D(Layer):
         return self.activation(y)
 
     def compute_output_shape(self, input_shape):
-        spatial = input_shape[1:-1]
-        out = tuple(
-            _conv_out_dim(s, k, st, self.border_mode)
-            for s, k, st in zip(spatial, self.kernel_size, self.subsample)
-        )
-        return (input_shape[0],) + out + (self.nb_filter,)
-
-
-class DepthwiseConvolution2D(Layer):
-    """Depthwise-only conv, NHWC (the depthwise half of
-    SeparableConvolution2D — standalone so MobileNet-style blocks can put
-    BatchNorm/activation BETWEEN the depthwise and pointwise stages;
-    reference mobilenet config, ImageClassificationConfig.scala:48-49)."""
-
-    def __init__(self, nb_row, nb_col=None, subsample=(1, 1),
-                 border_mode="valid", depth_multiplier=1, activation=None,
-                 bias=True, init="glorot_uniform", input_shape=None,
-                 name=None, **kwargs):
-        super().__init__(input_shape=input_shape, name=name, **kwargs)
-        self.kernel_size = _ntuple((nb_row, nb_col) if nb_col else nb_row, 2)
-        self.subsample = _ntuple(subsample, 2)
-        self.border_mode = border_mode
-        self.depth_multiplier = int(depth_multiplier)
-        self.activation = get_activation(activation)
-        self.bias = bias
-        self.init = init
-
-    def build(self, input_shape):
-        in_ch = int(input_shape[-1])
-        self.add_weight(
-            "depthwise_kernel",
-            self.kernel_size + (1, in_ch * self.depth_multiplier), self.init
-        )
-        if self.bias:
-            self.add_weight("bias", (in_ch * self.depth_multiplier,),
-                            "zero")
-
-    def call(self, params, inputs, state=None, training=False, rng=None):
-        y = _depthwise_lower(inputs, params["depthwise_kernel"],
-                             self.subsample, self.border_mode)
-        if self.bias:
-            y = y + params["bias"]
-        return self.activation(y)
-
-    def compute_output_shape(self, input_shape):
-        spatial = input_shape[1:-1]
-        out = tuple(
-            _conv_out_dim(s, k, st, self.border_mode)
-            for s, k, st in zip(spatial, self.kernel_size, self.subsample)
-        )
-        return (input_shape[0],) + out + (
-            int(input_shape[-1]) * self.depth_multiplier,)
+        return (input_shape[0],) + self._spatial_out(input_shape) + (
+            self.nb_filter,)
 
 
 class Deconvolution2D(Layer):

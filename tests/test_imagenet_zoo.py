@@ -74,6 +74,19 @@ def test_densenet_161_plan():
     assert sum(1 for n in names if n.endswith("/conv3x3")) == 6 + 12 + 36 + 24
 
 
+def test_inception_v3_forward():
+    from analytics_zoo_tpu.models.inception import inception_v3
+
+    # 79px: the smallest input whose valid-padding stem + two reductions
+    # stay positive; width 0.05 keeps the 11-module graph tiny
+    net = inception_v3(classes=5, input_shape=(79, 79, 3), width=0.05)
+    _check(net, size=79)
+    names = [ly.name for ly in net.layers]
+    # the factorized-asymmetric-conv signature blocks are all present
+    assert "mixed_6b/7x7_1x7/conv" in names
+    assert "mixed_7c/dbl_3x1/conv" in names
+
+
 def test_mobilenet_forward():
     _check(zoo_nets.mobilenet(classes=5, input_shape=(32, 32, 3),
                               alpha=0.25))
@@ -118,14 +131,16 @@ def test_classifier_factory_covers_reference_model_set():
     )
 
     reference_models = [
-        "alexnet", "alexnet-quantize", "inception-v1", "resnet-50",
+        "alexnet", "alexnet-quantize", "inception-v1", "inception-v3",
+        "resnet-50",
         "resnet-50-quantize", "resnet-50-int8", "vgg-16", "vgg-19",
         "densenet-161", "squeezenet", "mobilenet", "mobilenet-v2",
         "mobilenet-v2-quantize",
     ]
     for name in reference_models:
-        # alexnet's valid-padding plan needs >=67px crops
-        crop = 67 if name.startswith("alexnet") else 32
+        # alexnet/inception-v3 valid-padding plans need bigger crops
+        base = name.removesuffix("-quantize").removesuffix("-int8")
+        crop = {"alexnet": 67, "inception-v3": 79}.get(base, 32)
         cfg = ImageClassificationConfig(crop=crop)
         clf = ImageClassifier(model_name=name, classes=4, config=cfg)
         net = clf.build_model()
