@@ -35,7 +35,7 @@ QUICK_FILES = {
     "test_onnx.py", "test_image_ops.py", "test_inference.py",
     "test_serving.py", "test_keras2.py", "test_caffe.py",
     "test_layer_oracle_enforcement.py", "test_actors.py",
-    "test_textset.py", "test_image3d.py",
+    "test_textset.py", "test_image3d.py", "test_transfer_learning.py",
 }
 
 
