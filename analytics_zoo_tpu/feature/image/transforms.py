@@ -505,3 +505,30 @@ def assemble_crop_batch(images, out_h, out_w, rng=None, offsets=None,
         crop = np.asarray(im, np.uint8)[y0:y0 + out_h, x0:x0 + out_w]
         out[i] = crop[:, ::-1] if flips[i] else crop
     return out
+
+
+def resize_batch(batch, out_h, out_w, n_threads=None):
+    """Bilinear-resize a (N, H, W, C) uint8 batch to (N, oh, ow, C) —
+    the resize stage of the host preprocess chain (resize -> crop/flip ->
+    normalize), on C++ threads when the native library is built, cv2
+    otherwise.  Both use half-pixel-center sampling (cv2 INTER_LINEAR),
+    agreeing to +-1 from uint8 rounding.
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu import native
+
+    batch = np.ascontiguousarray(batch, np.uint8)
+    if batch.ndim != 4:
+        raise ValueError(f"expected (N, H, W, C) uint8, got {batch.shape}")
+    if native.lib is not None:
+        return native.lib.resize_bilinear(batch, out_h, out_w,
+                                          n_threads=n_threads)
+    import cv2
+
+    out = np.empty((batch.shape[0], out_h, out_w, batch.shape[-1]),
+                   np.uint8)
+    for i, im in enumerate(batch):
+        r = cv2.resize(im, (out_w, out_h), interpolation=cv2.INTER_LINEAR)
+        out[i] = r if r.ndim == 3 else r[..., None]
+    return out

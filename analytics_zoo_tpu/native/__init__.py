@@ -46,6 +46,12 @@ class _NativeLib:
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32,
         ]
+        self._dll.zoo_resize_bilinear_u8.restype = None
+        self._dll.zoo_resize_bilinear_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
 
     def crc32c(self, data: bytes) -> int:
         return self._dll.zoo_crc32c(data, len(data))
@@ -95,6 +101,23 @@ class _NativeLib:
             flp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             n, out_h, out_w, ch, int(n_threads),
+        )
+        return out
+
+    def resize_bilinear(self, batch, out_h, out_w, n_threads=None):
+        """(N, H, W, C) uint8 -> (N, oh, ow, C) uint8, half-pixel-center
+        bilinear (cv2 INTER_LINEAR convention), on C++ threads."""
+        import numpy as np
+
+        batch = np.ascontiguousarray(batch, dtype=np.uint8)
+        n, ih, iw, ch = batch.shape
+        out = np.empty((n, out_h, out_w, ch), dtype=np.uint8)
+        if n_threads is None:
+            n_threads = min(8, os.cpu_count() or 1)
+        self._dll.zoo_resize_bilinear_u8(
+            batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, ih, iw, out_h, out_w, ch, int(n_threads),
         )
         return out
 

@@ -124,4 +124,78 @@ void zoo_assemble_batch(const uint8_t* const* imgs,
   for (auto& th : pool) th.join();
 }
 
+// ---------------------------------------------------------------------------
+// Threaded bilinear resize: N same-size HWC uint8 images -> (N, oh, ow, ch)
+// uint8.  Half-pixel-center sampling with edge clamping — the cv2
+// INTER_LINEAR convention, so the Python oracle (ImageResize/cv2) and the
+// native path agree to rounding.  Completes the native host preprocess
+// chain: resize (here) -> crop/flip (zoo_assemble_batch) -> normalize
+// (zoo_normalize_u8).
+// ---------------------------------------------------------------------------
+
+void zoo_resize_bilinear_u8(const uint8_t* in, uint8_t* out, int32_t n,
+                            int32_t ih, int32_t iw, int32_t oh, int32_t ow,
+                            int32_t ch, int32_t n_threads) {
+  const float sy = (float)ih / (float)oh;
+  const float sx = (float)iw / (float)ow;
+  // Per-output-column sampling data is identical across rows and images:
+  // precompute once.
+  std::vector<int32_t> x0s(ow), x1s(ow);
+  std::vector<float> fxs(ow);
+  for (int32_t x = 0; x < ow; ++x) {
+    float src = ((float)x + 0.5f) * sx - 0.5f;
+    if (src < 0) src = 0;
+    int32_t x0 = (int32_t)src;
+    if (x0 > iw - 1) x0 = iw - 1;
+    int32_t x1 = x0 + 1 < iw ? x0 + 1 : iw - 1;
+    x0s[x] = x0;
+    x1s[x] = x1;
+    fxs[x] = src - (float)x0;
+  }
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+  auto work = [&](int32_t start, int32_t end) {
+    for (int32_t i = start; i < end; ++i) {
+      const uint8_t* src_img = in + (size_t)i * ih * iw * ch;
+      uint8_t* dst_img = out + (size_t)i * oh * ow * ch;
+      for (int32_t y = 0; y < oh; ++y) {
+        float srcy = ((float)y + 0.5f) * sy - 0.5f;
+        if (srcy < 0) srcy = 0;
+        int32_t y0 = (int32_t)srcy;
+        if (y0 > ih - 1) y0 = ih - 1;
+        int32_t y1 = y0 + 1 < ih ? y0 + 1 : ih - 1;
+        float fy = srcy - (float)y0;
+        const uint8_t* r0 = src_img + (size_t)y0 * iw * ch;
+        const uint8_t* r1 = src_img + (size_t)y1 * iw * ch;
+        uint8_t* drow = dst_img + (size_t)y * ow * ch;
+        for (int32_t x = 0; x < ow; ++x) {
+          const uint8_t* p00 = r0 + (size_t)x0s[x] * ch;
+          const uint8_t* p01 = r0 + (size_t)x1s[x] * ch;
+          const uint8_t* p10 = r1 + (size_t)x0s[x] * ch;
+          const uint8_t* p11 = r1 + (size_t)x1s[x] * ch;
+          float fx = fxs[x];
+          for (int32_t c = 0; c < ch; ++c) {
+            float top = (float)p00[c] + fx * ((float)p01[c] - (float)p00[c]);
+            float bot = (float)p10[c] + fx * ((float)p11[c] - (float)p10[c]);
+            float v = top + fy * (bot - top);
+            drow[(size_t)x * ch + c] = (uint8_t)(v + 0.5f);
+          }
+        }
+      }
+    }
+  };
+  if (n_threads == 1) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int32_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int32_t s = t * per, e = s + per < n ? s + per : n;
+    if (s >= e) break;
+    pool.emplace_back(work, s, e);
+  }
+  for (auto& th : pool) th.join();
+}
+
 }  // extern "C"

@@ -216,6 +216,52 @@ def test_assemble_crop_batch_validation():
     assert out1.shape == (1, 24, 24, 3)
 
 
+def test_native_resize_matches_cv2():
+    """zoo_resize_bilinear_u8 vs cv2 INTER_LINEAR (the Python fallback):
+    same half-pixel-center convention, so results agree to +-1 uint8
+    rounding at every pixel."""
+    import cv2
+
+    from analytics_zoo_tpu import native
+    from analytics_zoo_tpu.feature.image.transforms import resize_batch
+
+    lib = native.build_native()
+    if lib is None:
+        pytest.skip("no C++ compiler available")
+    rng = np.random.default_rng(11)
+    batch = rng.integers(0, 256, size=(6, 37, 53, 3), dtype=np.uint8)
+    for oh, ow in [(24, 24), (64, 48), (37, 53)]:
+        got = resize_batch(batch, oh, ow)
+        assert got.shape == (6, oh, ow, 3) and got.dtype == np.uint8
+        want = np.stack([
+            cv2.resize(im, (ow, oh), interpolation=cv2.INTER_LINEAR)
+            for im in batch
+        ])
+        diff = np.abs(got.astype(int) - want.astype(int))
+        assert diff.max() <= 1, (oh, ow, diff.max())
+        # identity resize is exact
+    np.testing.assert_array_equal(resize_batch(batch, 37, 53), batch)
+
+
+def test_resize_batch_fallback_matches_native():
+    from analytics_zoo_tpu import native
+    from analytics_zoo_tpu.feature.image.transforms import resize_batch
+
+    lib = native.build_native()
+    if lib is None:
+        pytest.skip("no C++ compiler available")
+    rng = np.random.default_rng(12)
+    batch = rng.integers(0, 256, size=(3, 40, 40, 1), dtype=np.uint8)
+    got = resize_batch(batch, 20, 30)
+    saved, native.lib = native.lib, None
+    try:
+        want = resize_batch(batch, 20, 30)
+    finally:
+        native.lib = saved
+    diff = np.abs(got.astype(int) - want.astype(int))
+    assert diff.max() <= 1
+
+
 def test_stale_native_lib_rebuilds(tmp_path, monkeypatch):
     """A .so built from older source (missing a new symbol) must not
     crash import or build_native — it rebuilds from current source."""
