@@ -1,0 +1,162 @@
+"""Distributed policy-gradient RL on the actor runtime (reference
+pyzoo/zoo/examples/ray/rl_pong/rl_pong.py: Karpathy's numpy Pong policy
+gradient with N `@ray.remote` rollout actors on RayOnSpark — each worker
+plays episodes at the current weights and ships back gradients, the
+driver applies RMSProp as results arrive).
+
+Same structure, no Atari/gym dependency (zero egress in this sandbox):
+the environment is "catch" — a ball falls down a WxH pixel board, a
+paddle moves left/right/stay, +1 for a catch, -1 for a miss — and the
+policy is the reference's numpy recipe: 2-layer MLP over pixels,
+discounted-reward REINFORCE with manual backprop, RMSProp on the driver.
+The DISTRIBUTION pattern (broadcast weights -> parallel rollout actors
+-> gradient aggregation per round) is the example's point.
+
+Usage: python examples/ray_rl/rl_pong.py [--rounds 30] [--workers 3]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from analytics_zoo_tpu.parallel.actors import (  # noqa: E402
+    ActorContext,
+    get,
+    remote,
+)
+
+W, HGT = 7, 8             # board width/height (the "pixels")
+D = W * HGT               # input dimensionality
+H = 32                    # hidden neurons (reference uses 200 for Atari)
+GAMMA = 0.97
+DECAY = 0.99              # RMSProp decay (reference decay_rate)
+LR = 1e-2
+ACTIONS = 3               # left / stay / right
+
+
+def init_weights(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": (rng.standard_normal((D, H)) / np.sqrt(D)).astype(np.float64),
+        "w2": (rng.standard_normal((H, ACTIONS))
+               / np.sqrt(H)).astype(np.float64),
+    }
+
+
+def discount_rewards(r):
+    """Reference discount_rewards: gamma-discounted return per step."""
+    out = np.zeros_like(r)
+    acc = 0.0
+    for t in reversed(range(len(r))):
+        acc = acc * GAMMA + r[t]
+        out[t] = acc
+    return out
+
+
+@remote
+class RolloutWorker:
+    """Plays episodes at given weights; returns policy gradients
+    (reference PongEnv.compute_gradient)."""
+
+    def __init__(self, seed):
+        self.rng = np.random.default_rng(seed)
+
+    def _episode(self, w):
+        ball_x = int(self.rng.integers(W))
+        paddle_x = W // 2
+        xs, hs, dlogps, rewards = [], [], [], []
+        for ball_y in range(HGT - 1):
+            board = np.zeros((HGT, W))
+            board[ball_y, ball_x] = 1.0
+            board[HGT - 1, paddle_x] = 1.0
+            x = board.reshape(-1)
+            h = np.maximum(x @ w["w1"], 0.0)
+            logits = h @ w["w2"]
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = int(self.rng.choice(ACTIONS, p=p))
+            # grad of log pi(a|x) wrt logits
+            dlogp = -p
+            dlogp[a] += 1.0
+            paddle_x = int(np.clip(paddle_x + (a - 1), 0, W - 1))
+            done = ball_y == HGT - 2
+            rewards.append((1.0 if paddle_x == ball_x else -1.0)
+                           if done else 0.0)
+            xs.append(x)
+            hs.append(h)
+            dlogps.append(dlogp)
+        return (np.stack(xs), np.stack(hs), np.stack(dlogps),
+                np.asarray(rewards))
+
+    def compute_gradient(self, weights, episodes=8):
+        """N episodes at ``weights`` -> (grads, mean reward).
+
+        Advantages are normalized across the WHOLE episode batch (the
+        reference's recipe) — per-episode normalization would erase the
+        won-vs-lost signal that IS the gradient."""
+        all_xs, all_hs, all_dlogps, all_adv = [], [], [], []
+        total = 0.0
+        for _ in range(episodes):
+            xs, hs, dlogps, rewards = self._episode(weights)
+            total += rewards.sum()
+            all_xs.append(xs)
+            all_hs.append(hs)
+            all_dlogps.append(dlogps)
+            all_adv.append(discount_rewards(rewards))
+        xs = np.concatenate(all_xs)
+        hs = np.concatenate(all_hs)
+        dlogps = np.concatenate(all_dlogps)
+        adv = np.concatenate(all_adv)
+        adv -= adv.mean()
+        std = adv.std()
+        if std > 1e-8:
+            adv /= std
+        dlogits = dlogps * adv[:, None]     # (T_total, A)
+        g = {
+            "w2": hs.T @ dlogits,
+            "w1": xs.T @ ((dlogits @ weights["w2"].T) * (hs > 0)),
+        }
+        return g, total / episodes
+
+
+def run(rounds=30, workers=3, episodes_per_worker=8, seed=0):
+    ctx = ActorContext.init()
+    w = init_weights(seed)
+    rms = {k: np.zeros_like(v) for k, v in w.items()}
+    actors = [RolloutWorker.remote(seed + 100 + i) for i in range(workers)]
+
+    history = []
+    for rnd in range(rounds):
+        results = get([a.compute_gradient.remote(w, episodes_per_worker)
+                       for a in actors])
+        mean_reward = float(np.mean([r for _, r in results]))
+        history.append(mean_reward)
+        for k in w:
+            grad = np.mean([g[k] for g, _ in results], axis=0)
+            rms[k] = DECAY * rms[k] + (1 - DECAY) * grad ** 2
+            w[k] += LR * grad / (np.sqrt(rms[k]) + 1e-5)
+        if (rnd + 1) % 10 == 0:
+            print(f"round {rnd + 1}: mean episode reward "
+                  f"{mean_reward:+.3f}")
+    ctx.stop()
+    first = float(np.mean(history[:5]))
+    last = float(np.mean(history[-5:]))
+    print(f"mean reward first 5 rounds {first:+.3f} -> last 5 {last:+.3f}")
+    return first, last
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--workers", type=int, default=3)
+    a = ap.parse_args()
+    first, last = run(rounds=a.rounds, workers=a.workers)
+    assert last > first, (first, last)
+
+
+if __name__ == "__main__":
+    main()

@@ -392,3 +392,10 @@ def test_openvino_predict_example():
     from examples.openvino.predict import run
 
     assert run(n=32) > 0.9
+
+
+def test_ray_rl_pong_example_learns():
+    from examples.ray_rl.rl_pong import run
+
+    first, last = run(rounds=40, workers=3)
+    assert last > first + 0.5, (first, last)
