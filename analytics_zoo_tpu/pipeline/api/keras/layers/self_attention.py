@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from analytics_zoo_tpu.ops.attention import (
     dot_product_attention,
@@ -52,8 +53,23 @@ class _TransformerCore(Layer):
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) — live memory drops from O(n_block) to O(1)
         # block activations for ~1/3 more FLOPs, the standard trade for
-        # training deep stacks near the HBM limit
-        self.remat = bool(remat)
+        # training deep stacks near the HBM limit.  Accepts True/"full"
+        # (recompute everything), "dots" (save matmul outputs —
+        # checkpoint_dots_with_no_batch_dims: less recompute, more
+        # memory), or "attn" (save only the per-block attention context
+        # via checkpoint_name — the backward re-derives the cheap
+        # projections but not the flash-attention forward).  The best
+        # point is hardware-dependent; the transformer bench sweeps it.
+        if remat in (False, None):
+            self.remat = None
+        elif remat in (True, "full"):
+            self.remat = "full"
+        elif remat in ("dots", "attn"):
+            self.remat = str(remat)
+        else:
+            raise ValueError(
+                f"remat must be bool, 'full', 'dots' or 'attn'; "
+                f"got {remat!r}")
         from analytics_zoo_tpu.ops.activations import get_activation
 
         self.act = get_activation(activation)
@@ -92,8 +108,18 @@ class _TransformerCore(Layer):
 
     def _run_blocks(self, blocks, h, mask, training, rng):
         body = self._block_forward
-        if self.remat:
+        if self.remat == "full":
             body = jax.checkpoint(body, static_argnums=(3,))
+        elif self.remat == "dots":
+            body = jax.checkpoint(
+                body, static_argnums=(3,),
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif self.remat == "attn":
+            body = jax.checkpoint(
+                body, static_argnums=(3,),
+                policy=jax.checkpoint_policies
+                .save_only_these_names("attn_context"))
         for bi, bp in enumerate(blocks):
             brng = jax.random.fold_in(rng, bi) if rng is not None else None
             h = body(bp, h, mask, training, brng)
@@ -112,6 +138,7 @@ class _TransformerCore(Layer):
                  if brng is not None else None),
             causal=not self.bidirectional,
         )
+        a = checkpoint_name(a, "attn_context")
         a = merge_heads(a) @ bp["proj_kernel"] + bp["proj_bias"]
         a = self._drop(a, self.hidden_drop, training, brng, 1)
         h = self._ln(h + a, bp["ln1_gamma"], bp["ln1_beta"])

@@ -338,20 +338,28 @@ def test_transformer_remat_matches_baseline(zoo_ctx):
                             hidden_size=16, embedding_drop=0.0,
                             hidden_drop=0.0, attn_drop=0.0)
     params = base.init_params(jax.random.PRNGKey(0))
-    rem = TransformerLayer(vocab=50, seq_len=12, n_block=2, n_head=2,
-                           hidden_size=16, embedding_drop=0.0,
-                           hidden_drop=0.0, attn_drop=0.0, remat=True)
-
     def loss(layer, p):
         return jnp.sum(layer.call(p, toks, training=True,
                                   rng=jax.random.PRNGKey(1)) ** 2)
 
     la, ga = jax.value_and_grad(lambda p: loss(base, p))(params)
-    lb, gb = jax.value_and_grad(lambda p: loss(rem, p))(params)
-    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=1e-5), ga, gb)
+    # every checkpoint policy must be a pure memory/FLOP trade
+    for policy in (True, "dots", "attn"):
+        rem = TransformerLayer(vocab=50, seq_len=12, n_block=2, n_head=2,
+                               hidden_size=16, embedding_drop=0.0,
+                               hidden_drop=0.0, attn_drop=0.0,
+                               remat=policy)
+        lb, gb = jax.value_and_grad(lambda p: loss(rem, p))(params)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-6,
+                                   err_msg=str(policy))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5), ga, gb)
+    import pytest
+
+    with pytest.raises(ValueError, match="remat"):
+        TransformerLayer(vocab=50, seq_len=12, n_block=1, n_head=2,
+                         hidden_size=16, remat="bogus")
 
 
 def test_from_logits_losses_are_f32_under_bf16():

@@ -29,6 +29,9 @@ import numpy as np
 
 def run(seq=1024, batch=8, blocks=12, hidden=768, heads=12, vocab=32768,
         steps=10, remat=False, attn_drop=0.1, hidden_drop=0.1):
+    """``remat``: False, True/"full", "dots" or "attn" — the
+    TransformerLayer checkpoint policy (sweep on hardware; the best
+    memory/recompute point is device-dependent)."""
     import jax
 
     from analytics_zoo_tpu import init_zoo_context
@@ -119,8 +122,10 @@ def main():
     p.add_argument("--hidden", type=int, default=768)
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--steps", type=int, default=10)
-    p.add_argument("--remat", action="store_true",
-                   help="jax.checkpoint per transformer block")
+    p.add_argument("--remat", nargs="?", const="full", default=False,
+                   choices=["full", "dots", "attn"],
+                   help="jax.checkpoint per transformer block; optional "
+                        "policy argument (default 'full')")
     p.add_argument("--attn-drop", type=float, default=0.1)
     p.add_argument("--hidden-drop", type=float, default=0.1)
     p.add_argument("--out", default=None)
