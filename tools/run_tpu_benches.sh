@@ -49,7 +49,10 @@ run transformer 3600 python tools/transformer_bench.py \
 # 3. serving latency on the real chip at a sustainable offered load
 run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
 
-# 4. headline bench line (host-infeed heavy: keep the core free)
+# 4. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
+run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
+
+# 5. headline bench line (host-infeed heavy: keep the core free)
 run bench 4800 python bench.py
 
 echo "$(date) queue complete" | tee -a "$LOG/queue.log"
