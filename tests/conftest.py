@@ -36,6 +36,7 @@ QUICK_FILES = {
     "test_serving.py", "test_keras2.py", "test_caffe.py",
     "test_layer_oracle_enforcement.py", "test_actors.py",
     "test_textset.py", "test_image3d.py", "test_transfer_learning.py",
+    "test_layer_serialization.py",
 }
 
 
