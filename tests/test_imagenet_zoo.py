@@ -120,6 +120,27 @@ def test_mobilenet_v2_learns():
     assert acc > 0.8, acc
 
 
+def test_predict_image_set_with_zoo_family():
+    """Full reference flow on a new family: preprocess chain (resize/crop/
+    normalize per the model's config) -> batched forward -> LabelOutput."""
+    from analytics_zoo_tpu.feature.image.imageset import ImageSet
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassificationConfig,
+        ImageClassifier,
+    )
+
+    rng = np.random.default_rng(0)
+    imgs = [rng.integers(0, 256, size=(40, 48, 3), dtype=np.uint8)
+            for _ in range(5)]
+    cfg = ImageClassificationConfig(
+        resize=36, crop=32, label_map={i: f"class_{i}" for i in range(4)})
+    clf = ImageClassifier(model_name="mobilenet-v2", classes=4, config=cfg)
+    clf.model.build_params()
+    out = clf.predict_image_set(ImageSet.from_arrays(imgs), top_k=3)
+    assert len(out) == 5 and len(out[0]) == 3
+    assert out[0][0][0].startswith("class_")
+
+
 def test_classifier_factory_covers_reference_model_set():
     """Every model name in ImageClassificationConfig.scala:31-50 (minus
     the dataset-variant suffixes) builds through ImageClassifier."""
