@@ -52,7 +52,11 @@ run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
 # 4. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
 run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 
-# 5. headline bench line (host-infeed heavy: keep the core free)
+# 5. jax.profiler trace of the pure step -> PROFILE_r04/ (the roofline
+# evidence for the remaining pure-step gap)
+run profile 3000 python tools/profile_step.py 256
+
+# 6. headline bench line (host-infeed heavy: keep the core free)
 run bench 4800 python bench.py
 
 echo "$(date) queue complete" | tee -a "$LOG/queue.log"
