@@ -28,6 +28,7 @@ from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
 )
 from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
+    zigzag_ring_attention,
 )
 from analytics_zoo_tpu.parallel.strategies import (  # noqa: F401
     column_parallel_dense,

@@ -523,3 +523,83 @@ def test_ring_backward_chunk_padding(seq_ctx):
     for a, b, name in zip(g, gr, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-3, err_msg=name)
+
+
+class TestZigzagRingAttention:
+    """Causal-load-balanced variant (VERDICT r03 weak #8): same contract
+    as ring_attention (contiguous sharding in/out), balanced work."""
+
+    def test_matches_dense(self, seq_ctx):
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention,
+        )
+
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(2, 3, 32, 8)).astype(np.float32))
+            for _ in range(3)
+        )
+        for causal in (False, True):
+            out = zigzag_ring_attention(q, k, v, causal=causal)
+            ref = dot_product_attention(q, k, v, causal=causal,
+                                        use_flash=False)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, err_msg=str(causal))
+
+    def test_gradients_match_dense(self, seq_ctx):
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention,
+        )
+
+        rng = np.random.default_rng(3)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(1, 2, 32, 8))
+                        .astype(np.float32) * 0.5)
+            for _ in range(3)
+        )
+        g = jax.grad(lambda q, k, v: jnp.sum(
+            zigzag_ring_attention(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, causal=True, use_flash=False) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, err_msg=name)
+
+    def test_layout_roundtrip(self, seq_ctx):
+        """to-zigzag -> from-zigzag is the identity on any sharded block."""
+        import jax.sharding as shd
+
+        from analytics_zoo_tpu.common.engine import get_zoo_context
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            _zz_from,
+            _zz_to,
+        )
+
+        ctx = get_zoo_context()
+        mesh = ctx.mesh
+        n = mesh.shape["seq"]
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(1, 1, 8 * n, 4))
+                        .astype(np.float32))
+        spec = shd.PartitionSpec(None, None, "seq", None)
+
+        def body(xl):
+            return _zz_from(_zz_to(xl, "seq", n), "seq", n)
+
+        out = jax.shard_map(body, mesh=mesh, in_specs=(spec,),
+                            out_specs=spec, check_vma=False)(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_odd_local_length_rejected(self, seq_ctx):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention,
+        )
+
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(1, 1, 36, 8)).astype(np.float32))
+        with pytest.raises(ValueError, match="even local sequence"):
+            zigzag_ring_attention(q, q, q, causal=True)
