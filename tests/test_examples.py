@@ -399,3 +399,11 @@ def test_ray_rl_pong_example_learns():
 
     first, last = run(rounds=40, workers=3)
     assert last > first + 0.5, (first, last)
+
+
+def test_image_augmentation_3d_notebook_runs():
+    ns = _run_notebook(
+        os.path.join(REPO, "apps/image_augmentation_3d.ipynb"))
+    assert ns["pipeline_data"].shape == (5, 40, 40, 1)
+    assert ns["batch"]["x"].shape == (2, 5, 40, 40, 1)
+    assert ns["center"].shape == (3, 32, 32, 1)
