@@ -296,8 +296,12 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
             if causal:
                 live = live & (q_pos + offset >= k_pos)
             if has_seg:
-                sq = qseg_ref[0].reshape(block_q, 1)
-                sk = kseg_ref[0].reshape(1, block_k)
+                # q_seg rides as (B, Lq, 8) and kv_seg as (B, 8, Lk): a bare
+                # (B, L) operand would need block (1, block) whose
+                # second-to-last dim violates Mosaic's (8, 128)-or-full-dim
+                # block rule on real TPU (interpret mode does not check).
+                sq = qseg_ref[0][:, :1]            # (block_q, 1)
+                sk = kseg_ref[0][:1, :]            # (1, block_k)
                 live = live & (sq == sk)
             s = jnp.where(live, s, _NEG)
             m, l = m_ref[...], l_ref[...]
@@ -356,13 +360,15 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         args.append(bias.astype(jnp.float32))
     if has_seg:
         in_specs.append(pl.BlockSpec(
-            (1, block_q), lambda bi, hi, qi, ki: (bi, qi),
+            (1, block_q, 8), lambda bi, hi, qi, ki: (bi, qi, 0),
             memory_space=pltpu.VMEM))
         in_specs.append(pl.BlockSpec(
-            (1, block_k), lambda bi, hi, qi, ki: (bi, ki),
+            (1, 8, block_k), lambda bi, hi, qi, ki: (bi, 0, ki),
             memory_space=pltpu.VMEM))
-        args.append(q_seg.astype(jnp.int32))
-        args.append(kv_seg.astype(jnp.int32))
+        args.append(jnp.broadcast_to(
+            q_seg.astype(jnp.int32)[:, :, None], (b, lq, 8)))
+        args.append(jnp.broadcast_to(
+            kv_seg.astype(jnp.int32)[:, None, :], (b, 8, lk)))
     if has_drop:
         in_specs.append(pl.BlockSpec(
             (2,), lambda bi, hi, qi, ki: (0,),

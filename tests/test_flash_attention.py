@@ -378,3 +378,42 @@ def test_attention_stats_matches_reference(causal):
         full = _attention_reference(q, k, v, False, 0.125)
         np.testing.assert_allclose(acc / l12[..., None], full, rtol=1e-4,
                                    atol=1e-4)
+
+
+def test_mosaic_tpu_lowering_all_variants():
+    """Cross-lower every production flash configuration for the TPU backend
+    (no chip needed: Mosaic's block-shape validation — second-to-last dim
+    divisible by 8 or full, last divisible by 128 or full — runs at lowering
+    time).  Interpret-mode numerics tests cannot catch these; the round-4
+    chip run failed exactly here on the (1, block) segment-id specs."""
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        _flash_fwd_pallas,
+        _resolve_blocks,
+    )
+
+    B, H, L, D = 2, 2, 4096, 64
+    q = jnp.zeros((B, H, L, D), jnp.bfloat16)
+    segs = jnp.zeros((B, L), jnp.int32)
+    bias = jnp.zeros((B, 1, 1, L), jnp.float32)
+    seed = jnp.asarray([3, 11], jnp.int32)
+    full_bias = jnp.zeros((B, 1, L, L), jnp.float32)
+    variants = {
+        "clean": dict(),
+        "causal": dict(causal=True),
+        "bias_dropout": dict(bias=bias, dropout_p=0.1, seed=seed),
+        "full_bias": dict(bias=full_bias),
+        "causal_seg_dropout": dict(causal=True, q_seg=segs, kv_seg=segs,
+                                   dropout_p=0.1, seed=seed),
+        "stats": dict(return_stats=True),
+    }
+    for name, kw in variants.items():
+        b = kw.get("bias")
+        bq, bk = _resolve_blocks(None, None,
+                                 full_bias=b is not None and b.shape[-2] > 1,
+                                 dropout=kw.get("dropout_p", 0) > 0)
+        causal = kw.pop("causal", False)
+
+        def fn(q, kw=kw, causal=causal, bq=bq, bk=bk):
+            return _flash_fwd_pallas(q, q, q, causal, 0.125, bq, bk, **kw)
+
+        jax.jit(fn).trace(q).lower(lowering_platforms=("tpu",))
