@@ -60,10 +60,13 @@ class ZooModel:
         import pickle
 
         self.model.save(path, over_write=over_write)
-        # append the wrapper class + config so load restores the subclass
+        # append the wrapper class + config so load restores the subclass;
+        # live nets (e.g. ImageClassifier(model=net)'s ``_provided``) are
+        # nulled, not pickled — load_model reattaches ``model`` from the
+        # saved KerasNet and never re-runs build_model
         with open(path + ".zoo_meta", "wb") as f:
-            cfg = dict(self.__dict__)
-            cfg.pop("model", None)
+            cfg = {k: (None if isinstance(v, KerasNet) else v)
+                   for k, v in self.__dict__.items() if k != "model"}
             pickle.dump({"cls": type(self), "cfg": cfg}, f)
 
     @staticmethod

@@ -1,0 +1,93 @@
+"""NCF recommendation inference app.
+
+Mirror of the reference app `model-inference-examples/
+recommendation-inference`: NueralCFModel.scala / NueralCFJModel.java load
+a pre-trained NeuralCF into an (Abstract)InferenceModel, `preProcess`
+turns a `List<UserItemPair>` into input tensors, and SimpleDriver
+predicts pairs (1,2)..(9,10) and prints the scores.
+
+Usage:
+    python examples/model_inference/recommendation_inference.py \
+        [--model-path p] [--train-first]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def train_and_save(model_path, n_users=40, n_items=60, epochs=12, seed=0):
+    """Produce the pre-trained ncf model the reference assumes exists
+    (its README points at a model trained by the recommendation example)."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_zoo_context("ncf-training", seed=seed)
+    rng = np.random.default_rng(seed)
+    # preference structure: user u likes item i iff (u + i) % 3 == 0
+    users = rng.integers(0, n_users, 4096)
+    items = rng.integers(0, n_items, 4096)
+    labels = ((users + items) % 3 == 0).astype(np.int32)
+    ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+                   hidden_layers=(20, 10))
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    ncf.fit([users, items], labels, batch_size=256, nb_epoch=epochs)
+    ncf.save_model(model_path)
+    return ncf.evaluate([users, items], labels, batch_size=256)["accuracy"]
+
+
+class NeuralCFInferenceModel:
+    """Reference NueralCFJModel: wraps InferenceModel, owns the
+    UserItemPair -> tensor preprocess."""
+
+    def __init__(self, concurrent_num=4):
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+        self._inference = InferenceModel(concurrent_num=concurrent_num)
+
+    def load(self, model_path):
+        self._inference.load(model_path)
+        return self
+
+    @staticmethod
+    def pre_process(user_item_pairs):
+        """List of (user, item) -> the model's two int input arrays
+        (reference preProcess builds List<List<JTensor>>)."""
+        pairs = np.asarray(list(user_item_pairs), np.int32)
+        return [pairs[:, 0], pairs[:, 1]]
+
+    def predict(self, user_item_pairs):
+        inputs = self.pre_process(user_item_pairs)
+        return np.asarray(self._inference.predict(inputs))
+
+
+def run(model_path=None, train_first=True):
+    """SimpleDriver.java: load, predict pairs (1,2)..(9,10), print."""
+    model_path = model_path or "/tmp/zoo_ncf_inference/ncf.zoo"
+    os.makedirs(os.path.dirname(model_path), exist_ok=True)
+    train_acc = None
+    if train_first or not os.path.exists(model_path):
+        train_acc = train_and_save(model_path)
+    rcm = NeuralCFInferenceModel().load(model_path)
+    pairs = [(i, i + 1) for i in range(1, 10)]
+    probs = rcm.predict(pairs)
+    for (u, it), p in zip(pairs, probs):
+        print(f"user={u} item={it} scores={np.round(p, 4).tolist()}")
+    return train_acc, probs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-path", default=None)
+    ap.add_argument("--train-first", action="store_true", default=True)
+    args = ap.parse_args()
+    run(args.model_path, args.train_first)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    main()

@@ -1,0 +1,192 @@
+"""Text-classification inference app — train, simple driver, web service.
+
+Mirror of the reference apps `model-inference-examples/
+text-classification-training` (TextClassificationTrainer.scala: train a
+CNN text classifier, save for deployment) and `text-classification-
+inference` (TextClassificationModel.java: an AbstractInferenceModel
+subclass owning the text preprocess; SimpleDriver.java: batch predict;
+WebServiceDriver.java + WebServiceController.java: an HTTP POST /predict
+endpoint).  The JVM/Spring stack becomes: InferenceModel subclass with
+the preprocess inside, plus a stdlib http.server service.
+
+Usage:
+    python examples/model_inference/text_classification.py train --out d/
+    python examples/model_inference/text_classification.py simple --dir d/
+    python examples/model_inference/text_classification.py serve --dir d/
+"""
+
+import argparse
+import json
+import os
+import threading
+
+import numpy as np
+
+SEQUENCE_LENGTH = 100
+TOKEN_LENGTH = 64
+
+
+def _corpus(n_classes=4, n_docs=400, seed=0):
+    # class-specific token families (same synthetic scheme as
+    # examples/textclassification/train.py — no news20 archive in sandbox)
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n_docs):
+        c = int(rng.integers(n_classes))
+        words = [f"w{c}_{int(rng.integers(30))}" for _ in range(20)] \
+            + [f"c{int(rng.integers(50))}" for _ in range(10)]
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(c)
+    return texts, labels, n_classes
+
+
+def train_and_save(out_dir, epochs=10, encoder="cnn"):
+    """The text-classification-training app: fit and export model +
+    word index for the inference side (TextClassificationTrainer.scala
+    saves the bigdl model; we also persist the dictionary)."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+    init_zoo_context("text-classification-training", seed=0)
+    texts, labels, n_classes = _corpus()
+    ts = TextSet.from_texts(texts, labels).tokenize().normalize() \
+        .word2idx(max_words_num=20000).shape_sequence(SEQUENCE_LENGTH)
+    model = TextClassifier(
+        class_num=n_classes, token_length=TOKEN_LENGTH,
+        sequence_length=SEQUENCE_LENGTH, encoder=encoder,
+        vocab_size=len(ts.get_word_index()) + 1)
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(ts.to_feature_set(), batch_size=32, nb_epoch=epochs)
+    acc = model.evaluate(ts.to_feature_set(), batch_size=32)["accuracy"]
+    os.makedirs(out_dir, exist_ok=True)
+    model.save_model(os.path.join(out_dir, "text-classification.zoo"))
+    ts.save_word_index(os.path.join(out_dir, "word_index.txt"))
+    return acc
+
+
+class TextClassificationModel:
+    """The inference-side model: preprocess lives WITH the model
+    (reference TextClassificationModel.java extends AbstractInferenceModel
+    and owns tokenize→index→pad), predict goes through the pooled
+    InferenceModel runner."""
+
+    def __init__(self, model_dir, concurrent_num=4):
+        from analytics_zoo_tpu.feature.text import TextSet
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+        self._inference = InferenceModel(concurrent_num=concurrent_num)
+        self._inference.load(
+            os.path.join(model_dir, "text-classification.zoo"))
+        self._word_index = TextSet.from_texts([]).load_word_index(
+            os.path.join(model_dir, "word_index.txt")).get_word_index()
+
+    def preprocess(self, text):
+        """text -> (SEQUENCE_LENGTH,) int32 ids (reference
+        TextProcessor.java: tokenize, stopword-strip, index, pad)."""
+        from analytics_zoo_tpu.feature.text import TextSet
+
+        ts = TextSet.from_texts([text]).tokenize().normalize() \
+            .word2idx(existing_map=self._word_index) \
+            .shape_sequence(SEQUENCE_LENGTH)
+        return ts.features[0].indices.astype(np.int32)
+
+    def predict(self, texts):
+        batch = np.stack([self.preprocess(t) for t in texts])
+        return np.asarray(self._inference.predict(batch))
+
+
+def run_simple(model_dir, texts=None):
+    """SimpleDriver.java: load once, predict a couple of documents."""
+    model = TextClassificationModel(model_dir)
+    if texts is None:
+        raw, labels, _ = _corpus(n_docs=8, seed=7)
+        texts = raw[:4]
+    probs = model.predict(texts)
+    preds = probs.argmax(axis=1)
+    for t, p, pr in zip(texts, preds, probs):
+        print(f"pred={int(p)} probs={np.round(pr, 3).tolist()} "
+              f"text={t[:40]}...")
+    return probs
+
+
+def serve(model_dir, port=0):
+    """WebServiceDriver.java: HTTP service, POST /predict with a JSON
+    body {"text": ...} (or a list) -> class probabilities.  Returns the
+    live server so callers/tests can post against it and shut it down."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    model = TextClassificationModel(model_dir)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/predict":
+                self.send_error(404)
+                return
+            try:
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                texts = body["text"]
+                if isinstance(texts, str):
+                    texts = [texts]
+                probs = model.predict(texts)
+                out = {"predictions": probs.argmax(1).tolist(),
+                       "probabilities": probs.tolist()}
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except Exception as e:  # noqa: BLE001 — surface as HTTP 400
+                self.send_error(400, str(e))
+
+        def log_message(self, *a):  # quiet CI
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def post_predict(port, texts):
+    """A minimal client for the web service (the reference README's
+    curl call)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"text": texts}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("mode", choices=("train", "simple", "serve"))
+    ap.add_argument("--dir", default="/tmp/zoo_text_classification")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args()
+    if args.mode == "train":
+        acc = train_and_save(args.out or args.dir, epochs=args.epochs)
+        print("train accuracy:", round(acc, 4))
+    elif args.mode == "simple":
+        run_simple(args.dir)
+    else:
+        server = serve(args.dir, port=args.port)
+        print(f"serving on :{server.server_address[1]} — POST /predict")
+        server.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    main()

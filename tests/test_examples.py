@@ -407,3 +407,41 @@ def test_image_augmentation_3d_notebook_runs():
     assert ns["pipeline_data"].shape == (5, 40, 40, 1)
     assert ns["batch"]["x"].shape == (2, 5, 40, 40, 1)
     assert ns["center"].shape == (3, 32, 32, 1)
+
+
+def test_model_inference_text_classification_app():
+    import tempfile
+
+    from examples.model_inference import text_classification as app
+
+    d = tempfile.mkdtemp(prefix="zoo_tc_app_")
+    acc = app.train_and_save(d, epochs=8)
+    assert acc > 0.8, acc
+    probs = app.run_simple(d)
+    assert probs.shape[1] == 4
+    server = app.serve(d, port=0)
+    try:
+        out = app.post_predict(server.server_address[1],
+                               ["w0_1 w0_2 w0_3 c1", "w2_9 w2_8 c4"])
+        assert len(out["predictions"]) == 2
+        assert len(out["probabilities"][0]) == 4
+    finally:
+        server.shutdown()
+
+
+def test_model_inference_recommendation_app():
+    from examples.model_inference.recommendation_inference import run
+
+    train_acc, probs = run(train_first=True)
+    assert train_acc > 0.7, train_acc
+    assert probs.shape == (9, 2)
+
+
+def test_model_inference_streaming_image_classification():
+    from examples.model_inference.streaming_image_classification import run
+
+    results, truth = run(epochs=25, n_stream=5)
+    assert len(results) == 5
+    got = [label for _, (label, _) in sorted(results.items())]
+    correct = sum(1 for g, t in zip(got, truth) if g == t)
+    assert correct >= 4, (got, truth)
