@@ -25,13 +25,19 @@ Semantics: causal masking is *end-aligned* for lq != lk (query i sees keys
 0..(lk-lq)+i), matching the jnp path in ops/attention.py — the decode-style
 convention where q is the tail of the key sequence.
 
-Gradient support: ``flash_attention`` is wrapped in jax.custom_vjp; the
-backward recomputes attention **blockwise** with a lax.scan over key blocks
-(O(Lq·block_k) live memory, the standard flash rematerialisation strategy),
-so long-context training never materializes the (L, L) matrix.  On CPU
-(tests) the forward falls back to the jnp path automatically; set
-``ZOO_FLASH_INTERPRET=1`` to force the actual Pallas kernel in interpret
-mode on CPU (CI routing tests).
+Gradient support: ``flash_attention`` is wrapped in jax.custom_vjp.  The
+forward saves its softmax stats (m, l), so the backward needs no
+stats-recompute pass; on TPU the backward runs as two Pallas kernels
+(``_flash_bwd_pallas``: a dq kernel streaming K/V blocks past each q
+block, and a dk/dv/dbias kernel streaming q blocks past each K/V block)
+whose rematerialized score tiles never leave VMEM.  Elsewhere — CPU, a
+full (Lq, Lk) bias that needs its own O(Lq·Lk) gradient, or kernel
+failure — a blockwise lax.scan over key blocks serves as fallback and
+oracle (O(Lq·block_k) live memory).  Either way long-context training
+never materializes the (L, L) matrix.  On CPU (tests) the forward falls
+back to the jnp path automatically; set ``ZOO_FLASH_INTERPRET=1`` to
+force the actual Pallas kernels in interpret mode on CPU (CI routing +
+grad-oracle tests).
 """
 
 from __future__ import annotations
@@ -432,6 +438,334 @@ def _resolve_blocks(block_q, block_k,
     return block_q, block_k
 
 
+def _resolve_bwd_blocks(block_q, block_k, lq, lk) -> tuple[int, int]:
+    """Backward blocks: 512x512 keeps both kernels' live VMEM ~7 MB at
+    d=128 with dropout (f32 q/g/k/v casts + up to four (bq, bk) f32
+    score/prob/grad tiles + the PRNG-bits tile + (bq|bk, d) accumulators),
+    well under the measured ~16 MB scoped budget that burned the 1024-row
+    forward tuning (see _resolve_blocks).  A caller's SMALLER explicit
+    blocks are honored (the VMEM-pressure escape hatch); anything larger —
+    including the forward's resolved 1024 defaults flowing through
+    _flash_core — is capped at 512 because the backward holds roughly
+    twice the forward's live tiles per step."""
+    return (min(block_q or 512, 512, lq),
+            min(block_k or 512, 512, lk))
+
+
+def _flash_bwd_pallas(q, k, v, g, out, m, l, causal, scale,
+                      block_q=None, block_k=None, interpret=False,
+                      bias=None, q_seg=None, kv_seg=None, dropout_p=0.0,
+                      seed=None):
+    """Pallas flash backward: two kernels, both O(block²) VMEM.
+
+    dq kernel: grid (b, h, n_q, n_k) — a q block accumulates dq across
+    streamed K/V blocks.  dk/dv kernel: grid (b, h, n_k, n_q) — a K/V
+    block accumulates dk/dv (and its bias-grad tile) across streamed q
+    blocks.  Score tiles are rematerialized from q/k in VMEM (standard
+    flash strategy) using the forward's saved softmax stats (m, l), so
+    no stats-recompute pass exists and nothing O(Lq·Lk) ever reaches
+    HBM.  Dropout re-derives the forward's exact keep mask from the
+    `_keep_bits` position hash.
+
+    Bias gradients are emitted per (b, h) as (b, h, 1, lk) partials and
+    reduced outside to the bias's broadcast shape; full (…, Lq, Lk)
+    biases are NOT handled here (their db is itself O(Lq·Lk) — callers
+    fall back to the jnp blockwise path).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    offset = lk - lq
+    bq, bk = _resolve_bwd_blocks(block_q, block_k, lq, lk)
+    n_q = pl.cdiv(lq, bq)
+    n_k = pl.cdiv(lk, bk)
+    has_bias = bias is not None
+    has_seg = q_seg is not None
+    has_drop = dropout_p > 0.0
+    if has_bias:
+        bb, bh, bq_dim, _ = bias.shape
+        if bq_dim > 1:
+            raise ValueError("full (Lq, Lk) bias backward not supported "
+                             "in the Pallas path")
+
+    gf = g.astype(jnp.float32)
+    # D_i = dO_i · O_i (flash-bwd identity; holds under dropout because
+    # O already contains the dropped probabilities)
+    D = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, h, lq)
+    m4 = m.astype(jnp.float32)[..., None]               # (b, h, lq, 1)
+    l4 = jnp.maximum(l.astype(jnp.float32), 1e-20)[..., None]
+    D4 = D[..., None]
+
+    thr = _drop_threshold(dropout_p) if has_drop else None
+    inv_keep = 1.0 / (1.0 - dropout_p) if has_drop else None
+
+    def tiles(q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref, bias_ref,
+              qseg_ref, kseg_ref, seed_ref, bi, hi, qi, ki):
+        """Shared per-(q block, k block) recompute: returns
+        (p_t, ds_raw, ds, qb, kb, gb) — all f32 tiles.  bi/hi/qi/ki are
+        program ids read OUTSIDE any pl.when branch (program_id inside a
+        cond branch cannot lower in interpret mode)."""
+        q_start = qi * bq
+        k_start = ki * bk
+        qb = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        gb = g_ref[0, 0].astype(jnp.float32)
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        q_live = q_pos < lq
+        k_live = k_pos < lk
+        # zero padded rows: OOB block reads are unspecified and a NaN
+        # would poison the accumulations through 0 * NaN
+        qb = jnp.where(q_live, qb, 0.0)
+        gb = jnp.where(q_live, gb, 0.0)
+        kb = jnp.where(k_live.reshape(bk, 1), kb, 0.0)
+        vb = jnp.where(k_live.reshape(bk, 1), vb, 0.0)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        live = q_live & k_live
+        if causal:
+            live = live & (q_pos + offset >= k_pos)
+        if has_seg:
+            live = live & (qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
+        mb = m_ref[0, 0]  # (bq, 1) f32
+        lb = l_ref[0, 0]
+        db_row = d_ref[0, 0]
+        # division and D-subtraction INSIDE the where: padded q rows read
+        # OOB stats (NaN/0 in interpret mode, unspecified on hardware) and
+        # the dk/dv kernel CONTRACTS over q rows — a NaN there would
+        # poison every output element, so masked entries must be exact 0s
+        p = jnp.where(live, jnp.exp(s - mb) / lb, 0.0)
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if has_drop:
+            bits = _keep_bits(seed_ref[0], seed_ref[1], bi, hi,
+                              q_pos, k_pos)
+            t = jnp.where(bits >= thr, inv_keep, 0.0)
+            p_t = p * t
+            ds_raw = jnp.where(live, p * (t * dp - db_row), 0.0)
+        else:
+            p_t = p
+            ds_raw = jnp.where(live, p * (dp - db_row), 0.0)
+        return p_t, ds_raw, ds_raw * scale, qb, kb, gb
+
+    # ---- dq kernel: grid (b, h, n_q, n_k), key blocks innermost --------
+    def dq_kernel(*refs):
+        i = 7
+        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref = refs[:7]
+        bias_ref = qseg_ref = kseg_ref = seed_ref = None
+        if has_bias:
+            bias_ref = refs[i]
+            i += 1
+        if has_seg:
+            qseg_ref, kseg_ref = refs[i:i + 2]
+            i += 2
+        if has_drop:
+            seed_ref = refs[i]
+            i += 1
+        dq_ref, acc_ref = refs[i], refs[i + 1]
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        def compute():
+            _, _, ds, _, kb, _ = tiles(
+                q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
+                bias_ref, qseg_ref, kseg_ref, seed_ref, bi, hi, qi, ki)
+            acc_ref[...] += jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            pl.when(ki * bk <= qi * bq + bq - 1 + offset)(compute)
+        else:
+            compute()
+
+        @pl.when(ki == n_k - 1)
+        def _emit():
+            dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+    # ---- dk/dv kernel: grid (b, h, n_k, n_q), q blocks innermost -------
+    def dkv_kernel(*refs):
+        i = 7
+        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref = refs[:7]
+        bias_ref = qseg_ref = kseg_ref = seed_ref = None
+        if has_bias:
+            bias_ref = refs[i]
+            i += 1
+        if has_seg:
+            qseg_ref, kseg_ref = refs[i:i + 2]
+            i += 2
+        if has_drop:
+            seed_ref = refs[i]
+            i += 1
+        if has_bias:
+            dk_ref, dv_ref, db_ref = refs[i:i + 3]
+            dk_acc, dv_acc, db_acc = refs[i + 3:i + 6]
+        else:
+            dk_ref, dv_ref = refs[i:i + 2]
+            dk_acc, dv_acc = refs[i + 2:i + 4]
+            db_ref = db_acc = None
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
+        ki = pl.program_id(2)
+        qi = pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
+            if has_bias:
+                db_acc[...] = jnp.zeros_like(db_acc)
+
+        def compute():
+            p_t, ds_raw, ds, qb, _, gb = tiles(
+                q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
+                bias_ref, qseg_ref, kseg_ref, seed_ref, bi, hi, qi, ki)
+            dk_acc[...] += jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dv_acc[...] += jax.lax.dot_general(
+                p_t, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_bias:
+                db_acc[...] += jnp.sum(ds_raw, axis=0, keepdims=True)
+
+        if causal:
+            pl.when(ki * bk <= qi * bq + bq - 1 + offset)(compute)
+        else:
+            compute()
+
+        @pl.when(qi == n_q - 1)
+        def _emit():
+            dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+            if has_bias:
+                db_ref[0, 0] = db_acc[...]
+
+    def common_specs(order):
+        """In-specs for q/g/m/l/D + k/v + optionals; ``order`` maps grid
+        ids -> (qi, ki) for the kernel's grid layout."""
+        def im_q(bi, hi, g2, g3):
+            return (bi, hi, order(g2, g3)[0], 0)
+
+        def im_k(bi, hi, g2, g3):
+            return (bi, hi, order(g2, g3)[1], 0)
+
+        def im_row(bi, hi, g2, g3):
+            return (bi, hi, order(g2, g3)[0], 0)
+
+        specs = [
+            pl.BlockSpec((1, 1, bq, d), im_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), im_k, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), im_k, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d), im_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), im_row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), im_row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), im_row, memory_space=pltpu.VMEM),
+        ]
+        args = [q, k, v, gf.astype(q.dtype), m4, l4, D4]
+        if has_bias:
+            specs.append(pl.BlockSpec(
+                (1, 1, 1, bk),
+                lambda bi, hi, g2, g3, _bb=bb, _bh=bh: (
+                    bi if _bb > 1 else 0, hi if _bh > 1 else 0, 0,
+                    order(g2, g3)[1]),
+                memory_space=pltpu.VMEM))
+            args.append(bias.astype(jnp.float32))
+        if has_seg:
+            specs.append(pl.BlockSpec(
+                (1, bq, 8),
+                lambda bi, hi, g2, g3: (bi, order(g2, g3)[0], 0),
+                memory_space=pltpu.VMEM))
+            specs.append(pl.BlockSpec(
+                (1, 8, bk),
+                lambda bi, hi, g2, g3: (bi, 0, order(g2, g3)[1]),
+                memory_space=pltpu.VMEM))
+            args.append(jnp.broadcast_to(
+                q_seg.astype(jnp.int32)[:, :, None], (b, lq, 8)))
+            args.append(jnp.broadcast_to(
+                kv_seg.astype(jnp.int32)[:, None, :], (b, 8, lk)))
+        if has_drop:
+            specs.append(pl.BlockSpec(
+                (2,), lambda bi, hi, g2, g3: (0,),
+                memory_space=pltpu.SMEM))
+            args.append(seed.astype(jnp.int32))
+        return specs, args
+
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
+
+    dq_specs, dq_args = common_specs(lambda g2, g3: (g2, g3))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(*dq_args)
+
+    kv_specs, kv_args = common_specs(lambda g2, g3: (g3, g2))
+    kv_out_specs = [
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, ki, qi: (bi, hi, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, ki, qi: (bi, hi, ki, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    kv_out_shape = [jax.ShapeDtypeStruct(k.shape, k.dtype),
+                    jax.ShapeDtypeStruct(v.shape, v.dtype)]
+    kv_scratch = [pltpu.VMEM((bk, d), jnp.float32),
+                  pltpu.VMEM((bk, d), jnp.float32)]
+    if has_bias:
+        kv_out_specs.append(pl.BlockSpec(
+            (1, 1, 1, bk), lambda bi, hi, ki, qi: (bi, hi, 0, ki),
+            memory_space=pltpu.VMEM))
+        kv_out_shape.append(
+            jax.ShapeDtypeStruct((b, h, 1, n_k * bk), jnp.float32))
+        kv_scratch.append(pltpu.VMEM((1, bk), jnp.float32))
+    res = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, n_k, n_q),
+        in_specs=kv_specs,
+        out_specs=kv_out_specs,
+        out_shape=kv_out_shape,
+        scratch_shapes=kv_scratch,
+        compiler_params=params,
+        interpret=interpret,
+    )(*kv_args)
+    if has_bias:
+        dk, dv, db_part = res
+        db = db_part[..., :lk]  # (b, h, 1, lk) per-(b,h) partials
+        if bb == 1:
+            db = jnp.sum(db, axis=0, keepdims=True)
+        if bh == 1:
+            db = jnp.sum(db, axis=1, keepdims=True)
+        dbias = db.astype(bias.dtype)
+    else:
+        dk, dv = res
+        dbias = None
+    return dq, dk, dv, dbias
+
+
 def _interpret_forced() -> bool:
     return bool(os.environ.get("ZOO_FLASH_INTERPRET"))
 
@@ -456,47 +790,59 @@ def _flash_core(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
                          dropout_p, block_q, block_k)
 
 
+def _warn_fallback_once():
+    # Do NOT silently degrade to the O(L²) path on TPU: warn loudly
+    # (once) with the actual kernel error so a broken kernel is
+    # visible in logs and benchmarks.
+    global _warned_fallback
+    if not _warned_fallback:
+        _warned_fallback = True
+        logging.getLogger("analytics_zoo_tpu").exception(
+            "Pallas flash-attention kernel failed on TPU; falling "
+            "back to the O(L^2) jnp path. THIS IS A PERFORMANCE BUG."
+        )
+
+
 def _forward_impl(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
-                  dropout_p, block_q, block_k):
+                  dropout_p, block_q, block_k, return_stats=False):
     if _pallas_available():
         try:
-            out = _flash_fwd_pallas(
+            res = _flash_fwd_pallas(
                 q, k, v, causal, scale, block_q, block_k,
                 interpret=_interpret_forced(), bias=bias, q_seg=q_seg,
-                kv_seg=kv_seg, dropout_p=dropout_p, seed=seed)
+                kv_seg=kv_seg, dropout_p=dropout_p, seed=seed,
+                return_stats=return_stats)
             invocation_counts["pallas"] += 1
-            return out
+            return res
         except Exception:
-            # Do NOT silently degrade to the O(L²) path on TPU: warn loudly
-            # (once) with the actual kernel error so a broken kernel is
-            # visible in logs and benchmarks.
-            global _warned_fallback
-            if not _warned_fallback:
-                _warned_fallback = True
-                logging.getLogger("analytics_zoo_tpu").exception(
-                    "Pallas flash-attention kernel failed on TPU; falling "
-                    "back to the O(L^2) jnp path. THIS IS A PERFORMANCE BUG."
-                )
+            _warn_fallback_once()
     invocation_counts["fallback"] += 1
-    return _attention_reference(q, k, v, causal, scale, bias=bias,
-                                q_seg=q_seg, kv_seg=kv_seg,
-                                dropout_p=dropout_p, seed=seed)
+    out = _attention_reference(q, k, v, causal, scale, bias=bias,
+                               q_seg=q_seg, kv_seg=kv_seg,
+                               dropout_p=dropout_p, seed=seed)
+    return (out, None, None) if return_stats else out
 
 
 def _fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, scale, dropout_p,
          block_q, block_k):
-    out = _flash_core(q, k, v, bias, q_seg, kv_seg, seed, causal, scale,
-                      dropout_p, block_q, block_k)
-    return out, (q, k, v, bias, q_seg, kv_seg, seed, out)
+    # Save the softmax stats (m, l) alongside the output: the backward
+    # then needs no stats-recompute pass (a full extra QK^T sweep).
+    out, m, l = _forward_impl(q, k, v, bias, q_seg, kv_seg, seed, causal,
+                              scale, dropout_p, block_q, block_k,
+                              return_stats=True)
+    return out, (q, k, v, bias, q_seg, kv_seg, seed, out, m, l)
 
 
 def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
-    """Blockwise flash backward: lax.scan over key blocks, recomputing each
+    """Flash backward.  On TPU (stats saved by the Pallas forward):
+    `_flash_bwd_pallas` — two streaming kernels whose score tiles never
+    leave VMEM.  Otherwise (CPU, full-(Lq,Lk)-bias grad, or kernel
+    failure): blockwise lax.scan over key blocks, recomputing each
     (lq, block_k) score tile from q/k (rematerialisation).  Live memory is
-    O(lq·block_k + lk·d); the (lq, lk) matrix is never materialized.
-    Dropout is re-derived from the same `_keep_bits` hash the forward used,
-    so no mask is stored."""
-    q, k, v, bias, q_seg, kv_seg, seed, out = res
+    O(lq·block_k + lk·d) either way; the (lq, lk) matrix is never
+    materialized.  Dropout is re-derived from the same `_keep_bits` hash
+    the forward used, so no mask is stored."""
+    q, k, v, bias, q_seg, kv_seg, seed, out, m_s, l_s = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale_v = 1.0 / math.sqrt(d) if scale is None else scale
@@ -504,11 +850,31 @@ def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
     has_bias = bias is not None
     has_seg = q_seg is not None
     has_drop = dropout_p > 0.0
-    # The backward keeps its own 256 default: its scan materializes
+
+    dseg_q = (np.zeros(q_seg.shape, dtype=jax.dtypes.float0)
+              if has_seg else None)
+    dseg_kv = (np.zeros(kv_seg.shape, dtype=jax.dtypes.float0)
+               if has_seg else None)
+    dseed = (np.zeros(seed.shape, dtype=jax.dtypes.float0)
+             if seed is not None else None)
+
+    full_bias = has_bias and bias.shape[2] > 1
+    if m_s is not None and _pallas_available() and not full_bias:
+        try:
+            dq, dk, dv, dbias = _flash_bwd_pallas(
+                q, k, v, g, out, m_s, l_s, causal, scale_v,
+                block_q=block_q, block_k=block_k,
+                interpret=_interpret_forced(), bias=bias, q_seg=q_seg,
+                kv_seg=kv_seg, dropout_p=dropout_p, seed=seed)
+            invocation_counts["pallas"] += 1
+            return (dq, dk, dv, dbias, dseg_q, dseg_kv, dseed)
+        except Exception:
+            _warn_fallback_once()
+    # The fallback scan keeps its own 256 cap: it materializes
     # (b, h, lq, bk) f32 score/grad tiles in HBM, so the forward kernel's
     # 1024 tuning would quadruple live memory and can OOM long-context
-    # training.  An explicit block_k still applies to both directions.
-    bk = min(block_k if block_k is not None else 256, lk)
+    # training.  A caller's SMALLER explicit block_k is honored.
+    bk = min(block_k or 256, 256, lk)
     n_k = -(-lk // bk)
     pad = n_k * bk - lk
 
@@ -560,10 +926,15 @@ def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
             jnp.where(live, jnp.exp(s - new_m[..., None]), 0.0), axis=-1)
         return (new_m, l), None
 
-    m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
-    (m, l), _ = jax.lax.scan(stats_step, (m0, l0),
-                             (kb_s, kpos_s, bias_s, kseg_s))
+    if m_s is not None:
+        # forward already saved the softmax stats — pass 1 unnecessary
+        m = m_s.astype(jnp.float32)
+        l = l_s.astype(jnp.float32)
+    else:
+        m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
+        l0 = jnp.zeros((b, h, lq), jnp.float32)
+        (m, l), _ = jax.lax.scan(stats_step, (m0, l0),
+                                 (kb_s, kpos_s, bias_s, kseg_s))
     l_safe = jnp.maximum(l, 1e-20)
     # D_i = sum_j P~_ij (dO_i · V_j) = dO_i · O_i  (flash-bwd identity;
     # holds with dropout because O already contains the dropped P~)
@@ -618,12 +989,6 @@ def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
             bb, bh, bq, n_k * bk)[..., :lk].astype(bias.dtype)
     else:
         dbias = None
-    dseg_q = (np.zeros(q_seg.shape, dtype=jax.dtypes.float0)
-              if has_seg else None)
-    dseg_kv = (np.zeros(kv_seg.shape, dtype=jax.dtypes.float0)
-               if has_seg else None)
-    dseed = (np.zeros(seed.shape, dtype=jax.dtypes.float0)
-             if seed is not None else None)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             dbias, dseg_q, dseg_kv, dseed)
 

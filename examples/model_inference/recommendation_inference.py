@@ -81,7 +81,9 @@ def run(model_path=None, train_first=True):
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-path", default=None)
-    ap.add_argument("--train-first", action="store_true", default=True)
+    ap.add_argument("--train-first", action="store_true",
+                    help="retrain and overwrite even if the model exists "
+                         "(a missing model always trains)")
     args = ap.parse_args()
     run(args.model_path, args.train_first)
 

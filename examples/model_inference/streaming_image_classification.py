@@ -117,11 +117,17 @@ def run(epochs=25, n_stream=6, size=32, spool_dir=None):
     feeder = threading.Thread(target=source, daemon=True)
     feeder.start()
 
+    def frame_idx(fname):
+        return int(fname.split("-")[1].split(".")[0])
+
     results, seen = {}, set()
     deadline = time.monotonic() + 120
     while len(results) < n_stream and time.monotonic() < deadline:
-        pending = sorted(f for f in os.listdir(spool)
-                         if f.endswith(".npy") and f not in seen)
+        # only completed frames: the feeder writes .tmp-*.npy then
+        # os.replace()s to frame-*.npy atomically
+        pending = sorted((f for f in os.listdir(spool)
+                          if f.startswith("frame-") and f not in seen),
+                         key=frame_idx)
         if not pending:
             time.sleep(0.05)
             continue
@@ -133,7 +139,8 @@ def run(epochs=25, n_stream=6, size=32, spool_dir=None):
     op.close()
 
     truth = [labels[int(c)] for c in y[:n_stream]]
-    for i, (fname, (label, p)) in enumerate(sorted(results.items())):
+    ordered = sorted(results.items(), key=lambda kv: frame_idx(kv[0]))
+    for i, (fname, (label, p)) in enumerate(ordered):
         print(f"{fname}: {label} ({p:.3f}) truth={truth[i]}")
     return results, truth
 

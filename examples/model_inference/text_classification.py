@@ -113,10 +113,11 @@ def run_simple(model_dir, texts=None):
     return probs
 
 
-def serve(model_dir, port=0):
+def serve(model_dir, port=0, background=True):
     """WebServiceDriver.java: HTTP service, POST /predict with a JSON
-    body {"text": ...} (or a list) -> class probabilities.  Returns the
-    live server so callers/tests can post against it and shut it down."""
+    body {"text": ...} (or a list) -> class probabilities.  With
+    ``background=True`` returns the live server (callers/tests post
+    against it and shut it down); otherwise blocks in serve_forever."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     model = TextClassificationModel(model_dir)
@@ -148,6 +149,10 @@ def serve(model_dir, port=0):
             pass
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    if not background:
+        print(f"serving on :{server.server_address[1]} — POST /predict")
+        server.serve_forever()
+        return server
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server
@@ -180,9 +185,7 @@ def main():
     elif args.mode == "simple":
         run_simple(args.dir)
     else:
-        server = serve(args.dir, port=args.port)
-        print(f"serving on :{server.server_address[1]} — POST /predict")
-        server.serve_forever()
+        serve(args.dir, port=args.port, background=False)
 
 
 if __name__ == "__main__":

@@ -442,6 +442,8 @@ def test_model_inference_streaming_image_classification():
 
     results, truth = run(epochs=25, n_stream=5)
     assert len(results) == 5
-    got = [label for _, (label, _) in sorted(results.items())]
+    got = [label for _, (label, _) in
+           sorted(results.items(),
+                  key=lambda kv: int(kv[0].split("-")[1].split(".")[0]))]
     correct = sum(1 for g, t in zip(got, truth) if g == t)
     assert correct >= 4, (got, truth)

@@ -28,6 +28,7 @@ from analytics_zoo_tpu.ops.pallas.flash_attention import (
     _attention_reference,
     _flash_fwd_pallas,
     _resolve_blocks,
+    flash_attention,
 )
 
 FETCH_S = 0.070  # tunnel fixed fetch latency (PROFILE_r03/ANALYSIS.md)
@@ -109,6 +110,23 @@ def main():
             eff_flops = flops * (0.5 if kw.get("causal") else 1.0)
             row[name] = {"ms": round(t * 1e3, 2),
                          "tflops": round(eff_flops / t / 1e12, 1)}
+            # full train step (fwd + Pallas bwd kernels) through the
+            # public custom_vjp: grad wrt q
+            def fl_pub(q, k, v, kw=kw):
+                return flash_attention(
+                    q, k, v, kw.get("causal", False), scale,
+                    bias=kw.get("bias"), q_segment_ids=kw.get("q_seg"),
+                    kv_segment_ids=kw.get("kv_seg"),
+                    dropout_p=kw.get("dropout_p", 0.0),
+                    dropout_seed=seed if kw.get("dropout_p") else None)
+
+            grad_fn = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fl_pub(q, k, v).astype(jnp.float32) ** 2))
+            t_tr = timed(grad_fn, q, k, v)
+            row[name]["train_ms"] = round(t_tr * 1e3, 2)
+            row[name]["train_tflops"] = round(
+                eff_flops * 3.5 / t_tr / 1e12, 1)  # fwd 2 + bwd 5 matmuls
         row["train_vs_clean"] = round(
             row["train_mask_dropout"]["tflops"] / row["clean"]["tflops"], 3)
 
