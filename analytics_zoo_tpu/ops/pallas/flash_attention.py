@@ -478,6 +478,7 @@ def _flash_bwd_pallas(q, k, v, g, out, m, l, causal, scale,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     offset = lk - lq
+    invocation_counts["pallas"] += 1
     bq, bk = _resolve_bwd_blocks(block_q, block_k, lq, lk)
     n_q = pl.cdiv(lq, bq)
     n_k = pl.cdiv(lk, bk)
@@ -866,7 +867,6 @@ def _bwd(causal, scale, dropout_p, block_q, block_k, res, g):
                 block_q=block_q, block_k=block_k,
                 interpret=_interpret_forced(), bias=bias, q_seg=q_seg,
                 kv_seg=kv_seg, dropout_p=dropout_p, seed=seed)
-            invocation_counts["pallas"] += 1
             return (dq, dk, dv, dbias, dseg_q, dseg_kv, dseed)
         except Exception:
             _warn_fallback_once()

@@ -36,6 +36,8 @@ from jax.sharding import PartitionSpec as P
 from analytics_zoo_tpu.common.engine import SEQ_AXIS, get_zoo_context
 from analytics_zoo_tpu.ops.pallas.flash_attention import (
     _attention_stats_reference,
+    _flash_bwd_pallas,
+    _interpret_forced,
     _pallas_available,
     attention_stats,
 )
@@ -144,6 +146,93 @@ def _ring_vjp_fwd(ql, kl, vl, axis_name, n_shards, causal, scale,
 _BWD_CHUNK = 256
 
 
+def _flash_hop_bwd(ql, k_blk, v_blk, g, out, m, l, causal, scale):
+    """One hop's (dq, dk, dv) through the Pallas backward kernels, using
+    the ring's saved GLOBAL softmax stats (the kernels take m/l as inputs
+    precisely so partial-attention backwards compose this way)."""
+    dq, dk, dv, _ = _flash_bwd_pallas(
+        ql, k_blk, v_blk, g, out, m, l, causal, scale,
+        interpret=_interpret_forced())
+    return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+            dv.astype(jnp.float32))
+
+
+def _zero_hop_grads(ql, k_blk, v_blk):
+    return (jnp.zeros(ql.shape, jnp.float32),
+            jnp.zeros(k_blk.shape, jnp.float32),
+            jnp.zeros(v_blk.shape, jnp.float32))
+
+
+def _hop_grads_flash(ql, k_blk, v_blk, g, out, m, l, kv_idx, my, causal,
+                     scale):
+    """Contiguous-layout hop gradients via the Pallas kernels: full
+    attend for past key blocks, causal diagonal for the own block, all
+    zeros (no MXU work) for future blocks — mirroring `_hop_stats`."""
+    if not causal:
+        return _flash_hop_bwd(ql, k_blk, v_blk, g, out, m, l, False,
+                              scale)
+
+    def full(_):
+        return _flash_hop_bwd(ql, k_blk, v_blk, g, out, m, l, False,
+                              scale)
+
+    def diag(_):
+        return _flash_hop_bwd(ql, k_blk, v_blk, g, out, m, l, True,
+                              scale)
+
+    def skip(_):
+        return _zero_hop_grads(ql, k_blk, v_blk)
+
+    branch = jnp.where(kv_idx < my, 0, jnp.where(kv_idx == my, 1, 2))
+    return lax.switch(branch, (full, diag, skip), None)
+
+
+def _zz_quadrant_bwd(qp, kp, vp, gp, op, mp, lp, q_id, k_id, scale):
+    """Backward of one zigzag (query piece, key piece) quadrant whose
+    order is only known at run time — mirrors `_zz_quadrant`."""
+    def full(_):
+        return _flash_hop_bwd(qp, kp, vp, gp, op, mp, lp, False, scale)
+
+    def diag(_):
+        return _flash_hop_bwd(qp, kp, vp, gp, op, mp, lp, True, scale)
+
+    def skip(_):
+        return _zero_hop_grads(qp, kp, vp)
+
+    branch = jnp.where(k_id < q_id, 0, jnp.where(k_id == q_id, 1, 2))
+    return lax.switch(branch, (full, diag, skip), None)
+
+
+def _zz_hop_grads_flash(ql, k_blk, v_blk, g, out, m, l, kv_owner, my, n,
+                        scale):
+    """Zigzag hop gradients via the Pallas kernels, quadrant by quadrant
+    (mirrors `_zz_hop_stats`'s static/run-time case split): the low-id
+    query piece never attends the high-id key piece (static skip); the
+    high-id query piece always fully attends the low-id key piece; the
+    low-low and high-high pairs branch at run time."""
+    half = ql.shape[2] // 2
+    q_lo, q_hi = _zz_piece_ids(my, n)
+    k_lo, k_hi = _zz_piece_ids(kv_owner, n)
+    qa, qb = ql[:, :, :half], ql[:, :, half:]
+    ka, kb = k_blk[:, :, :half], k_blk[:, :, half:]
+    va, vb = v_blk[:, :, :half], v_blk[:, :, half:]
+    ga, gb = g[:, :, :half], g[:, :, half:]
+    oa, ob = out[:, :, :half], out[:, :, half:]
+    ma, mb = m[:, :, :half], m[:, :, half:]
+    la, lb = l[:, :, :half], l[:, :, half:]
+
+    dqa, dka_1, dva_1 = _zz_quadrant_bwd(qa, ka, va, ga, oa, ma, la,
+                                         q_lo, k_lo, scale)
+    dqb_1, dka_2, dva_2 = _flash_hop_bwd(qb, ka, va, gb, ob, mb, lb,
+                                         False, scale)
+    dqb_2, dkb, dvb = _zz_quadrant_bwd(qb, kb, vb, gb, ob, mb, lb,
+                                       q_hi, k_hi, scale)
+    dq = jnp.concatenate([dqa, dqb_1 + dqb_2], axis=2)
+    dk = jnp.concatenate([dka_1 + dka_2, dkb], axis=2)
+    dv = jnp.concatenate([dva_1 + dva_2, dvb], axis=2)
+    return dq, dk, dv
+
+
 def _ring_vjp_bwd(axis_name, n_shards, causal, scale, zigzag, res, g):
     """Reverse ring: rematerialize each hop's score tile from (q, k_blk)
     and the saved GLOBAL softmax stats (m, l); dK/dV accumulators ride the
@@ -206,11 +295,28 @@ def _ring_vjp_bwd(axis_name, n_shards, causal, scale, zigzag, res, g):
             b, h, n_ck * ck, d)[:, :, :lc]
         return dq_h, dk_h, dv_h
 
+    # Pallas hop backward when the inner kernel served the forward: the
+    # kernels take the GLOBAL (m, l) as inputs, so each hop's partial
+    # backward composes exactly; score tiles stay in VMEM instead of the
+    # jnp chunk scan's HBM round-trips (the jnp path remains the
+    # fallback and oracle).  Zigzag pieces are half-length, so gate on
+    # the piece size.
+    piece = lc // 2 if zigzag else lc
+    use_flash_bwd = (_pallas_available() and d % 64 == 0 and piece >= 128)
+
     def step(carry, i):
         dq, k_blk, v_blk, dk_rot, dv_rot = carry
         kv_idx = (my - i) % n_shards
 
-        if causal and not zigzag:
+        if use_flash_bwd and zigzag:
+            dq_h, dk_h, dv_h = _zz_hop_grads_flash(
+                ql, k_blk, v_blk, g, out, m, l, kv_idx, my, n_shards,
+                scale)
+        elif use_flash_bwd:
+            dq_h, dk_h, dv_h = _hop_grads_flash(
+                ql, k_blk, v_blk, g, out, m, l, kv_idx, my, causal,
+                scale)
+        elif causal and not zigzag:
             def work(_):
                 return hop_grads(kv_idx, k_blk, v_blk)
 
