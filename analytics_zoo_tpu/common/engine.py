@@ -59,6 +59,9 @@ class ZooConfig:
                                train steps per fit() into this directory
       ZOO_PROFILE_STEPS        steps per captured trace (default 5)
       ZOO_INFEED_DEPTH         host->device feeder queue depth (default 2)
+      ZOO_SHARD_OPTIMIZER      "1": ZeRO-1 — shard optimizer state over
+                               the data axis (1/n memory + update compute
+                               per chip; params stay replicated)
     """
 
     app_name: str = "analytics-zoo-tpu"
@@ -74,6 +77,10 @@ class ZooConfig:
     profile_dir: str | None = None
     profile_steps: int | None = None
     infeed_depth: int | None = None
+    # ZeRO-1: shard optimizer state (Adam moments) over the data axis via
+    # GSPMD sharding constraints — 1/n optimizer memory and update compute
+    # per chip; parameters stay replicated.  Env: ZOO_SHARD_OPTIMIZER=1.
+    shard_optimizer: bool | None = None
 
     def __post_init__(self):
         env = os.environ
@@ -91,6 +98,8 @@ class ZooConfig:
             self.profile_steps, "ZOO_PROFILE_STEPS", 5)
         self.infeed_depth = resolve(
             self.infeed_depth, "ZOO_INFEED_DEPTH", 2)
+        self.shard_optimizer = bool(resolve(
+            self.shard_optimizer, "ZOO_SHARD_OPTIMIZER", False))
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
 

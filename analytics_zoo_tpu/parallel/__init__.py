@@ -33,5 +33,6 @@ from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
 from analytics_zoo_tpu.parallel.strategies import (  # noqa: F401
     column_parallel_dense,
     make_shard_map_train_step,
+    make_zero1_train_step,
     row_parallel_dense,
 )

@@ -47,6 +47,7 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.engine import (
+    DATA_AXIS,
     ZooContext,
     cast_floats,
     get_zoo_context,
@@ -321,6 +322,32 @@ class Estimator:
         self.history: list[dict] = []
 
     # ------------------------------------------------------------------
+    # ZeRO-1 optimizer-state sharding (ZOO_SHARD_OPTIMIZER)
+    # ------------------------------------------------------------------
+    def _shard_optimizer_on(self) -> bool:
+        return bool(self.ctx.config.shard_optimizer) \
+            and self.ctx.data_parallel_size > 1
+
+    def _opt_sharding_of(self, leaf):
+        """Per-leaf placement: shard dim 0 over the data axis when it
+        divides evenly (Adam moments mirror param shapes), else
+        replicate (scalar step counts, ragged leaves)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        dp = self.ctx.data_parallel_size
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] > 0 and leaf.shape[0] % dp == 0:
+            return NamedSharding(self.ctx.mesh, PartitionSpec(DATA_AXIS))
+        return self.ctx.replicated()
+
+    def _place_opt_state(self, opt_state):
+        if not self._shard_optimizer_on():
+            return jax.device_put(opt_state, self.ctx.replicated())
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self._opt_sharding_of(leaf)),
+            opt_state)
+
+    # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
     def _build_train_step(self, device_transform=None):
@@ -338,6 +365,9 @@ class Estimator:
                     if k in frozen else v)
                 for k, v in tree.items()
             }
+
+        opt_shardings = (self._opt_sharding_of
+                         if self._shard_optimizer_on() else None)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, state, seed, step, batch):
@@ -378,6 +408,15 @@ class Estimator:
                 grads = _mask_frozen(grads)
             grads = _clip_grads(grads, grad_clip)
             updates, opt_state = opt.update(grads, opt_state, params)
+            if opt_shardings is not None:
+                # ZeRO-1 via GSPMD: pinning the optimizer state's layout
+                # to the data axis makes XLA partition the moment updates
+                # (and reduce-scatter the grads feeding them) instead of
+                # computing the full update redundantly on every chip;
+                # params stay replicated (one all-gather of updates).
+                opt_state = jax.tree_util.tree_map(
+                    lambda leaf: jax.lax.with_sharding_constraint(
+                        leaf, opt_shardings(leaf)), opt_state)
             if frozen:
                 updates = _mask_frozen(updates)
             params = optax.apply_updates(params, updates)
@@ -460,9 +499,8 @@ class Estimator:
         opt_state = (self._opt_state if self._opt_state is not None
                      else self.optimizer.init(params))
         repl = ctx.replicated()
-        params, opt_state, state = jax.device_put(
-            (params, opt_state, state), repl
-        )
+        params, state = jax.device_put((params, state), repl)
+        opt_state = self._place_opt_state(opt_state)
         dev_tf = getattr(train_set, "device_transform", None)
         if self._train_step_fn is None or self._train_step_fn[0] is not dev_tf:
             self._train_step_fn = (dev_tf, self._build_train_step(dev_tf))
@@ -477,7 +515,7 @@ class Estimator:
                 jax.tree_util.tree_structure(opt_state),
                 [jnp.asarray(x) for x in resumed["opt_flat"]],
             )
-            opt_state = jax.device_put(opt_state, repl)
+            opt_state = self._place_opt_state(opt_state)
             state = jax.device_put(resumed["state"], repl)
             self.global_step = int(resumed["global_step"])
             start_epoch = int(resumed["epoch"])
