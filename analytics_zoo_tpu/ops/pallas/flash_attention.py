@@ -522,8 +522,13 @@ def _flash_bwd_pallas(q, k, v, g, out, m, l, causal, scale,
         # would poison the accumulations through 0 * NaN
         qb = jnp.where(q_live, qb, 0.0)
         gb = jnp.where(q_live, gb, 0.0)
-        kb = jnp.where(k_live.reshape(bk, 1), kb, 0.0)
-        vb = jnp.where(k_live.reshape(bk, 1), vb, 0.0)
+        # column-oriented mask built directly from iota: reshaping the
+        # (1, bk) i1 vector is a Mosaic "insert minor dim" op that only
+        # lowers for 32-bit types on real TPU
+        k_live_col = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (bk, 1), 0)) < lk
+        kb = jnp.where(k_live_col, kb, 0.0)
+        vb = jnp.where(k_live_col, vb, 0.0)
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale

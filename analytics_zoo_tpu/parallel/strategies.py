@@ -34,8 +34,12 @@ def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
     TPU translation of the reference's two Spark jobs (local
     forward/backward, then gradient slice aggregation) into one SPMD
     program with a single collective."""
-    from analytics_zoo_tpu.pipeline.estimator.estimator import _clip_grads
+    from analytics_zoo_tpu.pipeline.estimator.estimator import (
+        _clip_grads,
+        _normalize_grad_clip,
+    )
 
+    grad_clip = _normalize_grad_clip(grad_clip)
     mesh = mesh or get_zoo_context().mesh
 
     def local_step(params, opt_state, state, rng, batch):
@@ -89,6 +93,12 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     """
     from jax.flatten_util import ravel_pytree
 
+    from analytics_zoo_tpu.pipeline.estimator.estimator import (
+        _normalize_grad_clip,
+    )
+
+    # same grad_clip contract as make_shard_map_train_step / the Estimator
+    _clip = _normalize_grad_clip(grad_clip)
     mesh = mesh or get_zoo_context().mesh
     n = mesh.shape[DATA_AXIS]
 
@@ -139,11 +149,13 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         # reduce-scatter: each chip ends with the MEAN of its own slice
         g_shard = jax.lax.psum_scatter(
             flat_g, DATA_AXIS, scatter_dimension=0, tiled=True) / n
-        if grad_clip is not None:
-            # global-norm clip from shard norms: one extra scalar psum
-            gn = jnp.sqrt(jax.lax.psum(jnp.sum(g_shard ** 2), DATA_AXIS))
-            scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gn, 1e-12))
-            g_shard = g_shard * scale
+        if _clip is not None:
+            if _clip[0] == "const":
+                g_shard = jnp.clip(g_shard, _clip[1], _clip[2])
+            else:  # l2norm: global norm from shard norms, one scalar psum
+                gn = jnp.sqrt(jax.lax.psum(jnp.sum(g_shard ** 2), DATA_AXIS))
+                scale = jnp.minimum(1.0, _clip[1] / jnp.maximum(gn, 1e-12))
+                g_shard = g_shard * scale
         flat_p, unravel = ravel_pytree(params)
         p_shard = _shard_of(flat_p)
         updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)

@@ -79,21 +79,36 @@ def _process_shard() -> tuple[int, int] | None:
     return None
 
 
+def _normalize_grad_clip(grad_clip):
+    """Canonical grad-clip spec shared by every train-step builder:
+    ``None | ("l2norm", max) | ("const", lo, hi)``; a bare scalar is
+    accepted as a max-norm.  Tag AND arity are validated here so a bad
+    spec fails at build time, not from inside a jit trace."""
+    if grad_clip is None:
+        return None
+    if not isinstance(grad_clip, (tuple, list)):
+        return ("l2norm", float(grad_clip))
+    t = tuple(grad_clip)
+    if len(t) == 2 and t[0] == "l2norm":
+        return ("l2norm", float(t[1]))
+    if len(t) == 3 and t[0] == "const":
+        return ("const", float(t[1]), float(t[2]))
+    raise ValueError(f"unknown grad clip {grad_clip!r}")
+
+
 def _clip_grads(grads, grad_clip):
+    grad_clip = _normalize_grad_clip(grad_clip)
     if grad_clip is None:
         return grads
-    kind = grad_clip[0]
-    if kind == "const":
+    if grad_clip[0] == "const":
         _, lo, hi = grad_clip
         return jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), grads)
-    if kind == "l2norm":
-        _, max_norm = grad_clip
-        leaves = jax.tree_util.tree_leaves(grads)
-        norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                            for g in leaves))
-        scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
-        return jax.tree_util.tree_map(lambda g: g * scale, grads)
-    raise ValueError(f"unknown grad clip {grad_clip!r}")
+    _, max_norm = grad_clip
+    leaves = jax.tree_util.tree_leaves(grads)
+    norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                        for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
 
 class _DeviceFeeder:
@@ -556,11 +571,14 @@ class Estimator:
                 if resumed is None:
                     raise
                 params = jax.device_put(resumed["params"], repl)
-                opt_state = jax.device_put(
+                # same ZOO_SHARD_OPTIMIZER placement as the initial/resume
+                # sites: restoring replicated here would retrigger the OOM
+                # the ZeRO-1 layout exists to prevent, mid-retry
+                opt_state = self._place_opt_state(
                     jax.tree_util.tree_unflatten(
                         jax.tree_util.tree_structure(opt_state),
                         [jnp.asarray(x) for x in resumed["opt_flat"]],
-                    ), repl)
+                    ))
                 state = jax.device_put(resumed["state"], repl)
                 self.global_step = int(resumed["global_step"])
                 start_epoch = int(resumed["epoch"])

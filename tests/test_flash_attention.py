@@ -380,6 +380,50 @@ def test_attention_stats_matches_reference(causal):
                                    atol=1e-4)
 
 
+import contextlib
+import re
+
+
+@contextlib.contextmanager
+def _mosaic_module_spy():
+    """Capture the raw (pre-serialization) Mosaic module of every pallas
+    kernel lowered inside the block, and on exit reject the op class the
+    chip compiler rejects but client-side lowering does not: a
+    ``vector.shape_cast`` on a sub-32-bit element type that changes the
+    minor dimension ("Insertion of minor dim that is not a no-op only
+    supported for 32-bit types" — apply-vector-layout runs inside libtpu,
+    so without this scan the failure only surfaces on the real chip; it
+    did, twice, in round 4)."""
+    import jax._src.tpu_custom_call as tcc
+
+    captured = []
+    orig = tcc._lower_mosaic_module_to_asm
+
+    def spy(module, *a, **k):
+        captured.append(str(module.operation))
+        return orig(module, *a, **k)
+
+    tcc._lower_mosaic_module_to_asm = spy
+    try:
+        yield
+    finally:
+        tcc._lower_mosaic_module_to_asm = orig
+    pat = re.compile(
+        r"vector\.shape_cast.*?:\s*vector<([0-9x]+)x(i1|i8|i16|bf16|f16)>"
+        r"\s*to\s*vector<([0-9x]+)x(?:i1|i8|i16|bf16|f16)>")
+    bad = []
+    for mod in captured:
+        for m in pat.finditer(mod):
+            src_minor = m.group(1).split("x")[-1]
+            dst_minor = m.group(3).split("x")[-1]
+            if src_minor != dst_minor:
+                bad.append(m.group(0))
+    assert not bad, (
+        "sub-32-bit shape_cast changing the minor dim — lowers client-side "
+        "but Mosaic's apply-vector-layout rejects it on the chip; build the "
+        f"mask in the target orientation with broadcasted_iota instead: {bad}")
+
+
 def test_mosaic_tpu_lowering_all_variants():
     """Cross-lower every production flash configuration for the TPU backend
     (no chip needed: Mosaic's block-shape validation — second-to-last dim
@@ -406,17 +450,19 @@ def test_mosaic_tpu_lowering_all_variants():
                                    dropout_p=0.1, seed=seed),
         "stats": dict(return_stats=True),
     }
-    for name, kw in variants.items():
-        b = kw.get("bias")
-        bq, bk = _resolve_blocks(None, None,
-                                 full_bias=b is not None and b.shape[-2] > 1,
-                                 dropout=kw.get("dropout_p", 0) > 0)
-        causal = kw.pop("causal", False)
+    with _mosaic_module_spy():
+        for name, kw in variants.items():
+            b = kw.get("bias")
+            bq, bk = _resolve_blocks(
+                None, None,
+                full_bias=b is not None and b.shape[-2] > 1,
+                dropout=kw.get("dropout_p", 0) > 0)
+            causal = kw.pop("causal", False)
 
-        def fn(q, kw=kw, causal=causal, bq=bq, bk=bk):
-            return _flash_fwd_pallas(q, q, q, causal, 0.125, bq, bk, **kw)
+            def fn(q, kw=kw, causal=causal, bq=bq, bk=bk):
+                return _flash_fwd_pallas(q, q, q, causal, 0.125, bq, bk, **kw)
 
-        jax.jit(fn).trace(q).lower(lowering_platforms=("tpu",))
+            jax.jit(fn).trace(q).lower(lowering_platforms=("tpu",))
 
 
 @pytest.mark.parametrize("variant", [
@@ -498,13 +544,15 @@ def test_mosaic_tpu_lowering_backward():
 
     os.environ["ZOO_FLASH_INTERPRET"] = "1"  # route custom_vjp to pallas
     try:
-        for name, kw in variants.items():
-            causal = kw.pop("causal", False)
+        with _mosaic_module_spy():
+            for name, kw in variants.items():
+                causal = kw.pop("causal", False)
 
-            def fn(q, kw=kw, causal=causal):
-                return jnp.sum(flash_attention(q, q, q, causal, 0.125,
-                                               **kw) ** 2)
+                def fn(q, kw=kw, causal=causal):
+                    return jnp.sum(flash_attention(q, q, q, causal, 0.125,
+                                                   **kw) ** 2)
 
-            jax.jit(jax.grad(fn)).trace(q).lower(lowering_platforms=("tpu",))
+                jax.jit(jax.grad(fn)).trace(q).lower(
+                    lowering_platforms=("tpu",))
     finally:
         os.environ.pop("ZOO_FLASH_INTERPRET", None)

@@ -708,6 +708,52 @@ def test_zero1_step_matches_plain_dp(zoo_ctx):
                                    rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("clip", [("l2norm", 0.05), ("const", -0.001, 0.001)])
+def test_zero1_grad_clip_contract_matches_plain(zoo_ctx, clip):
+    """Both train-step factories accept the SAME grad_clip spec
+    (('l2norm', max) | ('const', lo, hi) — the Estimator's _clip_grads
+    format) and produce identical parameters; a tight clip makes the
+    assertion sensitive to the clip actually being applied."""
+    from analytics_zoo_tpu.parallel import (
+        make_shard_map_train_step,
+        make_zero1_train_step,
+    )
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.objectives import get_loss
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    rng_np = np.random.default_rng(3)
+    x = rng_np.normal(size=(32, 6)).astype(np.float32)
+    y = (x[:, :2] * 5.0).astype(np.float32)
+
+    model = Sequential()
+    model.add(Dense(2, input_shape=(6,)))
+    params, state = model.build_params(jax.random.PRNGKey(1))
+    loss = get_loss("mse")
+
+    plain = make_shard_map_train_step(model, loss, Adam(lr=0.05),
+                                      grad_clip=clip)
+    z_step, z_init = make_zero1_train_step(model, loss, Adam(lr=0.05),
+                                           grad_clip=clip)
+    opt_plain = Adam(lr=0.05).init(params)
+    opt_z = z_init(params)
+    p1, p2 = params, jax.tree_util.tree_map(jnp.copy, params)
+    s1 = s2 = state
+    key = jax.random.PRNGKey(0)
+    batch = zoo_ctx.shard_batch({"x": x, "y": y})
+    for _ in range(3):
+        p1, opt_plain, s1, l1 = plain(p1, opt_plain, s1, key, batch)
+        p2, opt_z, s2, l2 = z_step(p2, opt_z, s2, key, batch)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    with pytest.raises(ValueError, match="grad clip"):
+        make_zero1_train_step(model, loss, Adam(lr=0.05),
+                              grad_clip=("bogus", 1.0))
+
+
 def test_estimator_zero1_shards_opt_state_and_matches():
     """ZOO_SHARD_OPTIMIZER through the real Estimator path (GSPMD
     sharding constraints): optimizer moments end up sharded over the
