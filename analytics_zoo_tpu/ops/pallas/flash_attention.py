@@ -777,7 +777,14 @@ def _interpret_forced() -> bool:
 
 
 def _pallas_available() -> bool:
-    return jax.default_backend() == "tpu" or _interpret_forced()
+    # ZOO_FLASH_FORCE_PALLAS routes to the REAL (non-interpret) kernels on
+    # any backend — lowering-only CI: tracing + lower(platforms=("tpu",))
+    # then goes through genuine Mosaic lowering with no chip (interpret
+    # mode lowers to plain jax ops and exercises none of it; the round-4
+    # backward cross-lowering guard was vacuous for exactly that reason).
+    # Executing under this knob off-TPU will fail — lower, don't run.
+    return (jax.default_backend() == "tpu" or _interpret_forced()
+            or bool(os.environ.get("ZOO_FLASH_FORCE_PALLAS")))
 
 
 _warned_fallback = False

@@ -101,7 +101,10 @@ def peak_flops_for(device_kind: str) -> float | None:
 
 
 def main():
-    platform, diags = resolve_platform()
+    if os.environ.get("ZOO_BENCH_FORCE_CPU"):
+        platform, diags = "cpu", ["forced CPU rerun after mid-run TPU loss"]
+    else:
+        platform, diags = resolve_platform()
     fell_back = platform == "cpu"
     if fell_back:
         # Force-CPU the same way the test harness does; the axon plugin
@@ -125,11 +128,35 @@ def main():
         diags.append(f"in-process platform is {actual!r} despite probe ok")
     on_tpu = not fell_back
     # CPU fallback: shrink so a diagnostic number lands in minutes.
-    r = run(
-        image_size=224 if on_tpu else 64,
-        per_chip_batch=256 if on_tpu else 16,
-        steps=30 if on_tpu else 5,
-    )
+    try:
+        r = run(
+            image_size=224 if on_tpu else 64,
+            per_chip_batch=256 if on_tpu else 16,
+            steps=30 if on_tpu else 5,
+        )
+    except Exception as e:  # noqa: BLE001
+        # The tunnel can die MID-RUN after a clean probe (observed: perf
+        # stage lost at remote_compile, "connection reset by peer").  The
+        # driver needs a JSON line regardless, and jax cannot re-init a
+        # different backend in-process — re-exec ourselves forced to CPU
+        # and forward that line with the TPU diagnostics attached.
+        if not on_tpu:
+            raise
+        env = dict(os.environ, ZOO_BENCH_FORCE_CPU="1")
+        rr = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                            capture_output=True, text=True, env=env)
+        line = (rr.stdout or "").strip().splitlines()
+        if rr.returncode == 0 and line:
+            try:
+                doc = json.loads(line[-1])
+            except json.JSONDecodeError:
+                raise e  # surface the TPU failure, not the parse noise
+            doc["note"] = "TPU lost mid-run; CPU fallback at reduced size"
+            doc["tpu_init_diagnostics"] = diags + [
+                f"mid-run failure: {str(e).splitlines()[0][:200]}"]
+            print(json.dumps(doc))
+            return
+        raise
     ctx = r["ctx"]
     dp = max(ctx.data_parallel_size, 1)
     per_chip = r["e2e_ips"] / dp

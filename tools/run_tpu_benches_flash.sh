@@ -1,0 +1,33 @@
+#!/bin/bash
+# Follow-up TPU queue for the fixed flash backward kernels: flash +
+# transformer artifacts only (the stages the Mosaic i1-reshape bug killed
+# in the main round-4 queue), then a perf/profile retry if requested
+# (e.g. when the main queue's window was degraded).
+# Usage: bash tools/run_tpu_benches_flash.sh [logdir] [--with-perf]
+#        (arguments may appear in either order)
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_benches_flash
+WITH_PERF=0
+for arg in "$@"; do
+  case "$arg" in
+    --with-perf) WITH_PERF=1 ;;
+    --*) echo "unknown flag: $arg" >&2; exit 2 ;;
+    *) LOG=$arg ;;
+  esac
+done
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+
+run flash 3600 python tools/flash_bench.py
+
+run transformer 4800 python tools/transformer_bench.py \
+  --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
+  --remat --out TRANSFORMER_r04.json
+
+if [ "$WITH_PERF" = 1 ]; then
+  run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
+  run profile 3000 python tools/profile_step.py 256
+fi
+
+echo "$(date) queue complete" | tee -a "$LOG/queue.log"

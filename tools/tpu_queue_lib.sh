@@ -1,0 +1,41 @@
+# Shared TPU bench-queue machinery (sourced by run_tpu_benches*.sh).
+# Lessons encoded here (hard-won, see PERF_r04_STATUS.md):
+# - serialize chip access; ONE client at a time — concurrent clients wedge
+#   the tunnel, and a client killed mid-compile wedges it for hours.
+# - JAX_PLATFORMS=cpu env alone does NOT keep a script off the axon
+#   plugin; only jax.config.update("jax_platforms", "cpu") does.
+# - the tunnel can drop MID-QUEUE: re-probe before every stage and retry
+#   a failed stage once after the tunnel returns; a failure with the TPU
+#   still answering is a bug in the bench and repeats identically, so it
+#   earns no retry.
+# Requires $LOG to be set (and mkdir'd) by the sourcing script.
+
+probe() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+
+wait_for_tpu() {
+  echo "$(date) waiting for TPU..." | tee -a "$LOG/queue.log"
+  until probe; do
+    sleep 120
+  done
+  echo "$(date) TPU answered" | tee -a "$LOG/queue.log"
+}
+
+run() {
+  name=$1; tmo=$2; shift 2
+  for attempt in 1 2; do
+    wait_for_tpu
+    echo "$(date) START $name (attempt $attempt)" | tee -a "$LOG/queue.log"
+    timeout "$tmo" "$@" >"$LOG/$name.log" 2>&1
+    rc=$?  # capture BEFORE $(date) resets $?
+    echo "$(date) DONE $name rc=$rc" | tee -a "$LOG/queue.log"
+    [ "$rc" -eq 0 ] && break
+    if probe; then
+      echo "$(date) $name failed with TPU alive — not retrying" \
+        | tee -a "$LOG/queue.log"
+      break
+    fi
+  done
+}
