@@ -530,12 +530,14 @@ def test_pallas_backward_interpret_matches_reference(variant, monkeypatch):
         np.testing.assert_allclose(db1, db2, rtol=5e-4, atol=5e-4)
 
 
-def test_mosaic_tpu_lowering_backward():
+@pytest.mark.parametrize("D", [64, 128])
+def test_mosaic_tpu_lowering_backward(D):
     """Cross-lower the Pallas BACKWARD kernels for the TPU backend at the
     production shapes — the same no-chip Mosaic block-rule guard as the
     forward test (a bwd-spec regression otherwise only fails on the
-    chip)."""
-    B, H, L, D = 2, 2, 4096, 64
+    chip).  head_dim 128 is the transformer-bench config (hidden 2560 /
+    20 heads); 64 is BERT-base."""
+    B, H, L = 2, 2, 4096
     q = jnp.zeros((B, H, L, D), jnp.bfloat16)
     segs = jnp.zeros((B, L), jnp.int32)
     bias = jnp.zeros((B, 1, 1, L), jnp.float32)

@@ -1,8 +1,10 @@
 """Attribute profiled conv op times to conv shapes.
 
 Compiles the ResNet-50 train step, dumps optimized HLO to map
-convolution.N -> (operand shapes), then sums the PROFILE_r03 trace
+convolution.N -> (operand shapes), then sums the profiled trace
 durations per conv name and prints the per-shape cost ranking.
+Usage: conv_attr.py [batch] [trace_dir]  (trace_dir default PROFILE_r03,
+or $ZOO_PROFILE_DIR).
 """
 
 import glob
@@ -52,7 +54,12 @@ def main():
             shapes = re.findall(r"(?:bf16|f32)\[[\d,]+\]", line)
             conv_lines[m.group(1)] = " ".join(shapes[:3])
 
-    files = glob.glob("PROFILE_r03/**/*.trace.json.gz", recursive=True)
+    trace_dir = sys.argv[2] if len(sys.argv) > 2 else os.environ.get(
+        "ZOO_PROFILE_DIR", "PROFILE_r03")
+    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        sys.exit(f"no trace under {trace_dir}/ — run tools/profile_step.py "
+                 "first (usage: conv_attr.py [batch] [trace_dir])")
     with gzip.open(sorted(files)[-1], "rt") as f:
         data = json.load(f)
     pid_names = {}
