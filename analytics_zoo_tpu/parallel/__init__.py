@@ -14,6 +14,7 @@ first-class mesh axis (common/engine.py axes: data/model/seq/expert/pipe):
 """
 
 from analytics_zoo_tpu.parallel.multihost import (  # noqa: F401
+    hybrid_mesh,
     init_distributed,
 )
 from analytics_zoo_tpu.parallel.partition import (  # noqa: F401

@@ -14,6 +14,7 @@ import logging
 import os
 
 import jax
+import numpy as np
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -83,3 +84,74 @@ def process_local_batch_slice(global_batch_size: int,
     per_proc = global_batch_size // nproc
     start = per_proc * pid
     return slice(start, start + per_proc)
+
+
+def hybrid_mesh(ici_shape: dict, dcn_shape: dict, axes=None, devices=None,
+                slice_groups=None):
+    """Mesh spanning multiple TPU slices: the DCN-crossing axis outermost,
+    ICI axes inner (SURVEY §2.4 — collectives for the inner axes then ride
+    ICI; only the outermost axis' all-reduce crosses the data-center
+    network).  The multi-slice analogue of the reference's scale-out story
+    (its only inter-node axis, Spark DP, maps to the DCN axis here).
+
+    Args:
+      ici_shape: per-slice mesh extents, e.g. ``{"data": 2, "model": 2}``.
+      dcn_shape: extents ACROSS slices.  Exactly one axis may cross the
+        DCN, and it must be the outermost of the resulting mesh — the
+        standard multi-slice layout (DP over DCN, everything else on ICI).
+      axes: axis order (default: the axes appearing in ici/dcn shapes, in
+        canonical ``data/model/seq/expert/pipe`` order).
+      devices: flat device list (default ``jax.devices()``).
+      slice_groups: explicit list of equal-size device groups, one per
+        slice — used by CI (CPU devices carry no ``slice_index``) and for
+        exotic topologies.  On real multi-slice TPU the default groups by
+        ``device.slice_index``.
+
+    Returns a ``jax.sharding.Mesh`` whose total extent per axis is
+    ``dcn * ici``.
+    """
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.common.engine import ALL_AXES
+
+    dcn_axes = [a for a, n in dcn_shape.items() if n > 1]
+    if len(dcn_axes) > 1:
+        raise ValueError(
+            f"only one axis may cross the DCN, got {dcn_axes}")
+    if axes is None:
+        axes = tuple(a for a in ALL_AXES
+                     if a in ici_shape or a in dcn_shape)
+    n_slices = dcn_shape.get(dcn_axes[0], 1) if dcn_axes else 1
+    if dcn_axes and axes[0] != dcn_axes[0]:
+        raise ValueError(
+            f"DCN axis {dcn_axes[0]!r} must be outermost, axes={axes}")
+
+    if slice_groups is None:
+        # device discovery only when actually needed: jax.devices() forces
+        # backend init, which is slow/can fail when the TPU is unreachable
+        devices = list(jax.devices()) if devices is None else list(devices)
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        slice_groups = [by_slice[k] for k in sorted(by_slice)]
+    if len(slice_groups) != n_slices:
+        raise ValueError(
+            f"{n_slices} slices requested but {len(slice_groups)} device "
+            "groups found")
+
+    per_slice = [ici_shape.get(a, 1) for a in axes]
+    need = int(np.prod(per_slice))
+    arrays = []
+    for g in slice_groups:
+        if len(g) < need:
+            raise ValueError(
+                f"slice has {len(g)} devices, mesh needs {need}")
+        if len(g) > need:
+            logger.warning(
+                "hybrid_mesh: slice has %d devices but the ICI mesh uses "
+                "only %d — %d devices per slice will sit idle",
+                len(g), need, len(g) - need)
+        arrays.append(np.asarray(g[:need]).reshape(per_slice))
+    # stack slices on the (outermost) DCN axis and merge
+    dev = np.concatenate(arrays, axis=0) if dcn_axes else arrays[0]
+    return Mesh(dev, tuple(axes))

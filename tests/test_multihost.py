@@ -148,3 +148,62 @@ def test_two_process_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(resumed["losses"], full["losses"][2:],
                                rtol=1e-4, atol=1e-5)
     assert abs(resumed["eval"]["loss"] - full["eval"]["loss"]) < 1e-4
+
+
+class TestHybridMesh:
+    """Multi-slice mesh layout (SURVEY §2.4 DCN axis): DCN-crossing axis
+    outermost, ICI axes inner, slice groups stay contiguous."""
+
+    def _mesh(self, ici, dcn):
+        import jax
+
+        from analytics_zoo_tpu.parallel import hybrid_mesh
+
+        devs = jax.devices()
+        return hybrid_mesh(ici, dcn,
+                           slice_groups=[devs[:4], devs[4:]])
+
+    def test_shape_and_slice_placement(self):
+        import jax
+
+        m = self._mesh({"data": 2, "model": 2}, {"data": 2})
+        assert dict(m.shape) == {"data": 4, "model": 2}
+        devs = jax.devices()
+        # outermost (DCN) blocks = one slice each: rows 0-1 from slice 0
+        assert set(m.devices[:2].ravel()) == set(devs[:4])
+        assert set(m.devices[2:].ravel()) == set(devs[4:])
+
+    def test_collective_spans_slices(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        m = self._mesh({"data": 2, "model": 2}, {"data": 2})
+        x = np.arange(8, dtype=np.float32)
+        f = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(v, "data"), mesh=m,
+            in_specs=P("data"), out_specs=P(), check_vma=False))
+        # 4 data shards [0,1],[2,3],[4,5],[6,7] -> elementwise sums
+        np.testing.assert_allclose(np.asarray(f(x)), [12.0, 16.0])
+
+    def test_dcn_axis_must_be_outermost(self):
+        import pytest
+
+        from analytics_zoo_tpu.parallel import hybrid_mesh
+
+        with pytest.raises(ValueError, match="outermost"):
+            hybrid_mesh({"data": 2, "model": 2}, {"model": 2},
+                        axes=("data", "model"))
+        with pytest.raises(ValueError, match="one axis"):
+            hybrid_mesh({"data": 2}, {"data": 2, "model": 2})
+
+    def test_group_count_mismatch_raises(self):
+        import jax
+        import pytest
+
+        from analytics_zoo_tpu.parallel import hybrid_mesh
+
+        devs = jax.devices()
+        with pytest.raises(ValueError, match="device"):
+            hybrid_mesh({"data": 2}, {"data": 4},
+                        slice_groups=[devs[:4], devs[4:]])

@@ -81,10 +81,54 @@ def main():
             sys.exit("--json needs a path")
         out_path = argv[i + 1]
         del argv[i:i + 2]
+    flag_steps = 5  # profile_step.py's loop count (fallback when the
+    # trace carries no recognisable jit module events)
+    if "--steps" in argv:
+        i = argv.index("--steps")
+        flag_steps = int(argv[i + 1])
+        del argv[i:i + 2]
     args = [a for a in argv if not a.startswith("--")]
     batch = int(args[0]) if args else 256
     trace_dir = args[1] if len(args) > 1 else os.environ.get(
         "ZOO_PROFILE_DIR", "PROFILE_r04")
+
+    # Trace first: fail on a bad/missing trace BEFORE the multi-minute
+    # step compile.
+    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        sys.exit(f"no trace under {trace_dir}/ — run tools/profile_step.py")
+    with gzip.open(sorted(files)[-1], "rt") as f:
+        data = json.load(f)
+    pid_names = {}
+    for ev in data["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
+    tpu_pids = sorted(p for p, n in pid_names.items() if "TPU" in n)
+    if not tpu_pids:
+        sys.exit("no TPU process in trace")
+    # ONE core only: multi-chip traces repeat every fusion name per core,
+    # and summing across cores would inflate ms by the core count while
+    # the HLO-derived bounds would not
+    pid0 = tpu_pids[0]
+    dur_total = defaultdict(float)
+    for ev in data["traceEvents"]:
+        if ev.get("ph") != "X" or ev.get("pid") != pid0:
+            continue
+        dur_total[ev.get("name", "")] += ev.get("dur", 0) / 1e3
+    # per-step divisor: how many times the jitted step module ran on this
+    # core (profile_step.py loops it); prefer a module named like a step,
+    # fall back to --steps (default 5 = profile_step.py's loop count)
+    mod_counts = defaultdict(int)
+    for ev in data["traceEvents"]:
+        if (ev.get("ph") == "X" and ev.get("pid") == pid0
+                and str(ev.get("name", "")).startswith("jit")):
+            mod_counts[ev["name"]] += 1
+    step_mods = {n: c for n, c in mod_counts.items() if "step" in n.lower()}
+    pick = step_mods or mod_counts
+    steps = max(pick.values()) if pick else None
+    if steps is None or not (1 <= steps <= 1000):
+        steps = int(flag_steps)
+    dur = {n: d / steps for n, d in dur_total.items()}
 
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.models.resnet import ResNet
@@ -133,23 +177,6 @@ def main():
         if byts:
             info[name] = (byts, fl)
 
-    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
-    if not files:
-        sys.exit(f"no trace under {trace_dir}/ — run tools/profile_step.py")
-    with gzip.open(sorted(files)[-1], "rt") as f:
-        data = json.load(f)
-    pid_names = {}
-    for ev in data["traceEvents"]:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
-    dur = defaultdict(float)
-    for ev in data["traceEvents"]:
-        if ev.get("ph") != "X":
-            continue
-        if "TPU" not in pid_names.get(ev.get("pid"), ""):
-            continue
-        dur[ev.get("name", "")] += ev.get("dur", 0) / 1e3 / 5  # 5 steps
-
     rows = []
     for name, ms in dur.items():
         if name not in info or ms <= 0.005:
@@ -176,7 +203,8 @@ def main():
     bound = sum(max(r.get("mxu_roofline_ms", 0), r["hbm_roofline_ms"])
                 for r in rows)
     summary = {
-        "trace": trace_dir, "batch": batch,
+        "trace": trace_dir, "batch": batch, "steps_divisor": steps,
+        "tpu_processes_in_trace": len(tpu_pids),
         "attributed_ms_per_step": round(total, 1),
         "composite_roofline_ms": round(bound, 1),
         "x_composite_roofline": round(total / bound, 2) if bound else None,
