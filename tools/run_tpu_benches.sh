@@ -1,78 +1,43 @@
 #!/bin/bash
 # Round-4 TPU bench queue: waits for the axon tunnel to answer, then runs
-# every TPU-dependent artifact producer sequentially (ONE process on the
-# chip at a time — concurrent clients wedge the tunnel; a client killed
-# mid-compile wedges it for hours).
+# every TPU-dependent artifact producer sequentially.  Queue machinery
+# (probe / wait_for_tpu / run with tunnel-death retry) lives in
+# tpu_queue_lib.sh.
 #
-# Lessons encoded here:
-# - serialize chip access; never run an ad-hoc python on the chip while
-#   this queue runs (JAX_PLATFORMS env alone does NOT keep a script off
-#   the axon plugin — only jax.config.update("jax_platforms", "cpu")).
-# - bench.py's e2e path needs the HOST core for infeed generation: do not
-#   run the pytest suite concurrently or e2e crawls ~10x (measured
-#   2026-07-30: 50 min vs ~4 min idle).
-# - serving on the tunneled chip sustains ~143 rps at batch 16; offer 100
-#   for a stable-queue latency artifact (200 measures saturation only).
+# Stage order puts the VERDICT top-next artifacts (flash + transformer,
+# which need the fixed backward kernels) before the perf/profile retry
+# and the bench headline, so a short tunnel window still lands the most
+# valuable numbers first.  Serving is last: SERVING_r04.json already
+# carries a real-chip stable-queue run.
 # Usage: bash tools/run_tpu_benches.sh [logdir]
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_benches}
 mkdir -p "$LOG"
-
-probe() {
-  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
-    >/dev/null 2>&1
-}
-
-wait_for_tpu() {
-  echo "$(date) waiting for TPU..." | tee -a "$LOG/queue.log"
-  until probe; do
-    sleep 120
-  done
-  echo "$(date) TPU answered" | tee -a "$LOG/queue.log"
-}
-
-# The tunnel can drop MID-QUEUE (it did at 01:28 on 2026-07-31, killing
-# the transformer stage at its first remote_compile): re-probe before
-# every stage and retry each failed stage once after the tunnel returns.
-run() {
-  name=$1; tmo=$2; shift 2
-  for attempt in 1 2; do
-    wait_for_tpu
-    echo "$(date) START $name (attempt $attempt)" | tee -a "$LOG/queue.log"
-    timeout "$tmo" "$@" >"$LOG/$name.log" 2>&1
-    rc=$?  # capture BEFORE $(date) resets $?
-    echo "$(date) DONE $name rc=$rc" | tee -a "$LOG/queue.log"
-    [ "$rc" -eq 0 ] && break
-    # only a dead tunnel earns a retry; a real failure (tunnel still
-    # answering) is a bug in the bench and repeats identically
-    if probe; then
-      echo "$(date) $name failed with TPU alive — not retrying" \
-        | tee -a "$LOG/queue.log"
-      break
-    fi
-  done
-}
+. "$(dirname "$0")/tpu_queue_lib.sh"
 
 # 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
-run flash 3000 python tools/flash_bench.py
+run flash 3600 python tools/flash_bench.py
 
 # 2. transformer at the honest config -> TRANSFORMER_r04.json
-run transformer 3600 python tools/transformer_bench.py \
+run transformer 4800 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
   --remat --out TRANSFORMER_r04.json
 
-# 3. serving latency on the real chip at a sustainable offered load
-run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
-
-# 4. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
+# 3. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
 run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 
-# 5. jax.profiler trace of the pure step -> PROFILE_r04/ (the roofline
-# evidence for the remaining pure-step gap)
+# 4. jax.profiler trace of the pure step -> PROFILE_r04/
 run profile 3000 python tools/profile_step.py 256
+
+# 5. per-fusion roofline table from the trace -> ROOFLINE_r04.json
+run roofline 2400 python tools/roofline_table.py 256 PROFILE_r04 \
+  --json ROOFLINE_r04.json
 
 # 6. headline bench line (host-infeed heavy: keep the core free)
 run bench 4800 python bench.py
+
+# 7. serving latency at a sustainable offered load (merge-don't-clobber)
+run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
 
 echo "$(date) queue complete" | tee -a "$LOG/queue.log"
