@@ -257,6 +257,8 @@ def init_zoo_context(
     seed: int | None = None,
     platform: str | None = None,
     compute_dtype=None,
+    dcn_shape: Mapping[str, int] | None = None,
+    slice_groups=None,
 ) -> ZooContext:
     """Initialise (or re-initialise) the global runtime context.
 
@@ -272,6 +274,13 @@ def init_zoo_context(
         axes get size 1 and leftover devices fold into ``data``.
       mesh_axes: axis names, outermost first.
       platform: force a jax platform ("cpu", "tpu"); tests use cpu meshes.
+      dcn_shape: multi-slice extents, e.g. ``{"data": 2}`` for
+        data-parallelism across 2 TPU slices — the mesh is then built by
+        :func:`analytics_zoo_tpu.parallel.hybrid_mesh` with ``mesh_shape``
+        as the per-slice (ICI) extents, and every ``fit``/``predict``
+        through this context trains multi-slice.
+      slice_groups: explicit per-slice device groups for ``dcn_shape``
+        (CI emulation / exotic topologies; default: ``device.slice_index``).
     """
     global _CONTEXT
     if isinstance(conf, ZooConfig):
@@ -303,10 +312,28 @@ def init_zoo_context(
 
     devices = jax.devices(cfg.platform) if cfg.platform else jax.devices()
     axes = tuple(cfg.mesh_axes)
-    shape = _infer_mesh_shape(devices, axes, cfg.mesh_shape)
-    n_used = math.prod(shape.values())
-    dev_array = np.asarray(devices[:n_used]).reshape([shape[a] for a in axes])
-    mesh = Mesh(dev_array, axes)
+    if slice_groups is not None and not dcn_shape:
+        raise ValueError("slice_groups requires dcn_shape")
+    if dcn_shape:
+        # multi-slice: DCN-crossing axis outermost, per-slice ICI extents
+        # from mesh_shape (see parallel.multihost.hybrid_mesh).  The FULL
+        # axes tuple is kept — unlisted axes get size 1 exactly like the
+        # plain path, so PartitionSpecs naming them keep working.
+        from analytics_zoo_tpu.parallel.multihost import hybrid_mesh
+
+        ici = dict(cfg.mesh_shape or {})
+        if not ici:
+            raise ValueError("dcn_shape requires an explicit mesh_shape "
+                             "(the per-slice ICI extents)")
+        mesh = hybrid_mesh(ici, dict(dcn_shape), axes=axes,
+                           devices=devices, slice_groups=slice_groups)
+        devices = list(mesh.devices.ravel())
+    else:
+        shape = _infer_mesh_shape(devices, axes, cfg.mesh_shape)
+        n_used = math.prod(shape.values())
+        dev_array = np.asarray(devices[:n_used]).reshape(
+            [shape[a] for a in axes])
+        mesh = Mesh(dev_array, axes)
     ctx = ZooContext(
         mesh=mesh, platform=devices[0].platform, seed=cfg.seed,
         compute_dtype=_resolve_compute_dtype(

@@ -207,3 +207,53 @@ class TestHybridMesh:
         with pytest.raises(ValueError, match="device"):
             hybrid_mesh({"data": 2}, {"data": 4},
                         slice_groups=[devs[:4], devs[4:]])
+
+    def test_fit_through_hybrid_context_matches_plain(self):
+        """init_zoo_context(dcn_shape=...) makes fit() itself train
+        multi-slice: identical loss curve to the plain 8-way DP mesh."""
+        import jax
+
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+        def build_and_fit():
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(64, 6)).astype(np.float32)
+            y = (x[:, :2] * 3.0).astype(np.float32)
+            m = Sequential()
+            m.add(Dense(2, input_shape=(6,)))
+            m.compile(optimizer="sgd", loss="mse")
+            m.fit(x, y, batch_size=16, nb_epoch=3)
+            return [h["loss"] for h in m._estimator.history]
+
+        devs = jax.devices()
+        ctx = init_zoo_context(
+            seed=0, mesh_shape={"data": 2, "model": 2},
+            dcn_shape={"data": 2},
+            slice_groups=[devs[:4], devs[4:]])
+        assert dict(ctx.mesh.shape) == {"data": 4, "model": 2}
+        hybrid_losses = build_and_fit()
+
+        init_zoo_context(seed=0, mesh_shape={"data": 4, "model": 2})
+        plain_losses = build_and_fit()
+        np.testing.assert_allclose(hybrid_losses, plain_losses, rtol=1e-5)
+
+    def test_hybrid_context_keeps_unlisted_axes_at_size_one(self):
+        """Pure-DP multi-slice with default axes must keep the model axis
+        at size 1 (like the plain path) so PartitionSpecs naming it still
+        resolve; slice_groups without dcn_shape is an error."""
+        import jax
+        import pytest
+
+        from analytics_zoo_tpu import init_zoo_context
+
+        devs = jax.devices()
+        ctx = init_zoo_context(
+            seed=0, mesh_shape={"data": 4}, dcn_shape={"data": 2},
+            slice_groups=[devs[:4], devs[4:]])
+        assert dict(ctx.mesh.shape) == {"data": 8, "model": 1}
+        ctx.sharding(None, "model")  # must not raise
+        with pytest.raises(ValueError, match="requires dcn_shape"):
+            init_zoo_context(seed=0, mesh_shape={"data": 8},
+                             slice_groups=[devs[:4], devs[4:]])

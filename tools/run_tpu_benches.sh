@@ -14,7 +14,7 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_benches}
 mkdir -p "$LOG"
-. "$(dirname "$0")/tpu_queue_lib.sh"
+. tools/tpu_queue_lib.sh || exit 1  # cwd is the repo root after the cd above
 
 # 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
 run flash 3600 python tools/flash_bench.py

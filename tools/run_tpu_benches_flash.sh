@@ -17,7 +17,7 @@ for arg in "$@"; do
   esac
 done
 mkdir -p "$LOG"
-. "$(dirname "$0")/tpu_queue_lib.sh"
+. tools/tpu_queue_lib.sh || exit 1  # cwd is the repo root after the cd above
 
 run flash 3600 python tools/flash_bench.py
 
