@@ -27,6 +27,7 @@ sys.path.insert(0, %(repo)r)
 port, pid, nproc, ckdir, epochs, out = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
     int(sys.argv[5]), sys.argv[6])
+mode = sys.argv[7] if len(sys.argv) > 7 else "plain"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -36,14 +37,15 @@ init_distributed(coordinator_address=f"127.0.0.1:{port}",
                  num_processes=nproc, process_id=pid)
 assert jax.process_count() == nproc
 from tests.test_multihost import build_and_fit
-hist = build_and_fit(None if ckdir == "-" else ckdir, epochs)
+hist = build_and_fit(None if ckdir == "-" else ckdir, epochs,
+                     hybrid=(mode == "hybrid"))
 if pid == 0:
     with open(out, "w") as f:
         json.dump(hist, f)
 """
 
 
-def build_and_fit(ckpt_dir=None, epochs=3):
+def build_and_fit(ckpt_dir=None, epochs=3, hybrid=False):
     """Deterministic tiny training run; returns per-epoch losses + eval.
 
     Runs identically single-process (8 devices) and 2-process (4+4): the
@@ -56,7 +58,20 @@ def build_and_fit(ckpt_dir=None, epochs=3):
     from analytics_zoo_tpu.pipeline.api.keras import Sequential
     from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
 
-    zoo.init_zoo_context(seed=3)
+    if hybrid:
+        # each PROCESS is a slice (what real multi-slice looks like:
+        # distinct host groups per slice); DP crosses the emulated DCN
+        import jax
+
+        groups: dict = {}
+        for d in jax.devices():
+            groups.setdefault(d.process_index, []).append(d)
+        sg = [groups[k] for k in sorted(groups)]
+        zoo.init_zoo_context(
+            seed=3, mesh_shape={"data": len(sg[0])},
+            dcn_shape={"data": len(sg)}, slice_groups=sg)
+    else:
+        zoo.init_zoo_context(seed=3)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(256, 8)).astype(np.float32)
     w = np.random.default_rng(1).normal(size=(8, 4))
@@ -83,7 +98,7 @@ def _free_port():
     return port
 
 
-def _run_two_process(tmp_path, tag, ckdir="-", epochs=3):
+def _run_two_process(tmp_path, tag, ckdir="-", epochs=3, mode="plain"):
     """Launch the 2-process run; ALWAYS reaps both workers (a worker that
     died before a collective leaves its sibling blocked in the barrier —
     without the finally-kill it would orphan and wedge later tests)."""
@@ -98,7 +113,7 @@ def _run_two_process(tmp_path, tag, ckdir="-", epochs=3):
     procs = [
         subprocess.Popen(
             [sys.executable, script, str(port), str(i), "2", ckdir,
-             str(epochs), out],
+             str(epochs), out, mode],
             cwd=REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for i in range(2)
@@ -257,3 +272,15 @@ class TestHybridMesh:
         with pytest.raises(ValueError, match="requires dcn_shape"):
             init_zoo_context(seed=0, mesh_shape={"data": 8},
                              slice_groups=[devs[:4], devs[4:]])
+
+
+def test_two_process_hybrid_slices_match_single_process(tmp_path):
+    """2 jax.distributed processes, each one an emulated SLICE (hybrid
+    mesh, DP crossing the process boundary as the DCN axis): identical
+    loss curve to the plain single-process 8-device run — multi-host AND
+    multi-slice semantics compose."""
+    two = _run_two_process(tmp_path, "hybrid2p", mode="hybrid")
+    one = build_and_fit()
+    np.testing.assert_allclose(two["losses"], one["losses"], rtol=1e-4,
+                               atol=1e-5)
+    assert abs(two["eval"]["loss"] - one["eval"]["loss"]) < 1e-4
