@@ -259,6 +259,7 @@ def init_zoo_context(
     compute_dtype=None,
     dcn_shape: Mapping[str, int] | None = None,
     slice_groups=None,
+    allow_idle: bool = False,
 ) -> ZooContext:
     """Initialise (or re-initialise) the global runtime context.
 
@@ -281,6 +282,8 @@ def init_zoo_context(
         through this context trains multi-slice.
       slice_groups: explicit per-slice device groups for ``dcn_shape``
         (CI emulation / exotic topologies; default: ``device.slice_index``).
+      allow_idle: let the hybrid mesh leave surplus per-slice devices idle
+        (otherwise a per-slice shape smaller than the slice is an error).
     """
     global _CONTEXT
     if isinstance(conf, ZooConfig):
@@ -326,7 +329,8 @@ def init_zoo_context(
             raise ValueError("dcn_shape requires an explicit mesh_shape "
                              "(the per-slice ICI extents)")
         mesh = hybrid_mesh(ici, dict(dcn_shape), axes=axes,
-                           devices=devices, slice_groups=slice_groups)
+                           devices=devices, slice_groups=slice_groups,
+                           allow_idle=allow_idle)
         devices = list(mesh.devices.ravel())
     else:
         shape = _infer_mesh_shape(devices, axes, cfg.mesh_shape)

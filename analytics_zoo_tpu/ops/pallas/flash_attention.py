@@ -772,8 +772,13 @@ def _flash_bwd_pallas(q, k, v, g, out, m, l, causal, scale,
     return dq, dk, dv, dbias
 
 
+def _env_flag(name: str) -> bool:
+    # same convention as engine.py's ZOO_SHARD_OPTIMIZER: "0"/"" are false
+    return os.environ.get(name, "") not in ("", "0")
+
+
 def _interpret_forced() -> bool:
-    return bool(os.environ.get("ZOO_FLASH_INTERPRET"))
+    return _env_flag("ZOO_FLASH_INTERPRET")
 
 
 def _pallas_available() -> bool:
@@ -784,7 +789,7 @@ def _pallas_available() -> bool:
     # backward cross-lowering guard was vacuous for exactly that reason).
     # Executing under this knob off-TPU will fail — lower, don't run.
     return (jax.default_backend() == "tpu" or _interpret_forced()
-            or bool(os.environ.get("ZOO_FLASH_FORCE_PALLAS")))
+            or _env_flag("ZOO_FLASH_FORCE_PALLAS"))
 
 
 _warned_fallback = False

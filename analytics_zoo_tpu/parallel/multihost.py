@@ -87,7 +87,7 @@ def process_local_batch_slice(global_batch_size: int,
 
 
 def hybrid_mesh(ici_shape: dict, dcn_shape: dict, axes=None, devices=None,
-                slice_groups=None):
+                slice_groups=None, allow_idle=False):
     """Mesh spanning multiple TPU slices: the DCN-crossing axis outermost,
     ICI axes inner (SURVEY §2.4 — collectives for the inner axes then ride
     ICI; only the outermost axis' all-reduce crosses the data-center
@@ -121,6 +121,13 @@ def hybrid_mesh(ici_shape: dict, dcn_shape: dict, axes=None, devices=None,
     if axes is None:
         axes = tuple(a for a in ALL_AXES
                      if a in ici_shape or a in dcn_shape)
+    # a typo'd axis key would otherwise fall through .get(a, 1) below and
+    # yield a degenerate size-1 mesh with at most an idle-devices warning
+    unknown = (set(ici_shape) | set(dcn_shape)) - set(axes)
+    if unknown:
+        raise ValueError(
+            f"ici_shape/dcn_shape keys {sorted(unknown)} not in mesh axes "
+            f"{tuple(axes)}")
     n_slices = dcn_shape.get(dcn_axes[0], 1) if dcn_axes else 1
     if dcn_axes and axes[0] != dcn_axes[0]:
         raise ValueError(
@@ -147,6 +154,13 @@ def hybrid_mesh(ici_shape: dict, dcn_shape: dict, axes=None, devices=None,
             raise ValueError(
                 f"slice has {len(g)} devices, mesh needs {need}")
         if len(g) > need:
+            # on real multi-slice hardware a wrong per-slice shape would
+            # otherwise silently train on a subset of each slice
+            if not allow_idle:
+                raise ValueError(
+                    f"slice has {len(g)} devices but the ICI mesh uses only "
+                    f"{need}; pass allow_idle=True to leave "
+                    f"{len(g) - need} devices per slice idle")
             logger.warning(
                 "hybrid_mesh: slice has %d devices but the ICI mesh uses "
                 "only %d — %d devices per slice will sit idle",

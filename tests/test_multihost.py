@@ -212,6 +212,30 @@ class TestHybridMesh:
         with pytest.raises(ValueError, match="one axis"):
             hybrid_mesh({"data": 2}, {"data": 2, "model": 2})
 
+    def test_unknown_axis_key_raises(self):
+        import pytest
+
+        from analytics_zoo_tpu.parallel import hybrid_mesh
+
+        # a typo'd axis name must not silently yield a size-1 mesh
+        with pytest.raises(ValueError, match="not in mesh axes"):
+            hybrid_mesh({"dtaa": 2}, {"data": 2}, axes=("data",))
+
+    def test_surplus_devices_require_allow_idle(self):
+        import jax
+        import pytest
+
+        from analytics_zoo_tpu.parallel import hybrid_mesh
+
+        devs = jax.devices()
+        with pytest.raises(ValueError, match="allow_idle"):
+            hybrid_mesh({"data": 2}, {"data": 2},
+                        slice_groups=[devs[:4], devs[4:]])
+        m = hybrid_mesh({"data": 2}, {"data": 2},
+                        slice_groups=[devs[:4], devs[4:]],
+                        allow_idle=True)
+        assert dict(m.shape) == {"data": 4}
+
     def test_group_count_mismatch_raises(self):
         import jax
         import pytest

@@ -1,6 +1,6 @@
 """Accuracy-parity evidence (VERDICT r03 missing #1): train flagship
 recipes to convergence, record the full curve, and prove checkpoint-resume
-reproduces it.  Writes ACCURACY_r04.json.
+reproduces it.  Writes ACCURACY_r05.json.
 
 Dataset reality in this sandbox: there is NO network egress and no
 MNIST/CIFAR archive on disk, so the reference configs are anchored as:
@@ -249,7 +249,7 @@ def main():
         print("resnet_shapes acc", acc)
 
     path = a.out or os.path.join(os.path.dirname(__file__), "..",
-                                 "ACCURACY_r04.json")
+                                 "ACCURACY_r05.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: (v if not isinstance(v, dict) else

@@ -10,7 +10,7 @@ kernel is also timed with a BERT-style (B, 1, 1, L) padding mask plus
 attention dropout, and with packed-segment masking — the acceptance bar is
 masked+dropout within ~10% of the clean kernel's TFLOP/s.
 
-Writes FLASH_r04.json.  Usage: python tools/flash_bench.py
+Writes FLASH_r05.json.  Usage: python tools/flash_bench.py
 """
 
 import json
@@ -153,7 +153,7 @@ def main():
         results.append(row)
         print(json.dumps(row))
     out["results"] = results
-    path = os.path.join(os.path.dirname(__file__), "..", "FLASH_r04.json")
+    path = os.path.join(os.path.dirname(__file__), "..", "FLASH_r05.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

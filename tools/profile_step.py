@@ -1,6 +1,6 @@
 """Capture a jax.profiler trace of the pure-device ResNet-50 train step.
 
-Writes the trace under PROFILE_r04/ (override: second CLI arg) and prints
+Writes the trace under PROFILE_r05/ (override: second CLI arg) and prints
 a JSON line with the top-k ops by self time parsed back out of the trace
 (trace_viewer json.gz).
 """
@@ -96,7 +96,7 @@ def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     trace_dir = (sys.argv[2] if len(sys.argv) > 2 else
                  os.path.join(os.path.dirname(__file__), "..",
-                              "PROFILE_r04"))
+                              "PROFILE_r05"))
     step_fn, params, opt_state, state, sharded = build_step(batch)
     seed_arr = np.asarray(0, np.int32)
 

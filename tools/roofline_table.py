@@ -8,7 +8,7 @@ whose achieved bandwidth/compute sits at the ceiling is environment-
 bound; anything far below ceiling is a framework target.
 
 Usage: python tools/roofline_table.py [batch] [trace_dir] [--json out]
-  trace_dir default PROFILE_r04 (or $ZOO_PROFILE_DIR).  Needs the same
+  trace_dir default PROFILE_r05 (or $ZOO_PROFILE_DIR).  Needs the same
   backend the trace came from (compiles the step to map op -> shapes).
 """
 
@@ -73,7 +73,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    argv = sys.argv[1:]
+    argv = [a for a in sys.argv[1:] if a != "--cpu"]
     out_path = None
     if "--json" in argv:
         i = argv.index("--json")
@@ -85,12 +85,19 @@ def main():
     # trace carries no recognisable jit module events)
     if "--steps" in argv:
         i = argv.index("--steps")
+        if i + 1 >= len(argv):
+            sys.exit("--steps needs a value")
         flag_steps = int(argv[i + 1])
         del argv[i:i + 2]
-    args = [a for a in argv if not a.startswith("--")]
+    # reject unknown flags: an unrecognized '--flag value' pair would leave
+    # the value behind to be misparsed as the positional batch/trace_dir
+    unknown = [a for a in argv if a.startswith("--")]
+    if unknown:
+        sys.exit(f"unknown flags: {' '.join(unknown)}")
+    args = argv
     batch = int(args[0]) if args else 256
     trace_dir = args[1] if len(args) > 1 else os.environ.get(
-        "ZOO_PROFILE_DIR", "PROFILE_r04")
+        "ZOO_PROFILE_DIR", "PROFILE_r05")
 
     # Trace first: fail on a bad/missing trace BEFORE the multi-minute
     # step compile.

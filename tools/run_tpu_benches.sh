@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-4 TPU bench queue: waits for the axon tunnel to answer, then runs
+# Round-5 TPU bench queue: waits for the axon tunnel to answer, then runs
 # every TPU-dependent artifact producer sequentially.  Queue machinery
 # (probe / wait_for_tpu / run with tunnel-death retry) lives in
 # tpu_queue_lib.sh.
@@ -16,23 +16,23 @@ LOG=${1:-/tmp/tpu_benches}
 mkdir -p "$LOG"
 . tools/tpu_queue_lib.sh || exit 1  # cwd is the repo root after the cd above
 
-# 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r04.json
+# 1. flash kernel micro-bench (clean vs train configs) -> FLASH_r05.json
 run flash 3600 python tools/flash_bench.py
 
-# 2. transformer at the honest config -> TRANSFORMER_r04.json
+# 2. transformer at the honest config -> TRANSFORMER_r05.json
 run transformer 4800 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
-  --remat --out TRANSFORMER_r04.json
+  --remat --out TRANSFORMER_r05.json
 
 # 3. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
 run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 
-# 4. jax.profiler trace of the pure step -> PROFILE_r04/
+# 4. jax.profiler trace of the pure step -> PROFILE_r05/
 run profile 3000 python tools/profile_step.py 256
 
-# 5. per-fusion roofline table from the trace -> ROOFLINE_r04.json
-run roofline 2400 python tools/roofline_table.py 256 PROFILE_r04 \
-  --json ROOFLINE_r04.json
+# 5. per-fusion roofline table from the trace -> ROOFLINE_r05.json
+run roofline 2400 python tools/roofline_table.py 256 PROFILE_r05 \
+  --json ROOFLINE_r05.json
 
 # 6. headline bench line (host-infeed heavy: keep the core free)
 run bench 4800 python bench.py

@@ -23,7 +23,7 @@ run flash 3600 python tools/flash_bench.py
 
 run transformer 4800 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
-  --remat --out TRANSFORMER_r04.json
+  --remat --out TRANSFORMER_r05.json
 
 if [ "$WITH_PERF" = 1 ]; then
   run perf 3000 python tools/perf_probe.py --batch 256 --steps 20

@@ -11,7 +11,7 @@ is visible, not hidden by back-to-back closed-loop pacing); the server runs
 in its own thread on the in-memory broker; a collector polls result hashes
 with a 1 ms tick and records completion times.
 
-Writes SERVING_r04.json.  Usage:
+Writes SERVING_r05.json.  Usage:
   python tools/serving_bench.py [--rate 200] [--n 2000] [--batch 16]
                                 [--shape 32,32,3]
 """
@@ -157,7 +157,7 @@ def main():
                        "stable-queue run for the latency number")
     print(json.dumps(out))
     path = a.out or os.path.join(os.path.dirname(__file__), "..",
-                                 "SERVING_r04.json")
+                                 "SERVING_r05.json")
     # Merge, don't clobber: the artifact keeps one run per
     # (platform, offered_rate) and fronts the best STABLE-queue run, so a
     # saturation probe can never replace the latency headline.
