@@ -1,0 +1,129 @@
+"""Routed mixture-of-experts feed-forward for the Keras model surface.
+
+The reference framework has no MoE at all (its TransformerLayer.scala:137
+feed-forward is a dense 4x MLP); SURVEY.md §2.4 makes expert parallelism a
+first-class axis of this framework, and round 4 landed the *strategies*
+level (``parallel.strategies.moe_mlp_topk``: shard_map + ``all_to_all``
+dispatch).  This module is the model-surface counterpart: the same
+GShard/Switch top-k + capacity semantics expressed as **dense one-hot
+dispatch einsums**, so it composes with the estimator's single GSPMD
+``jit`` train step (no ``shard_map`` axis context needed — XLA partitions
+the expert dimension and inserts the all_to_all from the sharding
+constraint below).
+
+Capacity semantics (GShard): every token proposes its top-k experts; the
+assignment stream is priority-ordered (all 1st choices outrank any 2nd
+choice) and each expert accepts at most ``C = ceil(cf * k * S / E)``
+tokens per group (group = one batch row).  Over-capacity assignments
+contribute ZERO to the expert output — callers MUST place this op behind
+a residual connection (as ``_TransformerCore._block_forward_aux`` does)
+so a dropped token degrades to identity, never to a zeroed activation.
+``tests/test_moe_layer.py::test_skewed_routing_*`` pins exactly that.
+
+The auxiliary load-balancing loss is the GShard/Switch one:
+``E * sum_e mean_prob_e * frac_first_choice_e`` — ~1.0 when balanced,
+up to ~E when collapsed onto one expert.  Under the GSPMD step the batch
+means are global (jit sees global shapes), so no pmean is needed.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _constrain_expert_axis(x):
+    """Pin the leading (expert) dim of ``x`` to the mesh ``expert`` axis
+    when the active context mesh has one — this is what turns the dispatch
+    einsum into an all_to_all + per-shard expert MLP under GSPMD."""
+    try:
+        from analytics_zoo_tpu.common.engine import (
+            EXPERT_AXIS,
+            get_zoo_context,
+        )
+
+        mesh = get_zoo_context().mesh
+    except Exception:
+        return x
+    if dict(mesh.shape).get(EXPERT_AXIS, 1) <= 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(EXPERT_AXIS, *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def routed_ffn(h, gate_w, w1, b1, w2, b2, *, top_k=2, capacity_factor=1.25,
+               activation=jax.nn.gelu, renormalize=False):
+    """Top-k routed MoE feed-forward on ``(B, S, D)`` activations.
+
+    Args:
+      h: (B, S, D) tokens.
+      gate_w: (D, E) router.
+      w1: (E, D, F), b1: (E, F), w2: (E, F, D), b2: (D,).
+      top_k: experts per token.
+      capacity_factor: per-expert capacity multiplier (C = ceil(cf*k*S/E)).
+      renormalize: rescale the k gate values to sum to 1 (GShard top-2
+        convention); default False (Switch: raw softmax probs).
+
+    Returns ``(y, aux, drop_fraction)``: y (B, S, D) — ZERO rows for
+    fully-dropped tokens (use behind a residual); aux — the f32 scalar
+    load-balancing loss; drop_fraction — f32 scalar fraction of the k*B*S
+    assignments that exceeded capacity.
+    """
+    b, s, d = h.shape
+    e = gate_w.shape[-1]
+    if top_k > e:
+        raise ValueError(f"top_k={top_k} > n_experts={e}")
+    cap = int(math.ceil(capacity_factor * top_k * s / e))
+    cap = max(1, min(cap, s))
+
+    # routing in f32 regardless of compute dtype (tiny, precision-critical)
+    probs = jax.nn.softmax(
+        h.astype(jnp.float32) @ gate_w.astype(jnp.float32),
+        axis=-1)                                          # (B, S, E)
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)       # (B, S, k)
+    if renormalize:
+        top_vals = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+
+    # priority-ordered capacity race: choice j's position within an expert
+    # counts every earlier token's j-th choice AND all previous choices
+    counts = jnp.zeros((b, 1, e), jnp.float32)
+    dispatch = jnp.zeros((b, s, e, cap), h.dtype)
+    combine = jnp.zeros((b, s, e, cap), h.dtype)
+    kept = jnp.zeros((), jnp.float32)
+    for j in range(top_k):
+        m = jax.nn.one_hot(top_idx[..., j], e, dtype=jnp.float32)
+        pos = jnp.cumsum(m, axis=1) - 1.0 + counts        # (B, S, E)
+        keep = m * (pos < cap)
+        slot = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1).astype(jnp.int32),
+                              cap, dtype=jnp.float32)     # (B, S, E, C)
+        dc = (keep[..., None] * slot).astype(h.dtype)
+        dispatch = dispatch + dc
+        combine = combine + dc * top_vals[..., j, None, None].astype(h.dtype)
+        counts = counts + jnp.sum(m, axis=1, keepdims=True)
+        kept = kept + jnp.sum(keep)
+
+    # gather each expert's C tokens per group: (E, B, C, D) -> (E, B*C, D)
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
+    xin = _constrain_expert_axis(xin.reshape(e, b * cap, d))
+    h1 = activation(jnp.einsum("etd,edf->etf", xin, w1) + b1[:, None, :])
+    # b2 joins INSIDE the expert output (before the gate-weighted
+    # combine): a fully-dropped token's row stays exactly zero even after
+    # b2 trains away from zero — the residual-passthrough contract.  For
+    # kept tokens the bias arrives scaled by the gate sum, and with
+    # top_k=E full dispatch this reduces to +b2 (probs sum to 1), so the
+    # dense-mixture oracle is unchanged.
+    ye = (jnp.einsum("etf,efd->etd", h1, w2)
+          + b2[None, None, :]).reshape(e, b, cap, d)
+    y = jnp.einsum("bsec,ebcd->bsd", combine, ye)
+
+    # GShard load balance: mean router prob x fraction-of-first-choices
+    me = jnp.mean(probs, axis=(0, 1))                           # (E,)
+    ce = jnp.mean(jax.nn.one_hot(top_idx[..., 0], e,
+                                 dtype=jnp.float32), axis=(0, 1))
+    aux = e * jnp.sum(me * ce)
+    drop_fraction = 1.0 - kept / float(top_k * b * s)
+    return y, aux, drop_fraction

@@ -422,6 +422,12 @@ def transformer_gpipe_lm(layer, params, head_kernel, head_bias, tokens, *,
     Returns: (B, L, V) logits.  Blocks run inference-mode (dropout off);
     ``layer.remat=True`` is honored per stage.
     """
+    if getattr(layer, "moe_experts", 0):
+        raise ValueError(
+            "pipeline stage builders carry dense blocks only: an MoE "
+            "stack's load-balancing aux loss cannot ride the microbatch "
+            "schedule and would be silently dropped (train MoE with the "
+            "GSPMD estimator step / dryrun phase 6 path instead)")
     mesh = mesh or get_zoo_context().mesh
     n_stages = dict(mesh.shape).get(axis_name, 1)
     blocks = params["blocks"] if isinstance(params, dict) else params
@@ -497,6 +503,12 @@ def transformer_gpipe(layer, params, h, *, n_microbatch, mask=None,
     pipeline for training use, and ``layer.remat=True`` is honored per
     stage.
     """
+    if getattr(layer, "moe_experts", 0):
+        raise ValueError(
+            "pipeline stage builders carry dense blocks only: an MoE "
+            "stack's load-balancing aux loss cannot ride the microbatch "
+            "schedule and would be silently dropped (train MoE with the "
+            "GSPMD estimator step / dryrun phase 6 path instead)")
     if mask is not None and mask.ndim >= 3 and mask.shape[0] != 1:
         raise ValueError(
             "transformer_gpipe: per-sample masks (leading batch dim "

@@ -40,7 +40,8 @@ class _TransformerCore(Layer):
     def __init__(self, n_block, n_head, hidden_size, intermediate_size=None,
                  hidden_drop=0.1, attn_drop=0.1, initializer_range=0.02,
                  bidirectional=False, activation="gelu", remat=False,
-                 input_shape=None, name=None, **kwargs):
+                 moe_experts=0, moe_top_k=2, moe_capacity_factor=1.25,
+                 moe_aux_weight=0.01, input_shape=None, name=None, **kwargs):
         super().__init__(input_shape=input_shape, name=name, **kwargs)
         self.n_block = int(n_block)
         self.n_head = int(n_head)
@@ -50,6 +51,21 @@ class _TransformerCore(Layer):
         self.attn_drop = float(attn_drop)
         self.initializer_range = float(initializer_range)
         self.bidirectional = bool(bidirectional)
+        # moe_experts > 0 swaps every block's dense feed-forward for a
+        # routed mixture of experts (ops.moe.routed_ffn: GShard top-k +
+        # capacity, dense-dispatch so the GSPMD train step shards the
+        # expert dim over the mesh `expert` axis).  The layer becomes
+        # stateful: its per-step state carries the load-balancing aux loss
+        # (raw + pre-weighted) and the capacity drop fraction — the
+        # estimator adds every `moe_aux_cost` state leaf to the training
+        # loss, so expert collapse is penalized out of the box.
+        self.moe_experts = int(moe_experts)
+        self.moe_top_k = int(moe_top_k)
+        self.moe_capacity_factor = float(moe_capacity_factor)
+        self.moe_aux_weight = float(moe_aux_weight)
+        if self.moe_experts and self.moe_top_k > self.moe_experts:
+            raise ValueError(
+                f"moe_top_k={moe_top_k} > moe_experts={moe_experts}")
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) — live memory drops from O(n_block) to O(1)
         # block activations for ~1/3 more FLOPs, the standard trade for
@@ -79,18 +95,58 @@ class _TransformerCore(Layer):
         d, m = self.hidden_size, self.intermediate_size
         std = self.initializer_range
         ks = jax.random.split(rng, 6)
-        return {
+        p = {
             "qkv_kernel": _dense_init(ks[0], (d, 3 * d), std),
             "qkv_bias": jnp.zeros((3 * d,)),
             "proj_kernel": _dense_init(ks[1], (d, d), std),
             "proj_bias": jnp.zeros((d,)),
             "ln1_gamma": jnp.ones((d,)), "ln1_beta": jnp.zeros((d,)),
-            "fc_kernel": _dense_init(ks[2], (d, m), std),
-            "fc_bias": jnp.zeros((m,)),
-            "out_kernel": _dense_init(ks[3], (m, d), std),
-            "out_bias": jnp.zeros((d,)),
             "ln2_gamma": jnp.ones((d,)), "ln2_beta": jnp.zeros((d,)),
         }
+        if self.moe_experts:
+            e = self.moe_experts
+            p.update({
+                "moe_gate": _dense_init(ks[2], (d, e), std),
+                "moe_w1": _dense_init(ks[3], (e, d, m), std),
+                "moe_b1": jnp.zeros((e, m)),
+                "moe_w2": _dense_init(ks[4], (e, m, d), std),
+                "moe_b2": jnp.zeros((d,)),
+            })
+        else:
+            p.update({
+                "fc_kernel": _dense_init(ks[2], (d, m), std),
+                "fc_bias": jnp.zeros((m,)),
+                "out_kernel": _dense_init(ks[3], (m, d), std),
+                "out_bias": jnp.zeros((d,)),
+            })
+        return p
+
+    @property
+    def stateful(self):
+        # MoE stacks report their aux loss / drop fraction through the
+        # layer-state channel; the estimator adds every `moe_aux_cost`
+        # leaf to the training loss
+        return self.moe_experts > 0
+
+    def init_state(self):
+        if not self.moe_experts:
+            return {}
+        return {"moe_aux_loss": jnp.zeros((), jnp.float32),
+                "moe_aux_cost": jnp.zeros((), jnp.float32),
+                "moe_drop_fraction": jnp.zeros((), jnp.float32)}
+
+    def _moe_state(self, aux, drop):
+        return {"moe_aux_loss": aux,
+                "moe_aux_cost": self.moe_aux_weight * aux,
+                "moe_drop_fraction": drop}
+
+    def _per_block_param_count(self):
+        d, m = self.hidden_size, self.intermediate_size
+        attn = 3 * d * d + 3 * d + d * d + d + 4 * d  # qkv + proj + 2 LN
+        if self.moe_experts:
+            e = self.moe_experts
+            return attn + d * e + e * (2 * d * m + m) + d
+        return attn + 2 * d * m + m + d
 
     @staticmethod
     def _ln(x, gamma, beta, eps=1e-5):
@@ -107,7 +163,12 @@ class _TransformerCore(Layer):
         return jnp.where(keep, x / (1.0 - p), 0.0)
 
     def _run_blocks(self, blocks, h, mask, training, rng):
-        body = self._block_forward
+        return self._run_blocks_aux(blocks, h, mask, training, rng)[0]
+
+    def _run_blocks_aux(self, blocks, h, mask, training, rng):
+        """Run the stack; also return (mean aux loss, mean drop fraction)
+        over the MoE blocks (zeros for a dense stack)."""
+        body = self._block_forward_aux
         if self.remat == "full":
             body = jax.checkpoint(body, static_argnums=(3,))
         elif self.remat == "dots":
@@ -120,12 +181,26 @@ class _TransformerCore(Layer):
                 body, static_argnums=(3,),
                 policy=jax.checkpoint_policies
                 .save_only_these_names("attn_context"))
+        aux = jnp.zeros((), jnp.float32)
+        drop = jnp.zeros((), jnp.float32)
+        n_moe = 0
         for bi, bp in enumerate(blocks):
             brng = jax.random.fold_in(rng, bi) if rng is not None else None
-            h = body(bp, h, mask, training, brng)
-        return h
+            h, a, dr = body(bp, h, mask, training, brng)
+            if "moe_gate" in bp:  # static: params structure is traced once
+                n_moe += 1
+                aux = aux + a
+                drop = drop + dr
+        if n_moe:
+            aux, drop = aux / n_moe, drop / n_moe
+        return h, aux, drop
 
     def _block_forward(self, bp, h, mask, training, brng):
+        # single-output view kept for pipeline-parallel stage builders
+        # (parallel/pipeline.py), which carry dense blocks only
+        return self._block_forward_aux(bp, h, mask, training, brng)[0]
+
+    def _block_forward_aux(self, bp, h, mask, training, brng):
         qkv = h @ bp["qkv_kernel"] + bp["qkv_bias"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = split_heads(q, self.n_head)
@@ -142,10 +217,24 @@ class _TransformerCore(Layer):
         a = merge_heads(a) @ bp["proj_kernel"] + bp["proj_bias"]
         a = self._drop(a, self.hidden_drop, training, brng, 1)
         h = self._ln(h + a, bp["ln1_gamma"], bp["ln1_beta"])
-        f = self.act(h @ bp["fc_kernel"] + bp["fc_bias"])
-        f = f @ bp["out_kernel"] + bp["out_bias"]
+        aux = jnp.zeros((), jnp.float32)
+        drop = jnp.zeros((), jnp.float32)
+        if "moe_gate" in bp:
+            from analytics_zoo_tpu.ops.moe import routed_ffn
+
+            # routed FFN behind the residual: an over-capacity token's
+            # zero expert output degrades to identity, never to a zeroed
+            # activation (tests/test_moe_layer.py pins this)
+            f, aux, drop = routed_ffn(
+                h, bp["moe_gate"], bp["moe_w1"], bp["moe_b1"],
+                bp["moe_w2"], bp["moe_b2"], top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                activation=self.act)
+        else:
+            f = self.act(h @ bp["fc_kernel"] + bp["fc_bias"])
+            f = f @ bp["out_kernel"] + bp["out_bias"]
         f = self._drop(f, self.hidden_drop, training, brng, 2)
-        return self._ln(h + f, bp["ln2_gamma"], bp["ln2_beta"])
+        return self._ln(h + f, bp["ln2_gamma"], bp["ln2_beta"]), aux, drop
 
 
 class TransformerLayer(_TransformerCore):
@@ -185,8 +274,8 @@ class TransformerLayer(_TransformerCore):
         }
 
     def param_count(self):
-        d, m, v = self.hidden_size, self.intermediate_size, self.vocab
-        per_block = 3 * d * d + 3 * d + d * d + d + 2 * d * m + m + d + 4 * d
+        d, v = self.hidden_size, self.vocab
+        per_block = self._per_block_param_count()
         return v * d + self.seq_len * d + self.n_block * per_block
 
     def call(self, params, inputs, state=None, training=False, rng=None):
@@ -201,7 +290,11 @@ class TransformerLayer(_TransformerCore):
         h = h + jnp.take(params["pos_embed"], positions.astype(jnp.int32),
                          axis=0)
         h = self._drop(h, self.embedding_drop, training, rng, 0)
-        return self._run_blocks(params["blocks"], h, None, training, rng)
+        out, aux, drop = self._run_blocks_aux(params["blocks"], h, None,
+                                              training, rng)
+        if self.moe_experts:
+            return out, self._moe_state(aux, drop)
+        return out
 
     def compute_output_shape(self, input_shape):
         if isinstance(input_shape, list):
@@ -249,8 +342,8 @@ class BERT(_TransformerCore):
         }
 
     def param_count(self):
-        d, m = self.hidden_size, self.intermediate_size
-        per_block = 3 * d * d + 3 * d + d * d + d + 2 * d * m + m + d + 4 * d
+        d = self.hidden_size
+        per_block = self._per_block_param_count()
         return ((self.vocab + self.seq_len + self.type_vocab) * d + 2 * d
                 + d * d + d + self.n_block * per_block)
 
@@ -277,10 +370,13 @@ class BERT(_TransformerCore):
             # (reference BERT.scala attention-mask preprocessing)
             mask = (1.0 - attn_mask[:, None, None, :].astype(h.dtype)) \
                 * jnp.finfo(h.dtype).min
-        seq = self._run_blocks(params["blocks"], h, mask, training, rng)
+        seq, aux, drop = self._run_blocks_aux(params["blocks"], h, mask,
+                                              training, rng)
         pooled = jnp.tanh(
             seq[:, 0] @ params["pooler_kernel"] + params["pooler_bias"]
         )
+        if self.moe_experts:
+            return [seq, pooled], self._moe_state(aux, drop)
         return [seq, pooled]
 
     def compute_output_shape(self, input_shape):

@@ -79,6 +79,19 @@ def _process_shard() -> tuple[int, int] | None:
     return None
 
 
+def _collect_aux_cost(state):
+    """Sum every ``moe_aux_cost`` leaf in the model state tree: the
+    pre-weighted auxiliary losses layers report through the state channel
+    (MoE load balancing — keras/layers/self_attention.py _moe_state)."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        last = path[-1]
+        key = getattr(last, "key", getattr(last, "name", None))
+        if key == "moe_aux_cost":
+            total = total + leaf.astype(jnp.float32)
+    return total
+
+
 def _normalize_grad_clip(grad_clip):
     """Canonical grad-clip spec shared by every train-step builder:
     ``None | ("l2norm", max) | ("const", lo, hi)``; a bare scalar is
@@ -404,6 +417,11 @@ class Estimator:
                 )
                 preds = cast_floats(preds, jnp.float32)
                 l = loss_fn.mean(batch.get("y"), preds, batch.get("w"))
+                # Auxiliary losses reported through the layer-state channel
+                # (MoE load balancing: each stack stores its pre-weighted
+                # contribution under `moe_aux_cost`) join the training
+                # loss; eval loss stays the task loss alone.
+                l = l + _collect_aux_cost(new_state)
                 return l, new_state
 
             (l, new_state), grads = jax.value_and_grad(
