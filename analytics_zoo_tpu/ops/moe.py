@@ -34,6 +34,21 @@ import jax
 import jax.numpy as jnp
 
 
+def collect_aux_cost(state):
+    """Sum every ``moe_aux_cost`` leaf in a model state tree: the
+    pre-weighted auxiliary losses MoE stacks report through the layer
+    state channel (keras/layers/self_attention.py ``_moe_state``).  Every
+    train-step builder that computes a loss from ``model.forward`` must
+    add this to the task loss, or a collapsed router trains unpenalized."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        last = path[-1]
+        key = getattr(last, "key", getattr(last, "name", None))
+        if key == "moe_aux_cost":
+            total = total + leaf.astype(jnp.float32)
+    return total
+
+
 def _constrain_expert_axis(x):
     """Pin the leading (expert) dim of ``x`` to the mesh ``expert`` axis
     when the active context mesh has one — this is what turns the dispatch

@@ -49,7 +49,12 @@ def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
             preds, new_state = model.forward(
                 p, batch["x"], state=state, training=True, rng=rng
             )
-            return loss_fn.mean(batch.get("y"), preds), new_state
+            from analytics_zoo_tpu.ops.moe import collect_aux_cost
+
+            l = loss_fn.mean(batch.get("y"), preds)
+            # MoE stacks report their pre-weighted load-balancing cost
+            # through the state channel; it must join every training loss
+            return l + collect_aux_cost(new_state), new_state
 
         (l, new_state), grads = jax.value_and_grad(
             loss_of, has_aux=True
@@ -134,7 +139,12 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
             preds, new_state = model.forward(
                 p, batch["x"], state=state, training=True, rng=rng
             )
-            return loss_fn.mean(batch.get("y"), preds), new_state
+            from analytics_zoo_tpu.ops.moe import collect_aux_cost
+
+            l = loss_fn.mean(batch.get("y"), preds)
+            # MoE stacks report their pre-weighted load-balancing cost
+            # through the state channel; it must join every training loss
+            return l + collect_aux_cost(new_state), new_state
 
         (l, new_state), grads = jax.value_and_grad(
             loss_of, has_aux=True

@@ -79,17 +79,7 @@ def _process_shard() -> tuple[int, int] | None:
     return None
 
 
-def _collect_aux_cost(state):
-    """Sum every ``moe_aux_cost`` leaf in the model state tree: the
-    pre-weighted auxiliary losses layers report through the state channel
-    (MoE load balancing — keras/layers/self_attention.py _moe_state)."""
-    total = jnp.zeros((), jnp.float32)
-    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
-        last = path[-1]
-        key = getattr(last, "key", getattr(last, "name", None))
-        if key == "moe_aux_cost":
-            total = total + leaf.astype(jnp.float32)
-    return total
+from analytics_zoo_tpu.ops.moe import collect_aux_cost as _collect_aux_cost
 
 
 def _normalize_grad_clip(grad_clip):

@@ -176,6 +176,49 @@ class TestMoETransformerLayer:
                                  jnp.zeros((2, 16), jnp.int32),
                                  n_microbatch=2)
 
+    def test_strategies_steps_include_aux(self):
+        """make_shard_map_train_step must also add the state-channel aux
+        cost — every model.forward-based loss does, not just the
+        estimator's (review finding, round 5)."""
+        import optax
+
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.parallel.strategies import (
+            make_shard_map_train_step,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Dense,
+            Flatten,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+            get_loss,
+        )
+
+        zoo.init_zoo_context(seed=5, mesh_shape={"data": 8})
+        m = Sequential()
+        m.add(self._layer(input_shape=(16,)))
+        m.add(Flatten())
+        m.add(Dense(2, activation="softmax"))
+        params, state = m.build_params()
+        loss_fn = get_loss("sparse_categorical_crossentropy")
+        opt = optax.sgd(0.0)  # lr 0: params unchanged, loss comparable
+        step = make_shard_map_train_step(m, loss_fn, opt)
+
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 32, size=(16, 16)).astype(np.int32)
+        y = rng.integers(0, 2, size=(16,)).astype(np.int32)
+        batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+        p2, _, new_state, l = step(params, opt.init(params), state,
+                                   jax.random.PRNGKey(0), batch)
+        preds, st2 = m.forward(p2, batch["x"], state=state, training=True,
+                               rng=jax.random.PRNGKey(0))
+        task = float(loss_fn.mean(batch["y"], preds))
+        aux_cost = [float(v["moe_aux_cost"]) for v in st2.values()
+                    if isinstance(v, dict) and "moe_aux_cost" in v][0]
+        assert aux_cost > 0.0
+        np.testing.assert_allclose(float(l), task + aux_cost, rtol=1e-5)
+
     def test_fit_includes_aux_and_learns(self):
         """End to end through the estimator: the training loss includes
         the pre-weighted aux cost, and a tiny copy task still learns."""
