@@ -66,6 +66,13 @@ def _constrain_expert_axis(x):
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # inside a shard_map body (manual axes) constraints over mesh axes
+    # are rejected at lowering — there the caller's own specs govern
+    # layout and the expert compute runs shard-local; the constraint is
+    # only for the GSPMD (estimator) path
+    if EXPERT_AXIS in getattr(jax.sharding.get_abstract_mesh(),
+                              "manual_axes", ()):
+        return x
     spec = P(EXPERT_AXIS, *([None] * (x.ndim - 1)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

@@ -36,5 +36,6 @@ from analytics_zoo_tpu.parallel.strategies import (  # noqa: F401
     column_parallel_dense,
     make_shard_map_train_step,
     make_zero1_train_step,
+    reshard_zero1_opt_state,
     row_parallel_dense,
 )

@@ -184,6 +184,50 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     return jax.jit(step, donate_argnums=(0, 1, 2)), init_opt_state
 
 
+def reshard_zero1_opt_state(opt_state, params, mesh=None):
+    """Re-lay an explicit-ZeRO-1 optimizer state (the
+    :func:`make_zero1_train_step` layout) for a DIFFERENT data-axis size —
+    the elastic slice-down/up restart (SURVEY §5): save on ``{data: 8}``,
+    resume on ``{data: 4}`` or vice versa.
+
+    The layout's only mesh-shape dependence is the flat vector's zero-pad
+    to a multiple of the data-axis size n: every 1-D leaf is (a moment
+    mirror of) the padded flat param vector, so resharding = strip the old
+    pad, re-pad for the new n, and place sharded over ``data`` on the new
+    mesh.  0-D leaves (step counts) replicate unchanged.  Works on host
+    numpy trees (a loaded checkpoint) or live jax.Arrays.
+
+    The estimator's GSPMD ZeRO-1 path needs none of this: its checkpoint
+    stores global logical arrays, so restoring onto a different mesh is
+    just a device_put (tests/test_elastic_resume.py proves both paths).
+    """
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import NamedSharding
+
+    import numpy as np
+
+    mesh = mesh or get_zoo_context().mesh
+    n_new = dict(mesh.shape)[DATA_AXIS]
+    size = ravel_pytree(params)[0].size
+    pad_new = (-size) % n_new
+
+    def fix(leaf):
+        # stay on the HOST until the final sharded device_put: jnp ops
+        # here would transiently materialize every params-sized moment on
+        # one device — the allocation ZeRO-1 exists to avoid
+        leaf = np.asarray(leaf)
+        if leaf.ndim == 1 and leaf.size >= size:
+            return np.pad(leaf[:size], (0, pad_new))
+        return leaf
+
+    out = jax.tree_util.tree_map(fix, opt_state)
+    return jax.device_put(
+        out,
+        jax.tree_util.tree_map(
+            lambda l: NamedSharding(
+                mesh, P(DATA_AXIS) if l.ndim >= 1 else P()), out))
+
+
 # ---------------------------------------------------------------------------
 # Tensor-parallel dense blocks (model axis)
 # ---------------------------------------------------------------------------

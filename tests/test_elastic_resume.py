@@ -1,0 +1,172 @@
+"""Elastic resume across mesh shapes (SURVEY §5 slice-down restart;
+VERDICT r4 missing #6): a checkpoint written under one data-axis size must
+resume under another — both the estimator path (save on {data:8}, resume
+on {data:4} and 4→8, ZOO_SHARD_OPTIMIZER ZeRO-1 leaves included) and the
+explicit shard_map ZeRO-1 layout (reshard_zero1_opt_state re-pads the
+flat-vector shards).
+
+The oracle is the straight-through run: SPMD math is mesh-size-invariant
+(the global batch schedule depends only on (seed, epoch)), so the resumed
+curve must equal the uninterrupted one to float tolerance.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(8, 4))
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _fit(mesh_size, ckpt_dir, epochs):
+    """One training leg on a {data: mesh_size} mesh; absolute epoch
+    target so a second call RESUMES from ckpt_dir."""
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    zoo.init_zoo_context(seed=3, mesh_shape={"data": mesh_size})
+    x, y = _data()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,)))
+    m.add(Dense(4, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    if ckpt_dir:
+        m.set_checkpoint(ckpt_dir)
+    m.fit(x, y, batch_size=32, nb_epoch=epochs)
+    res = m.evaluate(x, y, batch_size=32)
+    return {"losses": [h["loss"] for h in m._estimator.history],
+            "eval": res}
+
+
+@pytest.mark.parametrize("n_save,n_resume", [(8, 4), (4, 8)])
+def test_estimator_resume_across_mesh_sizes(tmp_path, n_save, n_resume):
+    ckdir = str(tmp_path / f"ck_{n_save}to{n_resume}")
+    full = _fit(n_save, None, 4)
+
+    first = _fit(n_save, ckdir, 2)
+    np.testing.assert_allclose(first["losses"], full["losses"][:2],
+                               rtol=1e-4, atol=1e-5)
+
+    resumed = _fit(n_resume, ckdir, 4)
+    # resume really happened: only epochs 3..4 trained on the NEW mesh
+    assert len(resumed["losses"]) == 2, resumed["losses"]
+    np.testing.assert_allclose(resumed["losses"], full["losses"][2:],
+                               rtol=1e-4, atol=1e-5)
+    assert abs(resumed["eval"]["loss"] - full["eval"]["loss"]) < 1e-4
+
+
+def test_estimator_resume_with_sharded_optimizer(tmp_path, monkeypatch):
+    """ZeRO-1 (GSPMD) leaves ride the same checkpoint as global logical
+    arrays: 8 -> 4 with ZOO_SHARD_OPTIMIZER=1 on both legs."""
+    monkeypatch.setenv("ZOO_SHARD_OPTIMIZER", "1")
+    ckdir = str(tmp_path / "ck_zero1")
+    full = _fit(8, None, 4)
+    _fit(8, ckdir, 2)
+    resumed = _fit(4, ckdir, 4)
+    assert len(resumed["losses"]) == 2
+    np.testing.assert_allclose(resumed["losses"], full["losses"][2:],
+                               rtol=1e-4, atol=1e-5)
+
+
+class TestExplicitZero1Reshard:
+    """The shard_map ZeRO-1 layout pads the flat param vector to the
+    data-axis size, so ITS state needs real resharding."""
+
+    def _setup(self, mesh_size):
+        import optax
+
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.parallel.strategies import (
+            make_zero1_train_step,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+            get_loss,
+        )
+
+        zoo.init_zoo_context(seed=3, mesh_shape={"data": mesh_size})
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(8,)))
+        m.add(Dense(4, activation="softmax"))
+        params, state = m.build_params()
+        opt = optax.adam(1e-2)
+        loss = get_loss("sparse_categorical_crossentropy")
+        step, init_opt = make_zero1_train_step(m, loss, opt)
+        return m, params, state, step, init_opt
+
+    def test_8_to_4_matches_uninterrupted(self):
+        from analytics_zoo_tpu.parallel import reshard_zero1_opt_state
+
+        x, y = _data()
+        batch = {"x": jnp.asarray(x[:64]), "y": jnp.asarray(y[:64])}
+        rng = jax.random.PRNGKey(0)
+
+        # leg A: 4 steps straight through on 8
+        m, p, st, step8, init8 = self._setup(8)
+        o = init8(p)
+        for _ in range(4):
+            p, o, st, l_full = step8(p, o, st, rng, batch)
+        p_full = jax.tree_util.tree_map(np.asarray, p)
+
+        # leg B: 2 steps on 8, "checkpoint" to host, resume 2 more on 4
+        m, p, st, step8, init8 = self._setup(8)
+        o = init8(p)
+        for _ in range(2):
+            p, o, st, _ = step8(p, o, st, rng, batch)
+        saved = jax.tree_util.tree_map(np.asarray, (p, o, st))
+
+        m4, _, _, step4, _ = self._setup(4)
+        p4, o4, st4 = saved
+        from analytics_zoo_tpu.common.engine import get_zoo_context
+
+        ctx4 = get_zoo_context()
+        p4 = jax.device_put(p4, ctx4.replicated())
+        st4 = jax.device_put(st4, ctx4.replicated())
+        o4 = reshard_zero1_opt_state(o4, p4)
+        for _ in range(2):
+            p4, o4, st4, l4 = step4(p4, o4, st4, rng, batch)
+        p_resumed = jax.tree_util.tree_map(np.asarray, p4)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            p_full, p_resumed)
+        np.testing.assert_allclose(float(l4), float(l_full), rtol=1e-5)
+
+    def test_4_to_8_roundtrip_values(self):
+        """Slice-UP: the resharded state's logical content is identical
+        (pad-strip + re-pad is value-preserving)."""
+        from analytics_zoo_tpu.parallel import reshard_zero1_opt_state
+        from jax.flatten_util import ravel_pytree
+
+        m, p, st, step4, init4 = self._setup(4)
+        o = init4(p)
+        x, y = _data()
+        batch = {"x": jnp.asarray(x[:64]), "y": jnp.asarray(y[:64])}
+        p, o, st, _ = step4(p, o, st, jax.random.PRNGKey(0), batch)
+        saved = jax.tree_util.tree_map(np.asarray, o)
+
+        import analytics_zoo_tpu as zoo
+
+        zoo.init_zoo_context(seed=3, mesh_shape={"data": 8})
+        o8 = reshard_zero1_opt_state(saved, p)
+        size = ravel_pytree(p)[0].size
+        for a, b in zip(jax.tree_util.tree_leaves(saved),
+                        jax.tree_util.tree_leaves(o8)):
+            if np.ndim(a) == 1:
+                np.testing.assert_allclose(np.asarray(b)[:size],
+                                           np.asarray(a)[:size])
+            else:
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a))

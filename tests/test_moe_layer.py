@@ -219,6 +219,45 @@ class TestMoETransformerLayer:
         assert aux_cost > 0.0
         np.testing.assert_allclose(float(l), task + aux_cost, rtol=1e-5)
 
+    def test_shard_map_step_with_expert_axis_runs(self):
+        """Review finding (r5): with an expert axis in the mesh, the
+        sharding constraint inside routed_ffn must not blow up the
+        shard_map train steps (manual axes reject constraints — the op
+        falls back to shard-local compute there)."""
+        import optax
+
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.parallel.strategies import (
+            make_shard_map_train_step,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Dense,
+            Flatten,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+            get_loss,
+        )
+
+        zoo.init_zoo_context(seed=5, mesh_shape={"data": 4, "expert": 2},
+                             mesh_axes=("data", "expert"))
+        m = Sequential()
+        m.add(self._layer(input_shape=(16,)))
+        m.add(Flatten())
+        m.add(Dense(2, activation="softmax"))
+        params, state = m.build_params()
+        opt = optax.sgd(0.1)
+        step = make_shard_map_train_step(
+            m, get_loss("sparse_categorical_crossentropy"), opt)
+        rng = np.random.default_rng(0)
+        batch = {"x": jnp.asarray(
+            rng.integers(0, 32, size=(8, 16)).astype(np.int32)),
+            "y": jnp.asarray(rng.integers(0, 2, size=(8,))
+                             .astype(np.int32))}
+        p2, _, _, l = step(params, opt.init(params), state,
+                           jax.random.PRNGKey(0), batch)
+        assert np.isfinite(float(l))
+
     def test_fit_includes_aux_and_learns(self):
         """End to end through the estimator: the training loss includes
         the pre-weighted aux cost, and a tiny copy task still learns."""
