@@ -31,3 +31,34 @@ def test_digits_split_is_real_data():
     # all ten classes present in both splits
     assert set(np.unique(yt)) == set(range(10))
     assert set(np.unique(yv)) == set(range(10))
+
+
+def test_transformer_char_lm_converges(zoo_ctx):
+    """CI re-check of the ACCURACY_r05 transformer artifact path
+    (VERDICT r4 next #3): the SAME run() the tool uses — estimator step,
+    bf16 params-in-compute, remat, dropout, flash auto-routing — at a
+    tiny config; the loss must drop well below the uniform-byte 5.55
+    nats within one short epoch."""
+    from analytics_zoo_tpu import init_zoo_context
+    from tools.transformer_convergence import corpus_bytes, run
+
+    data = corpus_bytes()[:32768]
+    try:
+        hist, bpc, _ = run(seq=64, blocks=2, hidden=64, heads=2, batch=8,
+                           epochs=1, data=data)
+    finally:
+        # run() switches the global context to bf16 compute; restore the
+        # default so fixture-less tests later in the suite keep f32
+        init_zoo_context(seed=0)
+    assert hist[-1] < 4.0, hist          # uniform = ln(256) = 5.55 nats
+    assert bpc < 6.5, bpc                # held-out follows
+
+
+def test_lenet_augmented_recipe_learns(zoo_ctx):
+    """The ≥99% recipe's augmentation leg (short version): augmented
+    training must still reach the old bar quickly — guards the affine
+    transform from silently corrupting images."""
+    hist, acc, _ = run_lenet(epochs=12, augment=True)
+    # corrupted augmentation would sit near chance (~0.1); the full
+    # 60+15-epoch recipe is the ACCURACY artifact's ≥0.99 run
+    assert acc >= 0.9, acc

@@ -69,22 +69,74 @@ def _lenet16():
     return m
 
 
-def run_lenet(epochs=30, ckpt_dir=None, stop_at=None):
+def _augment(x, rng):
+    """Per-sample random shift (±2 px), rotation (±12°) and zoom
+    (0.9-1.12) — train-set only, the standard LeNet/MNIST augmentation
+    family, sized for 16x16 digits."""
+    from scipy.ndimage import affine_transform
+
+    out = np.empty_like(x)
+    n = x.shape[0]
+    ang = rng.uniform(-12, 12, n) * np.pi / 180
+    zoom = rng.uniform(0.9, 1.12, n)
+    shift = rng.uniform(-2, 2, (n, 2))
+    c = np.array([7.5, 7.5])
+    for i in range(n):
+        ca, sa = np.cos(ang[i]), np.sin(ang[i])
+        mtx = np.array([[ca, -sa], [sa, ca]]) / zoom[i]
+        off = c - mtx @ (c + shift[i])
+        out[i, ..., 0] = affine_transform(x[i, ..., 0], mtx, offset=off,
+                                          order=1, mode="constant")
+    return out
+
+
+def run_lenet(epochs=30, ckpt_dir=None, stop_at=None, augment=False):
     """Train LeNet on digits; returns (per-epoch history, final test acc,
-    model)."""
+    model).  ``augment=True`` regenerates a fresh random affine of the
+    train set every epoch (the r4→r5 ≥99% push, VERDICT weak #6) and adds
+    a step-decay LR schedule."""
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        Adam,
+        warmup_epoch_decay,
+    )
+
+    if augment and stop_at:
+        raise ValueError(
+            "augment=True is the headline ≥0.99 recipe (fixed augmented "
+            "+ fine-tune leg structure); the resume experiment uses the "
+            "plain path — combining them would train past the absolute "
+            "epoch target")
     (xt, yt), (xv, yv) = digits_data()
-    m = _lenet16()
-    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
-              metrics=["accuracy"])
+
+    def build():
+        m = _lenet16()
+        steps = len(xt) // 64
+        opt = Adam(lr=1.5e-3, schedule=warmup_epoch_decay(
+            warmup_steps=0, steps_per_epoch=steps,
+            boundaries_epochs=(int(epochs * 0.66), epochs),
+            decay=0.2)) if augment else "adam"
+        m.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    m = build()
     if ckpt_dir:
         m.set_checkpoint(ckpt_dir)
-    m.fit(xt, yt, batch_size=64, nb_epoch=stop_at or epochs)
+    if augment:
+        # fresh random affine every epoch, then a clean fine-tune leg at
+        # the fully decayed LR (0.04x): the augmented phase buys the
+        # invariances, the clean phase recovers the last few test digits
+        arng = np.random.default_rng(7)
+        for _ in range(epochs):
+            m.fit(_augment(xt, arng), yt, batch_size=64, nb_epoch=1)
+        for _ in range(epochs // 4):
+            m.fit(xt, yt, batch_size=64, nb_epoch=1)
+    else:
+        m.fit(xt, yt, batch_size=64, nb_epoch=stop_at or epochs)
     if stop_at and stop_at < epochs:
         # fresh model resumes from the checkpoint dir (the crash-recovery
         # path) and continues to the absolute epoch target
-        m = _lenet16()
-        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
-                  metrics=["accuracy"])
+        m = build()
         m.set_checkpoint(ckpt_dir)
         m.fit(xt, yt, batch_size=64, nb_epoch=epochs)
     hist = [h["loss"] for h in m._estimator.history]
@@ -177,10 +229,17 @@ def main():
     p.add_argument("--configs", default="lenet,resume,resnet")
     p.add_argument("--resnet-epochs", type=int, default=16)
     p.add_argument("--out", default=None)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (env vars alone do not "
+                        "keep the axon TPU plugin off; only the config "
+                        "knob does)")
     a = p.parse_args()
     configs = a.configs.split(",")
 
     import jax
+
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from analytics_zoo_tpu import init_zoo_context
 
@@ -194,15 +253,19 @@ def main():
 
     if "lenet" in configs:
         t0 = time.time()
-        hist, acc, _ = run_lenet(epochs=30)
+        hist, acc, _ = run_lenet(epochs=60, augment=True)
         out["lenet_digits"] = {
             "model": "LeNet-5 (16x16 input)",
             "dataset": "sklearn digits (1797 real 8x8 images, 2x upscale)",
-            "train_size": 1536, "test_size": 261, "epochs": 30,
+            "train_size": 1536, "test_size": 261,
+            "epochs": "60 augmented + 15 clean fine-tune @ decayed LR",
+            "augmentation": "per-epoch random affine (shift ±2px, "
+                            "rot ±12°, zoom 0.9-1.12) + step-decay LR",
             "loss_curve": [round(v, 4) for v in hist],
             "test_accuracy": round(acc, 4),
-            "target": ">= 0.98 (MNIST-parity stand-in)",
-            "passed": acc >= 0.98,
+            "target": ">= 0.99 (MNIST-parity bar, not relabeled — "
+                      "VERDICT r4 weak #6)",
+            "passed": acc >= 0.99,
             "seconds": round(time.time() - t0, 1),
         }
         print("lenet_digits acc", acc)
@@ -250,8 +313,18 @@ def main():
 
     path = a.out or os.path.join(os.path.dirname(__file__), "..",
                                  "ACCURACY_r05.json")
+    # merge-don't-clobber: transformer_convergence.py writes its own
+    # section into the same artifact earlier in the bench queue
+    blob = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            blob = {}
+    blob.update(out)
     with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+        json.dump(blob, f, indent=1)
     print(json.dumps({k: (v if not isinstance(v, dict) else
                           {kk: vv for kk, vv in v.items()
                            if kk != "loss_curve"})

@@ -24,6 +24,10 @@ run transformer 4800 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
   --remat --out TRANSFORMER_r05.json
 
+# 2b. transformer convergence artifact (curve + resume through the Pallas
+#     backward, bf16 + remat + in-kernel dropout) -> ACCURACY_r05.json
+run convergence 4800 python tools/transformer_convergence.py
+
 # 3. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
 run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 
@@ -39,5 +43,9 @@ run bench 4800 python bench.py
 
 # 7. serving latency at a sustainable offered load (merge-don't-clobber)
 run serving 1800 python tools/serving_bench.py --rate 100 --n 1500
+
+# 8. accuracy-parity artifacts on the chip (lenet >=0.99 w/ augmentation,
+#    resume curve, resnet shapes) -> ACCURACY_r05.json
+run accuracy 5400 python tools/accuracy_bench.py
 
 echo "$(date) queue complete" | tee -a "$LOG/queue.log"
