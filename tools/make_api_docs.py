@@ -1,0 +1,173 @@
+"""Generate the per-module API reference under docs/api/ from docstrings
+(VERDICT r4 next #9: the largest remaining docs gap vs the reference's
+mkdocs site, closed without hand-writing 5k lines).
+
+One markdown page per public module of ``analytics_zoo_tpu``: the module
+docstring, then every public class (init signature, docstring, public
+methods with their first docstring paragraph) and public function
+(signature + docstring).  ``docs/api/index.md`` is the table of contents.
+
+Usage: python tools/make_api_docs.py   (rerun after API changes; CI
+checks the tree is in sync via tests/test_api_docs.py)
+"""
+
+import importlib
+import inspect
+import os
+import pkgutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # no backend init at import
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+OUT = os.path.join(REPO, "docs", "api")
+PKG = "analytics_zoo_tpu"
+
+
+def _sig(obj) -> str:
+    import re
+
+    try:
+        s = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # default-value reprs carry memory addresses (nondeterministic
+    # across runs; the sync test would always fail): strip them
+    s = re.sub(r"<function ([\w.]+) at 0x[0-9a-f]+>", r"\1", s)
+    s = re.sub(r"<([\w.]+) object at 0x[0-9a-f]+>", r"<\1>", s)
+    return s
+
+
+def _first_para(doc: str) -> str:
+    if not doc:
+        return ""
+    return inspect.cleandoc(doc).split("\n\n")[0]
+
+
+def _doc(doc: str) -> str:
+    return inspect.cleandoc(doc) if doc else ""
+
+
+def _public_members(mod):
+    """Classes/functions DEFINED in this module (not re-exports), public
+    name, in source order."""
+    members = []
+    for name, obj in vars(mod).items():
+        if name.startswith("_"):
+            continue
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", None) != mod.__name__:
+            continue
+        try:
+            line = inspect.getsourcelines(obj)[1]
+        except (OSError, TypeError):
+            line = 0
+        members.append((line, name, obj))
+    return [(n, o) for _, n, o in sorted(members)]
+
+
+def render_module(mod) -> str | None:
+    members = _public_members(mod)
+    moddoc = _doc(mod.__doc__)
+    if not members and not moddoc:
+        return None
+    lines = [f"# `{mod.__name__}`", ""]
+    if moddoc:
+        lines += [moddoc, ""]
+    for name, obj in members:
+        if inspect.isclass(obj):
+            lines += [f"## class `{name}{_sig(obj)}`", ""]
+            d = _doc(obj.__doc__)
+            if d:
+                lines += [d, ""]
+            for mname, m in sorted(vars(obj).items()):
+                if mname.startswith("_"):
+                    continue
+                # unwrap descriptors: vars() yields raw classmethod/
+                # staticmethod/property objects, not callables
+                kind = ""
+                if isinstance(m, (classmethod, staticmethod)):
+                    kind = ("classmethod " if isinstance(m, classmethod)
+                            else "staticmethod ")
+                    m = m.__func__
+                elif isinstance(m, property):
+                    md = _first_para(getattr(m, "__doc__", None))
+                    lines.append(f"- **property `{mname}`**"
+                                 + (f" — {md}" if md else ""))
+                    continue
+                if not callable(m):
+                    continue
+                md = _first_para(getattr(m, "__doc__", None))
+                lines.append(f"- **{kind}`{mname}{_sig(m)}`**"
+                             + (f" — {md}" if md else ""))
+            lines.append("")
+        else:
+            lines += [f"## `{name}{_sig(obj)}`", ""]
+            d = _doc(obj.__doc__)
+            if d:
+                lines += [d, ""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def generate() -> dict[str, str]:
+    """module name -> rendered markdown (import failures are skipped with
+    a stderr note — optional-dependency modules)."""
+    pages = {}
+    pkg = importlib.import_module(PKG)
+
+    def onerror(name):  # subpackage __init__ import failure: note + go on
+        print(f"skip subtree {name}: import failed", file=sys.stderr)
+
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=PKG + ".",
+                                      onerror=onerror):
+        name = info.name
+        if any(part.startswith("_") for part in name.split(".")):
+            continue
+        try:
+            mod = importlib.import_module(name)
+        except Exception as e:  # optional deps (torch/tf interop, ...)
+            print(f"skip {name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            continue
+        page = render_module(mod)
+        if page:
+            pages[name] = page
+    return pages
+
+
+def main():
+    pages = generate()
+    os.makedirs(OUT, exist_ok=True)
+    # clear stale pages so renames don't leave orphans
+    for f in os.listdir(OUT):
+        if f.endswith(".md"):
+            os.remove(os.path.join(OUT, f))
+    index = ["# API reference", "",
+             f"Generated from docstrings by `tools/make_api_docs.py` "
+             f"({len(pages)} modules).  Regenerate after API changes.",
+             ""]
+    by_pkg: dict[str, list[str]] = {}
+    for name in sorted(pages):
+        sub = name.split(".")[1] if "." in name else ""
+        by_pkg.setdefault(sub, []).append(name)
+    for sub in sorted(by_pkg):
+        index.append(f"## {sub or PKG}")
+        index.append("")
+        for name in by_pkg[sub]:
+            fname = name.replace(".", "_") + ".md"
+            with open(os.path.join(OUT, fname), "w") as f:
+                f.write(pages[name])
+            index.append(f"- [`{name}`]({fname})")
+        index.append("")
+    with open(os.path.join(OUT, "index.md"), "w") as f:
+        f.write("\n".join(index).rstrip() + "\n")
+    print(f"wrote {len(pages)} pages to docs/api/")
+
+
+if __name__ == "__main__":
+    main()
