@@ -114,14 +114,18 @@ def render_module(mod) -> str | None:
     return "\n".join(lines).rstrip() + "\n"
 
 
-def generate() -> dict[str, str]:
-    """module name -> rendered markdown (import failures are skipped with
-    a stderr note — optional-dependency modules)."""
+def generate() -> tuple[dict[str, str], list[str]]:
+    """(module name -> rendered markdown, skipped module names).  Import
+    failures are skipped with a stderr note — optional-dependency
+    modules; their committed pages are PRESERVED by main(), not deleted,
+    so regenerating in a leaner environment cannot drop docs."""
     pages = {}
+    skipped: list[str] = []
     pkg = importlib.import_module(PKG)
 
     def onerror(name):  # subpackage __init__ import failure: note + go on
         print(f"skip subtree {name}: import failed", file=sys.stderr)
+        skipped.append(name)
 
     for info in pkgutil.walk_packages(pkg.__path__, prefix=PKG + ".",
                                       onerror=onerror):
@@ -133,19 +137,24 @@ def generate() -> dict[str, str]:
         except Exception as e:  # optional deps (torch/tf interop, ...)
             print(f"skip {name}: {type(e).__name__}: {e}",
                   file=sys.stderr)
+            skipped.append(name)
             continue
         page = render_module(mod)
         if page:
             pages[name] = page
-    return pages
+    return pages, skipped
 
 
 def main():
-    pages = generate()
+    pages, skipped = generate()
     os.makedirs(OUT, exist_ok=True)
-    # clear stale pages so renames don't leave orphans
+    keep = {s.replace(".", "_") + ".md" for s in skipped}
+    keep |= {s.replace(".", "_") + "_" for s in skipped}  # subtree prefix
+    # clear stale pages so renames don't leave orphans — but never the
+    # pages of modules this environment couldn't import
     for f in os.listdir(OUT):
-        if f.endswith(".md"):
+        if f.endswith(".md") and f not in keep \
+                and not any(f.startswith(p) for p in keep):
             os.remove(os.path.join(OUT, f))
     index = ["# API reference", "",
              f"Generated from docstrings by `tools/make_api_docs.py` "
