@@ -1,0 +1,217 @@
+"""Cross-host actor transport — the piece that makes ``parallel.actors``
+span a TPU pod the way the reference's RayOnSpark spanned a Spark cluster
+(pyzoo/zoo/ray/util/raycontext.py:192-393: one raylet per executor host;
+here, one :func:`start_worker_server` per pod host).
+
+Wire design: the driver keeps ONE TCP connection per remote actor (the
+ordering guarantee of the actor model falls out of TCP's in-order
+delivery, exactly as the local path's pipe gives it).  The first message
+on a fresh connection is the cloudpickled ``(cls, args, kwargs)`` spawn
+payload; the worker server spawns the actor as a local **spawn** process
+(same fork-safety contract as single-host actors) and then shuttles
+messages between socket and pipe until either side closes.  Frames are
+``struct`` length-prefixed pickles — the same (call_id, method, args,
+kwargs) tuples the local path uses, so :class:`actors.ActorHandle` drives
+both transports unchanged.
+
+Launch on each host (the role of ``ray start`` in raycontext.py):
+
+    python -m analytics_zoo_tpu.parallel.actor_worker --port 9040
+
+then on the driver::
+
+    ActorContext.init(workers=["host1:9040", "host2:9040"])
+    h = MyActor.options(worker="host2:9040").remote(...)
+    # or worker=1 (index into the registered list), or unset: round-robin
+
+SECURITY: frames are pickle — run worker servers only on a trusted,
+private interconnect (the TPU pod network), exactly like Ray's raylet
+protocol.  The server binds 0.0.0.0 by default for pod use; bind
+127.0.0.1 for local testing.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct(">Q")
+
+
+class SockConn:
+    """Pipe-shaped adapter over a socket: send/recv/poll/close — the
+    surface ``ActorHandle`` needs, so it can drive either transport.
+
+    ``poll`` reports True only when a FULL frame is buffered (on a pipe,
+    poll-true implies a whole message; a raw socket select() only means
+    *some* bytes arrived — treating that as message-ready would let a
+    stalled peer that sent half a frame hang ``get(timeout)`` forever).
+    The receive buffer is a bytearray (amortized O(n) accumulation, not
+    O(n²) bytes concatenation — parameter-server replies are large)."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+
+    def send(self, obj):
+        payload = pickle.dumps(obj)
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _frame_len(self):
+        """Length of the buffered frame, or None if incomplete."""
+        if len(self._buf) < _LEN.size:
+            return None
+        (n,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
+        return n if len(self._buf) >= _LEN.size + n else None
+
+    def _fill(self, timeout) -> bool:
+        """Buffer until a full frame is present; False on timeout."""
+        import select
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._frame_len() is None:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            r, _, _ = select.select([self._sock], [], [], remaining)
+            if not r:
+                return False
+            chunk = self._sock.recv(1 << 20)
+            if not chunk:
+                raise EOFError("actor connection closed")
+            self._buf += chunk
+        return True
+
+    def recv(self):
+        self._fill(None)
+        n = self._frame_len()
+        payload = bytes(self._buf[_LEN.size:_LEN.size + n])
+        del self._buf[:_LEN.size + n]
+        return pickle.loads(payload)
+
+    def poll(self, timeout=None) -> bool:
+        return self._fill(timeout)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _serve_connection(sock: socket.socket):
+    """One accepted driver connection == one actor lifetime."""
+    import multiprocessing as mp
+
+    conn = SockConn(sock)
+    proc = None
+    try:
+        kind, payload = conn.recv()
+        if kind != "spawn":
+            conn.send(("init_error", f"bad first frame {kind!r}"))
+            return
+        try:
+            from analytics_zoo_tpu.parallel.actors import _actor_loop
+
+            spawn = mp.get_context("spawn")
+            parent, child = spawn.Pipe()
+            proc = spawn.Process(target=_actor_loop,
+                                 args=(payload, child), daemon=True)
+            proc.start()
+            child.close()
+        except Exception:
+            # surface spawn failures as the same init_error frame the
+            # local path produces (an ActorError with traceback on the
+            # driver), and keep the server-side record
+            import traceback
+
+            tb = traceback.format_exc()
+            print(f"actor spawn failed:\n{tb}", file=__import__("sys")
+                  .stderr)
+            conn.send(("init_error", tb))
+            return
+
+        # pipe -> socket pump in a side thread; socket -> pipe inline
+        def pump():
+            try:
+                while True:
+                    conn.send(parent.recv())
+            except (EOFError, OSError):
+                conn.close()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            while True:
+                msg = conn.recv()
+                parent.send(msg)
+                if msg is None:  # shutdown sentinel, same as local path
+                    break
+        except EOFError:
+            pass
+        proc.join(timeout=5)
+    except EOFError:
+        pass  # driver went away: normal teardown
+    except Exception:
+        import sys
+        import traceback
+
+        print(f"actor connection error:\n{traceback.format_exc()}",
+              file=sys.stderr)
+    finally:
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+        conn.close()
+
+
+def start_worker_server(port: int, bind: str = "0.0.0.0",
+                        block: bool = True):
+    """Accept actor placements on this host (the raylet role).  With
+    ``block=False`` returns the listening socket and serves from a
+    daemon thread (tests / embedding in a launcher)."""
+    srv = socket.create_server((bind, port), reuse_port=False)
+
+    def loop():
+        while True:
+            try:
+                sock, _ = srv.accept()
+            except OSError:  # closed
+                return
+            threading.Thread(target=_serve_connection, args=(sock,),
+                             daemon=True).start()
+
+    if block:
+        loop()  # returns only when the listen socket dies/closes
+        return srv
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return srv
+
+
+def connect_and_spawn(addr: str, payload: bytes) -> SockConn:
+    """Driver side: open the actor's connection and send the spawn
+    payload; returns the live conn (first reply is the ready/err frame,
+    read by ActorHandle exactly as on the local path)."""
+    host, port = addr.rsplit(":", 1)
+    conn = SockConn(socket.create_connection((host, int(port)),
+                                             timeout=30))
+    conn._sock.settimeout(None)
+    conn.send(("spawn", payload))
+    return conn
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, default=9040)
+    p.add_argument("--bind", default="0.0.0.0")
+    a = p.parse_args()
+    print(f"actor worker serving on {a.bind}:{a.port}")
+    start_worker_server(a.port, a.bind)
+
+
+if __name__ == "__main__":
+    main()

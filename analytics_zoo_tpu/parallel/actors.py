@@ -59,7 +59,10 @@ def _actor_loop(payload, conn):
         conn.send(("init_error", traceback.format_exc()))
         return
     while True:
-        msg = conn.recv()
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return  # driver/worker gone: quiet exit (daemon teardown)
         if msg is None:  # shutdown
             return
         call_id, method, m_args, m_kwargs = msg
@@ -127,21 +130,31 @@ class ActorHandle:
     lock while others sleep on a condition variable, and ``get(timeout)``
     is a TOTAL deadline, not per-message."""
 
-    def __init__(self, cls, args, kwargs, ctx):
+    def __init__(self, cls, args, kwargs, ctx, worker: str | None = None):
         import cloudpickle
 
         self._ctx = ctx
-        spawn = mp.get_context("spawn")  # fork-unsafe next to JAX threads
-        parent, child = spawn.Pipe()
-        self._conn = parent
         # cloudpickle-by-value: the spawned interpreter has no import path
         # to nested/test-local classes, and module-level ones are shadowed
         # by the @remote wrapper anyway
         payload = cloudpickle.dumps((cls, args, kwargs))
-        self._proc = spawn.Process(
-            target=_actor_loop, args=(payload, child),
-            daemon=True)  # daemon: dies with the parent (JVMGuard role)
-        self._proc.start()
+        if worker is not None:
+            # cross-host placement: the actor lives on the worker server's
+            # host; this handle holds one TCP conn (ordering = TCP order)
+            from analytics_zoo_tpu.parallel.actor_worker import (
+                connect_and_spawn,
+            )
+
+            self._conn = connect_and_spawn(worker, payload)
+            self._proc = None
+        else:
+            spawn = mp.get_context("spawn")  # fork-unsafe next to JAX
+            parent, child = spawn.Pipe()
+            self._conn = parent
+            self._proc = spawn.Process(
+                target=_actor_loop, args=(payload, child),
+                daemon=True)  # daemon: dies with the parent (JVMGuard)
+            self._proc.start()
         import weakref
 
         self._send_lock = threading.Lock()
@@ -230,20 +243,32 @@ class ActorHandle:
     def terminate(self):
         try:
             self._conn.send(None)
-            self._proc.join(timeout=5)
-        except (BrokenPipeError, OSError):
+            if self._proc is not None:
+                self._proc.join(timeout=5)
+        except (BrokenPipeError, OSError, EOFError):
             pass
-        if self._proc.is_alive():
+        if self._proc is not None and self._proc.is_alive():
             self._proc.terminate()
+        close = getattr(self._conn, "close", None)
+        if close:
+            close()
 
 
 class _RemoteClass:
-    def __init__(self, cls):
+    def __init__(self, cls, worker=None):
         self._cls = cls
+        self._worker = worker
+
+    def options(self, worker=None) -> "_RemoteClass":
+        """Placement options (the ``.options()`` surface of ray):
+        ``worker`` is a registered worker address ("host:port"), an index
+        into ``ActorContext.init(workers=[...])``, or None (local)."""
+        return _RemoteClass(self._cls, worker=worker)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         ctx = ActorContext.current()
-        return ActorHandle(self._cls, args, kwargs, ctx)
+        return ActorHandle(self._cls, args, kwargs, ctx,
+                           worker=ctx._resolve_worker(self._worker))
 
     def __call__(self, *args, **kwargs):
         return self._cls(*args, **kwargs)  # local construction still works
@@ -314,19 +339,49 @@ def get(refs, timeout: float | None = None):
 class ActorContext:
     """Runtime holder (the RayContext.init/stop surface)."""
 
-    def __init__(self, num_pool_workers: int = 2):
+    def __init__(self, num_pool_workers: int = 2, workers=None):
         from concurrent.futures import ProcessPoolExecutor
 
         self._actors: list[ActorHandle] = []
+        # cross-host worker servers ("host:port") — actor_worker.py; an
+        # actor with no explicit placement round-robins over them when
+        # any are registered, else spawns locally
+        self._workers: list[str] = list(workers or [])
+        self._rr = 0
         self._pool = ProcessPoolExecutor(
             max_workers=num_pool_workers,
             mp_context=mp.get_context("spawn"))
 
+    def _resolve_worker(self, worker) -> str | None:
+        if worker is None:
+            if not self._workers:
+                return None
+            addr = self._workers[self._rr % len(self._workers)]
+            self._rr += 1
+            return addr
+        if isinstance(worker, int):
+            if not 0 <= worker < len(self._workers):
+                raise ValueError(
+                    f"worker index {worker} out of range: "
+                    f"{len(self._workers)} worker server(s) registered "
+                    "(ActorContext.init(workers=['host:port', ...]))")
+            return self._workers[worker]
+        if worker == "local":
+            return None
+        return str(worker)
+
     @classmethod
-    def init(cls, num_pool_workers: int = 2) -> "ActorContext":
+    def init(cls, num_pool_workers: int = 2,
+             workers=None) -> "ActorContext":
+        """Start the runtime (≈ RayContext.init).  ``workers``: list of
+        ``"host:port"`` actor worker servers (one per pod host, started
+        with ``python -m analytics_zoo_tpu.parallel.actor_worker``) —
+        actors then place across hosts, round-robin by default."""
         global _CONTEXT
         if _CONTEXT is None:
-            _CONTEXT = cls(num_pool_workers)
+            _CONTEXT = cls(num_pool_workers, workers=workers)
+        elif workers:
+            _CONTEXT._workers = list(workers)
         return _CONTEXT
 
     @classmethod

@@ -56,6 +56,19 @@ def test_no_orphan_pages():
         assert ok, f"orphan page {f}: no importable module matches"
 
 
+def test_index_links_every_page():
+    """The TOC and the page set move together: every committed page is
+    linked from index.md and every link resolves."""
+    import re
+
+    with open(os.path.join(API, "index.md")) as f:
+        idx = f.read()
+    links = set(re.findall(r"\]\((\S+\.md)\)", idx))
+    pages = {f for f in os.listdir(API)
+             if f.endswith(".md") and f != "index.md"}
+    assert links == pages, (links ^ pages)
+
+
 def test_committed_pages_match_generator():
     """Regenerate EVERY page in memory and compare against the committed
     tree — drift anywhere means someone changed an API without rerunning

@@ -156,12 +156,17 @@ def main():
         if f.endswith(".md") and f not in keep \
                 and not any(f.startswith(p) for p in keep):
             os.remove(os.path.join(OUT, f))
+    # preserved pages (modules this env couldn't import) stay in the TOC
+    listed = dict.fromkeys(sorted(pages))
+    for s in skipped:
+        if os.path.exists(os.path.join(OUT, s.replace(".", "_") + ".md")):
+            listed[s] = None
     index = ["# API reference", "",
              f"Generated from docstrings by `tools/make_api_docs.py` "
-             f"({len(pages)} modules).  Regenerate after API changes.",
+             f"({len(listed)} modules).  Regenerate after API changes.",
              ""]
     by_pkg: dict[str, list[str]] = {}
-    for name in sorted(pages):
+    for name in sorted(listed):
         sub = name.split(".")[1] if "." in name else ""
         by_pkg.setdefault(sub, []).append(name)
     for sub in sorted(by_pkg):
@@ -169,8 +174,9 @@ def main():
         index.append("")
         for name in by_pkg[sub]:
             fname = name.replace(".", "_") + ".md"
-            with open(os.path.join(OUT, fname), "w") as f:
-                f.write(pages[name])
+            if name in pages:
+                with open(os.path.join(OUT, fname), "w") as f:
+                    f.write(pages[name])
             index.append(f"- [`{name}`]({fname})")
         index.append("")
     with open(os.path.join(OUT, "index.md"), "w") as f:
