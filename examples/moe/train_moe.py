@@ -1,0 +1,77 @@
+"""Routed mixture-of-experts transformer training — a capability the
+reference never had (SURVEY.md §2.4 makes expert parallelism first-class;
+the reference's TransformerLayer.scala:137 feed-forward is a dense MLP).
+
+``TransformerLayer(moe_experts=E, moe_top_k=k)`` swaps every block's
+feed-forward for a GShard-style routed MoE (ops/moe.py): top-k routing
+with expert capacity behind the residual, the load-balancing auxiliary
+loss joining the training loss automatically through the layer-state
+channel.  On a mesh with an ``expert`` axis the expert dimension shards
+across devices (dryrun phase 6 trains this config on a data x expert
+mesh).
+
+The task is the attention example's marker-majority classification, so
+the two examples are directly comparable: same data, dense vs MoE FFN.
+
+Usage:
+    python examples/moe/train_moe.py --epochs 6 --experts 4
+"""
+
+import argparse
+
+
+def run(epochs=6, n=1024, vocab=128, seq_len=24, batch_size=64,
+        experts=4, top_k=2):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Dense,
+        GlobalAveragePooling1D,
+        TransformerLayer,
+    )
+    from examples.attention.transformer import make_data
+
+    init_zoo_context("moe example")
+    x, y = make_data(n, vocab, seq_len)
+    xv, yv = make_data(256, vocab, seq_len, seed=1)
+
+    tokens = Input(shape=(seq_len,), name="tokens")
+    core = TransformerLayer(vocab=vocab, seq_len=seq_len, n_block=2,
+                            n_head=4, hidden_size=64,
+                            moe_experts=experts, moe_top_k=top_k,
+                            name="moe_core")
+    seq = core(tokens)
+    pooled = GlobalAveragePooling1D()(seq)
+    out = Dense(2, activation="softmax")(pooled)
+    model = Model(tokens, out, name="moe_transformer")
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+              validation_data=(xv, yv))
+    res = model.evaluate(xv, yv, batch_size=batch_size)
+    # the layer-state channel carries the router health metrics
+    moe_state = [v for v in model.state.values()
+                 if isinstance(v, dict) and "moe_aux_loss" in v][0]
+    res["moe_aux_loss"] = float(moe_state["moe_aux_loss"])
+    res["moe_drop_fraction"] = float(moe_state["moe_drop_fraction"])
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--experts", type=int, default=4)
+    ap.add_argument("--top-k", type=int, default=2)
+    args = ap.parse_args()
+    res = run(epochs=args.epochs, experts=args.experts, top_k=args.top_k)
+    print(f"validation: {res}")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    main()

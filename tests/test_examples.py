@@ -447,3 +447,13 @@ def test_model_inference_streaming_image_classification():
                   key=lambda kv: int(kv[0].split("-")[1].split(".")[0]))]
     correct = sum(1 for g, t in zip(got, truth) if g == t)
     assert correct >= 4, (got, truth)
+
+
+def test_moe_example_learns_with_healthy_router():
+    from examples.moe.train_moe import run
+
+    res = run(epochs=4, n=512, batch_size=64)
+    assert res["accuracy"] > 0.7, res       # 2 classes, chance 0.5
+    # aux ~1.0 = balanced router; >2 would be collapsing
+    assert 0.5 < res["moe_aux_loss"] < 2.0, res
+    assert res["moe_drop_fraction"] < 0.4, res

@@ -31,6 +31,11 @@ run convergence 4800 python tools/transformer_convergence.py
 # 3. pure-step + dispatch/H2D/matmul probes (device-resident, fetch-forced)
 run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 
+# 3b. r03->r04 drop bisect (interleaved repeats + control, 4 fresh
+#     estimator builds) -> PERF_BISECT_r05.json.  Generous timeout: a
+#     SIGTERM mid-compile wedges the tunnel (PERF_r04_STATUS lesson #1)
+run bisect 5400 python tools/perf_probe.py --bisect --batch 256 --steps 20
+
 # 4. jax.profiler trace of the pure step -> PROFILE_r05/
 run profile 3000 python tools/profile_step.py 256
 
