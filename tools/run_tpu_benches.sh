@@ -36,6 +36,11 @@ run perf 3000 python tools/perf_probe.py --batch 256 --steps 20
 #     SIGTERM mid-compile wedges the tunnel (PERF_r04_STATUS lesson #1)
 run bisect 5400 python tools/perf_probe.py --bisect --batch 256 --steps 20
 
+# 3c. XLA flag sweep over the pure step -> FLAGSWEEP_r05.json (each
+#     combo is a fresh subprocess with its own 2400s budget; bad-flag or
+#     slow combos are contained; stage budget covers all 4 combos)
+run flagsweep 10800 python tools/flag_sweep.py --batch 256 --steps 20
+
 # 4. jax.profiler trace of the pure step -> PROFILE_r05/
 run profile 3000 python tools/profile_step.py 256
 
