@@ -24,6 +24,13 @@ run transformer 4800 python tools/transformer_bench.py \
   --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
   --remat --out TRANSFORMER_r05.json
 
+# 2a. remat-policy sweep point: 'attn' saves only the per-block attention
+#     context (less recompute than full) — whichever wins becomes the
+#     headline MFU claim
+run transformer_attn 4800 python tools/transformer_bench.py \
+  --seq 2048 --batch 8 --blocks 8 --hidden 2560 --heads 20 --steps 8 \
+  --remat attn --out TRANSFORMER_r05_attn.json
+
 # 2b. transformer convergence artifact (curve + resume through the Pallas
 #     backward, bf16 + remat + in-kernel dropout) -> ACCURACY_r05.json
 run convergence 4800 python tools/transformer_convergence.py
