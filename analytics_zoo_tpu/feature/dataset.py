@@ -12,8 +12,11 @@ TPU-native re-design of the reference's ``FeatureSet``
   iterator per partition (FeatureSet.scala:240-289) → seeded, *checkpointable*
   per-epoch shuffles: iterator state is (epoch, cursor, seed), so resume is
   exact — the reference's Spark iterators were not resumable, only retryable.
-- PMEM tier (feature/pmem/*) → host RAM **is** the fast tier on a TPU VM; the
-  tier enum is kept for API parity.
+- PMEM tier (feature/pmem/*) → memory-mapped spool files on local SSD:
+  ``FeatureSet.array(..., memory_type="PMEM")`` spills the arrays to
+  ``.npy`` files and reads batches through the page cache, the TPU-VM
+  analogue of Optane's beyond-DRAM byte-addressable capacity (see
+  :meth:`ArrayFeatureSet.spill_to_mmap`).
 
 The ``batch_size % num_model_replicas == 0`` contract follows the reference's
 TFDataset (pyzoo .../net/tf_dataset.py:136-143); batches here are globally
@@ -30,7 +33,13 @@ import numpy as np
 
 from analytics_zoo_tpu.feature.common import Preprocessing
 
-MemoryType = str  # "DRAM" | "DISK_<n>" | "PMEM" (API parity; PMEM==DRAM)
+# "DRAM" | "DISK_<n>" | "PMEM".  PMEM (reference FeatureSet.scala's
+# Optane tier: byte-addressable capacity beyond DRAM) maps on a TPU-VM to
+# memory-mapped local-SSD files: the arrays spill to .npy spool files and
+# batches read through the page cache, so resident memory is O(touched
+# pages) and the OS evicts under pressure — datasets beyond RAM train
+# with the same ArrayFeatureSet iterator contract (exact resume included).
+MemoryType = str
 
 
 def _as_list(x):
@@ -66,10 +75,20 @@ class FeatureSet:
 
     @staticmethod
     def array(x, y=None, sample_weight=None,
-              memory_type: MemoryType = "DRAM") -> "FeatureSet":
+              memory_type: MemoryType = "DRAM",
+              spool_dir: str | None = None) -> "FeatureSet":
         """Reference ``FeatureSet.array``/``FeatureSet.rdd``
-        (FeatureSet.scala:423-466) — memory_type selects the tier."""
+        (FeatureSet.scala:423-466) — memory_type selects the tier:
+        ``"PMEM"`` spills the arrays to memory-mapped spool files (see
+        module note), ``"DRAM"`` keeps them resident.
+
+        ``spool_dir``: where PMEM spool files land.  Point it at real
+        local SSD when the default tempdir is tmpfs (RAM-backed) or a
+        small partition — a tmpfs spool would hold the data in RAM
+        twice, defeating the tier."""
         fs = ArrayFeatureSet(x, y, sample_weight)
+        if str(memory_type).upper() == "PMEM":
+            fs.spill_to_mmap(spool_dir)
         return fs
 
     @staticmethod
@@ -213,6 +232,32 @@ class ArrayFeatureSet(FeatureSet):
     @property
     def num_samples(self) -> int:
         return self._n
+
+    def spill_to_mmap(self, spool_dir: str | None = None):
+        """The PMEM tier: rewrite every array as an ``.npy`` spool file
+        and reopen it memory-mapped read-only.  Batch fancy-indexing then
+        touches only the needed pages; the page cache is the fast tier
+        and the OS reclaims it under pressure (the role persistent
+        memory played for the reference's DRAMFeatureSet variant)."""
+        import tempfile
+
+        self._spool = tempfile.TemporaryDirectory(
+            prefix="zoo_pmem_", dir=spool_dir)  # kept: deletes on GC
+
+        def mm(arrs, tag):
+            if arrs is None:
+                return None
+            out = []
+            for i, a in enumerate(arrs):
+                path = os.path.join(self._spool.name, f"{tag}{i}.npy")
+                np.save(path, a)
+                out.append(np.load(path, mmap_mode="r"))
+            return out
+
+        self.xs = mm(self.xs, "x")
+        self.ys = mm(self.ys, "y")
+        self.ws = mm(self.ws, "w")
+        return self
 
     def batches(self, batch_size, shuffle=True, seed=0, epoch=0,
                 drop_last=True, start_batch=0, pad_to_batch=None,
