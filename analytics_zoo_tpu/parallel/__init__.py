@@ -25,6 +25,7 @@ from analytics_zoo_tpu.parallel.partition import (  # noqa: F401
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     gpipe,
     gpipe_1f1b_grads,
+    gpipe_hetero_1f1b_grads,
     stack_stage_params,
     transformer_gpipe,
 )

@@ -266,6 +266,27 @@ def _pipeline_local_hetero(edge_params, stacked_params, x_mb, *, stage_fns,
         *valid)
 
 
+def _infer_boundaries(stage_fns, edge_params, stacked_params, x_mb,
+                      rows: int):
+    """Chain jax.eval_shape through the stages to get every boundary
+    struct and the (f32, int32) union-buffer sizes — shared by
+    gpipe_hetero and gpipe_hetero_1f1b_grads so the two entry points can
+    never diverge on what frames they encode.  ``rows``: per-shard rows
+    of one microbatch (mb // dp when composing with DP)."""
+    n_stages = len(stage_fns)
+    stacked_local_struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+        stacked_params)
+    bound = [jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct((rows,) + a.shape[2:], a.dtype),
+        x_mb)]
+    for i in range(n_stages):
+        bound.append(jax.eval_shape(
+            stage_fns[i], edge_params[i], stacked_local_struct, bound[i]))
+    sizes = [_pair_sizes(s) for s in bound]
+    return bound, max(f for f, _ in sizes), max(i for _, i in sizes)
+
+
 def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
                  n_microbatch, mesh=None, axis_name: str = PIPE_AXIS,
                  batch_axis: str | None = None):
@@ -309,17 +330,8 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
         lambda a: a.reshape((n_microbatch, mb) + a.shape[1:]), x)
 
     # infer LOCAL per-boundary structs (rows sharded over batch_axis)
-    stacked_local_struct = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), stacked_params)
-    bound = [jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct((mb // dp,) + a.shape[2:], a.dtype),
-        x_mb)]
-    for i in range(n_stages):
-        bound.append(jax.eval_shape(
-            stage_fns[i], edge_params[i], stacked_local_struct, bound[i]))
-    sizes = [_pair_sizes(s) for s in bound]
-    flen = max(f for f, _ in sizes)
-    ilen = max(i for _, i in sizes)
+    bound, flen, ilen = _infer_boundaries(stage_fns, edge_params,
+                                          stacked_params, x_mb, mb // dp)
 
     if n_stages == 1:
         one = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
@@ -553,6 +565,188 @@ def gpipe_1f1b_grads(stage_fn, loss_fn, stage_params, x, y, *,
         check_vma=False,
     )
     return fn(stage_params, x_mb, y_mb)
+
+
+def _pipeline_local_1f1b_hetero(edge_params, stacked_params, x_mb, y_mb,
+                                *, stage_fns, loss_fn, axis_name,
+                                n_stages, n_micro, boundaries, out_struct,
+                                flen, ilen):
+    """Per-shard 1F1B over HETEROGENEOUS stages — the same dual-slot
+    schedule as :func:`_pipeline_local_1f1b` (see its docstring for the
+    tick math and the ring-store safety argument) over the union-buffer
+    carry of :func:`_pipeline_local_hetero`: activations travel as a
+    (f32, int32) frame pair, each stage decodes/encodes its own boundary
+    struct inside a ``lax.switch``.
+
+    Backward specifics of the encoded carry: only the FLOAT buffer
+    carries gradient (the int payload — token ids — is forward-only), so
+    the cotangent ring is fbuf-shaped and ``jax.vjp`` is taken with the
+    saved int frame closed over.  Parameter cotangents: every shard's
+    ``lax.switch`` vjp yields zeros for the branches it didn't run, so a
+    ``psum`` over the pipe axis assembles the full edge-param gradients
+    (replicated), while the stacked (stage-sharded) gradients stay
+    local."""
+    idx = lax.axis_index(axis_name)
+    s_count, m_count = n_stages, n_micro
+    ring_cap = 2 * s_count
+    stacked_local = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    fwd_perm = [(j, (j + 1) % s_count) for j in range(s_count)]
+    bwd_perm = [(j, (j - 1) % s_count) for j in range(s_count)]
+    n_ticks = m_count + 2 * s_count - 1
+    is_last = idx == s_count - 1
+
+    def stage_apply(edge, stacked_l, fbuf, ibuf):
+        def make_branch(i):
+            def branch(args):
+                e, sl, fb, ib = args
+                act = _decode((fb, ib), boundaries[i])
+                out = stage_fns[i](e[i], sl, act)
+                return _encode(out, flen, ilen)
+            return branch
+
+        return lax.switch(idx, [make_branch(i) for i in range(s_count)],
+                          (edge, stacked_l, fbuf, ibuf))
+
+    def scaled_loss(out_bufs, y):
+        out = _decode(out_bufs, out_struct)
+        return loss_fn(out, y) / m_count
+
+    def tick(carry, t):
+        (act_f, act_i), ct_in, (ring_f, ring_i), gacc, lacc = carry
+
+        # ---- forward slot: stage idx advances microbatch t - idx
+        mf = t - idx
+        inj_f, inj_i = (jax.tree_util.tree_map(
+            lambda a: a[jnp.clip(mf, 0, m_count - 1)], x_mb))
+        a_f = jnp.where(idx == 0, inj_f, act_f)
+        a_i = jnp.where(idx == 0, inj_i, act_i)
+        ring_f = ring_f.at[mf % ring_cap].set(a_f)
+        ring_i = ring_i.at[mf % ring_cap].set(a_i)
+        out_f = stage_apply(edge_params, stacked_local, a_f, a_i)
+
+        # ---- backward slot: stage idx back-props mb t - 2S + 1 + idx
+        mb_ = t - 2 * s_count + 1 + idx
+        b_valid = (mb_ >= 0) & (mb_ < m_count)
+        saved_f = ring_f[mb_ % ring_cap]
+        saved_i = ring_i[mb_ % ring_cap]
+        (out_bf, out_bi), vjp = jax.vjp(
+            lambda e, sl, fb: stage_apply(e, sl, fb, saved_i),
+            edge_params, stacked_local, saved_f)
+        y_here = jax.tree_util.tree_map(
+            lambda a: a[jnp.clip(mb_, 0, m_count - 1)], y_mb)
+        l_val, ct_loss = jax.value_and_grad(
+            lambda fb: scaled_loss((fb, out_bi), y_here))(out_bf)
+        ct_out = jnp.where(is_last, ct_loss, ct_in)
+        # integer outputs take float0 cotangents (not int zeros)
+        import numpy as _np
+
+        ct_i = _np.zeros(out_bi.shape, jax.dtypes.float0)
+        g_edge, g_stacked, ct_prev = vjp((ct_out, ct_i))
+        gacc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(b_valid, d, jnp.zeros_like(d)),
+            gacc, (g_edge, g_stacked))
+        lacc = lacc + jnp.where(is_last & b_valid, l_val, 0.0)
+
+        act_next = tuple(lax.ppermute(a, axis_name, fwd_perm)
+                         for a in out_f)
+        ct_next = lax.ppermute(
+            jnp.where(b_valid, ct_prev, jnp.zeros_like(ct_prev)),
+            axis_name, bwd_perm)
+        return (act_next, ct_next, (ring_f, ring_i), gacc, lacc), None
+
+    act0 = (jnp.zeros((flen,), jnp.float32), jnp.zeros((ilen,), jnp.int32))
+    ring0 = (jnp.zeros((ring_cap, flen), jnp.float32),
+             jnp.zeros((ring_cap, ilen), jnp.int32))
+    gacc0 = jax.tree_util.tree_map(
+        jnp.zeros_like, (edge_params, stacked_local))
+    (_, _, _, (g_edge, g_stacked), lacc), _ = lax.scan(
+        tick, (act0, jnp.zeros((flen,), jnp.float32), ring0, gacc0,
+               jnp.zeros((), jnp.float32)),
+        jnp.arange(n_ticks))
+    loss = lax.psum(lacc, axis_name)
+    # each shard holds cotangents only for ITS branch; assemble
+    g_edge = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), g_edge)
+    return loss, g_edge, jax.tree_util.tree_map(
+        lambda g: g[None], g_stacked)
+
+
+def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
+                            loss_fn, *, n_microbatch, mesh=None,
+                            axis_name: str = PIPE_AXIS):
+    """Loss and gradients of a HETEROGENEOUS pipeline (the
+    :func:`gpipe_hetero` stage contract: embed → blocks → head with
+    free-form boundaries) under the 1F1B memory schedule — O(S) live
+    activation frames per stage instead of ``jax.grad(gpipe_hetero)``'s
+    O(M) saved tick outputs.  This is 1F1B at exactly the model shape PP
+    exists for: the full LM whose ends change activation shape.
+
+    Args follow :func:`gpipe_hetero` (stage_fns, edge_params,
+    stacked_params, x) plus ``loss_fn(final_act_mb, y_mb) -> scalar``
+    (mean over one microbatch's rows; the returned loss is the mean over
+    microbatches).  Unlike ``gpipe_hetero`` there is NO ``batch_axis``
+    yet: PP x DP composition of the hetero 1F1B schedule would need
+    per-data-shard frame encoding — run it on a pipe-only mesh (the
+    homogeneous :func:`gpipe_1f1b_grads` does compose with DP).
+
+    Returns ``(loss, edge_grads, stacked_grads)`` — loss and edge grads
+    replicated, stacked grads with leading dim S (pipe-sharded).
+    """
+    mesh = mesh or get_zoo_context().mesh
+    n_stages = dict(mesh.shape).get(axis_name, 1)
+    if len(stage_fns) != n_stages:
+        raise ValueError(
+            f"{len(stage_fns)} stage_fns != pipe axis size {n_stages}")
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked_params leading dim {leaf.shape[0]} != pipe "
+                f"axis size {n_stages} (leaf shape {leaf.shape}); for "
+                "multiple blocks per stage use a (S, per, ...) layout "
+                "with the blocks folded inside the stage fn")
+    b = jax.tree_util.tree_leaves(x)[0].shape[0]
+    if b % n_microbatch:
+        raise ValueError(f"batch {b} not divisible by M={n_microbatch}")
+    mb = b // n_microbatch
+    x_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_microbatch, mb) + a.shape[1:]), x)
+    y_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_microbatch, mb) + a.shape[1:]), y)
+
+    if n_stages == 1:
+        # no pipe axis: run the stages sequentially under value_and_grad
+        def whole(params):
+            e, sl_stacked = params
+            sl = jax.tree_util.tree_map(lambda a: a[0], sl_stacked)
+            per = []
+            for m in range(n_microbatch):
+                act = jax.tree_util.tree_map(lambda a: a[m], x_mb)
+                act = stage_fns[0](e[0], sl, act)
+                per.append(loss_fn(act, jax.tree_util.tree_map(
+                    lambda a: a[m], y_mb)))
+            return jnp.mean(jnp.stack(per))
+
+        loss, (g_edge, g_stacked) = jax.value_and_grad(whole)(
+            (tuple(edge_params), stacked_params))
+        return loss, g_edge, g_stacked
+
+    bound, flen, ilen = _infer_boundaries(stage_fns, edge_params,
+                                          stacked_params, x_mb, mb)
+
+    # pre-encode the microbatches once (vmapped over M)
+    x_enc = jax.vmap(lambda m: _encode(m, flen, ilen))(x_mb)
+
+    fn = jax.shard_map(
+        partial(_pipeline_local_1f1b_hetero, stage_fns=stage_fns,
+                loss_fn=loss_fn, axis_name=axis_name, n_stages=n_stages,
+                n_micro=n_microbatch, boundaries=bound,
+                out_struct=bound[n_stages], flen=flen, ilen=ilen),
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(), P()),
+        out_specs=(P(), P(), P(axis_name)),
+        check_vma=False,
+    )
+    return fn(tuple(edge_params), stacked_params, x_enc, y_mb)
 
 
 def stack_stage_params(per_stage: list):

@@ -323,8 +323,11 @@ def main():
         except (OSError, ValueError):
             blob = {}
     blob.update(out)
-    with open(path, "w") as f:
+    # atomic: never leave a half-written artifact
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(blob, f, indent=1)
+    os.replace(tmp, path)
     print(json.dumps({k: (v if not isinstance(v, dict) else
                           {kk: vv for kk, vv in v.items()
                            if kk != "loss_curve"})

@@ -80,7 +80,10 @@ def build(seq, blocks, hidden, heads, remat, ckpt_dir=None):
 
 def bpc_of(net, xv, yv, batch):
     ev = net.evaluate(xv, yv, batch_size=batch)
-    return float(ev["loss"]) / np.log(2.0)
+    # plain python float: np.float64 would poison the JSON artifact
+    # (np.bool_/np.float64 are not json-serializable, and a failed dump
+    # mid-write corrupts the file)
+    return float(ev["loss"] / float(np.log(2.0)))
 
 
 def run(seq=256, blocks=4, hidden=256, heads=4, batch=16, epochs=2,
@@ -153,15 +156,15 @@ def main():
                    f"({len(data)} bytes, 90/10 split)",
         "epochs": a.epochs,
         "loss_curve_nats": [round(v, 4) for v in hist],
-        "heldout_bits_per_char": round(bpc, 4),
+        "heldout_bits_per_char": round(float(bpc), 4),
         "target": "<= 2.0 bpc held-out (uniform = 8.0; gzip -9 ~ 2.1)",
-        "passed": bpc <= 2.0,
+        "passed": bool(bpc <= 2.0),
         "resume": {
             "resumed_tail": [round(v, 5) for v in r_hist],
             "uninterrupted_tail": [round(v, 5) for v in tail],
-            "max_abs_deviation": round(max_dev, 6),
-            "heldout_bpc_resumed": round(r_bpc, 4),
-            "passed": max_dev < 2e-3 and abs(r_bpc - bpc) < 0.05,
+            "max_abs_deviation": round(float(max_dev), 6),
+            "heldout_bpc_resumed": round(float(r_bpc), 4),
+            "passed": bool(max_dev < 2e-3 and abs(r_bpc - bpc) < 0.05),
         },
         "platform": d.platform, "device_kind": d.device_kind,
         "seconds": round(time.time() - t0, 1),
@@ -170,11 +173,17 @@ def main():
     path = a.out or os.path.join(REPO, "ACCURACY_r05.json")
     blob = {}
     if os.path.exists(path):
-        with open(path) as f:
-            blob = json.load(f)
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+        except ValueError:
+            blob = {}  # recover from a previously corrupted artifact
     blob["transformer_char_lm"] = section
-    with open(path, "w") as f:
+    # atomic: a serialization error must never leave a half-written file
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(blob, f, indent=1)
+    os.replace(tmp, path)
     print(json.dumps({k: v for k, v in section.items()
                       if k != "loss_curve_nats"}))
 
