@@ -614,10 +614,15 @@ def _pipeline_local_1f1b_hetero(edge_params, stacked_params, x_mb, y_mb,
     def tick(carry, t):
         (act_f, act_i), ct_in, (ring_f, ring_i), gacc, lacc = carry
 
-        # ---- forward slot: stage idx advances microbatch t - idx
+        # ---- forward slot: stage idx advances microbatch t - idx.
+        # Encode the injected microbatch HERE, from the raw (token-sized)
+        # input: pre-encoding all M frames would stage M copies padded to
+        # the LARGEST boundary (the logits frame for an LM) — O(M·flen)
+        # replicated per shard, eroding the O(S) live set this schedule
+        # exists to provide.
         mf = t - idx
-        inj_f, inj_i = (jax.tree_util.tree_map(
-            lambda a: a[jnp.clip(mf, 0, m_count - 1)], x_mb))
+        inj_f, inj_i = _encode(jax.tree_util.tree_map(
+            lambda a: a[jnp.clip(mf, 0, m_count - 1)], x_mb), flen, ilen)
         a_f = jnp.where(idx == 0, inj_f, act_f)
         a_i = jnp.where(idx == 0, inj_i, act_i)
         ring_f = ring_f.at[mf % ring_cap].set(a_f)
@@ -714,17 +719,15 @@ def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
         lambda a: a.reshape((n_microbatch, mb) + a.shape[1:]), y)
 
     if n_stages == 1:
-        # no pipe axis: run the stages sequentially under value_and_grad
+        # no pipe axis: one vmapped stage body under value_and_grad (an
+        # unrolled python loop would trace M stage copies)
         def whole(params):
             e, sl_stacked = params
             sl = jax.tree_util.tree_map(lambda a: a[0], sl_stacked)
-            per = []
-            for m in range(n_microbatch):
-                act = jax.tree_util.tree_map(lambda a: a[m], x_mb)
-                act = stage_fns[0](e[0], sl, act)
-                per.append(loss_fn(act, jax.tree_util.tree_map(
-                    lambda a: a[m], y_mb)))
-            return jnp.mean(jnp.stack(per))
+            per = jax.vmap(
+                lambda xm, ym: loss_fn(stage_fns[0](e[0], sl, xm), ym)
+            )(x_mb, y_mb)
+            return jnp.mean(per)
 
         loss, (g_edge, g_stacked) = jax.value_and_grad(whole)(
             (tuple(edge_params), stacked_params))
@@ -732,9 +735,6 @@ def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
 
     bound, flen, ilen = _infer_boundaries(stage_fns, edge_params,
                                           stacked_params, x_mb, mb)
-
-    # pre-encode the microbatches once (vmapped over M)
-    x_enc = jax.vmap(lambda m: _encode(m, flen, ilen))(x_mb)
 
     fn = jax.shard_map(
         partial(_pipeline_local_1f1b_hetero, stage_fns=stage_fns,
@@ -746,7 +746,7 @@ def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
         out_specs=(P(), P(), P(axis_name)),
         check_vma=False,
     )
-    return fn(tuple(edge_params), stacked_params, x_enc, y_mb)
+    return fn(tuple(edge_params), stacked_params, x_mb, y_mb)
 
 
 def stack_stage_params(per_stage: list):
