@@ -100,12 +100,40 @@ def peak_flops_for(device_kind: str) -> float | None:
     return None
 
 
+def adopt_sweep_flags():
+    """If the XLA flag sweep (tools/flag_sweep.py -> FLAGSWEEP_r05.json)
+    found a combo beating baseline by >=1%, adopt its flags for the
+    headline run.  Must run BEFORE any jax import: XLA_FLAGS is read at
+    backend init.  Returns the adopted combo name or None."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "FLAGSWEEP_r05.json")
+    try:
+        with open(path) as f:
+            sweep = json.load(f)
+    except (OSError, ValueError):
+        return None
+    best, gain = sweep.get("best"), sweep.get("gain_pct")
+    if not best or best == "baseline" or not gain or gain < 1.0:
+        return None
+    flags = sweep["results"][best]["flags"]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + flags).strip()
+    return f"{best} (+{gain}%)"
+
+
 def main():
     if os.environ.get("ZOO_BENCH_FORCE_CPU"):
         platform, diags = "cpu", ["forced CPU rerun after mid-run TPU loss"]
     else:
         platform, diags = resolve_platform()
     fell_back = platform == "cpu"
+    # adopt only once the platform resolved to TPU: the sweep's xla_tpu_*
+    # flags are a FATAL 'Unknown flag' abort on the CPU backend, which
+    # would break every fallback path's a-number-always-lands contract
+    # (probe runs in a subprocess, so setting XLA_FLAGS here still
+    # precedes the in-process backend init)
+    pre_adopt_flags = os.environ.get("XLA_FLAGS")
+    adopted = None if fell_back else adopt_sweep_flags()
     if fell_back:
         # Force-CPU the same way the test harness does; the axon plugin
         # ignores JAX_PLATFORMS, only the config knob is honored.
@@ -143,6 +171,12 @@ def main():
         if not on_tpu:
             raise
         env = dict(os.environ, ZOO_BENCH_FORCE_CPU="1")
+        # the child runs on CPU: it must not inherit adopted TPU-only
+        # flags (fatal 'Unknown flag' on the CPU backend)
+        if pre_adopt_flags is None:
+            env.pop("XLA_FLAGS", None)
+        else:
+            env["XLA_FLAGS"] = pre_adopt_flags
         rr = subprocess.run([sys.executable, os.path.abspath(__file__)],
                             capture_output=True, text=True, env=env)
         line = (rr.stdout or "").strip().splitlines()
@@ -164,6 +198,7 @@ def main():
 
     out = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "xla_flags_adopted": adopted,
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_IMAGES_PER_SEC, 3),
