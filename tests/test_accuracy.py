@@ -4,9 +4,13 @@ digits to a convergence bar, and bit-exact checkpoint-resume curve
 reproduction (reference resume semantics, TrainImageNet.scala:104-118;
 exact iterator state resume is feature/dataset.py's contract)."""
 
+import os
+
 import numpy as np
 
 from tools.accuracy_bench import digits_data, run_lenet
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 def test_lenet_digits_converges(zoo_ctx, tmp_path):
@@ -33,25 +37,47 @@ def test_digits_split_is_real_data():
     assert set(np.unique(yv)) == set(range(10))
 
 
-def test_transformer_char_lm_converges(zoo_ctx):
+def test_transformer_char_lm_converges():
     """CI re-check of the ACCURACY_r05 transformer artifact path
     (VERDICT r4 next #3): the SAME run() the tool uses — estimator step,
     bf16 params-in-compute, remat, dropout, flash auto-routing — at a
     tiny config; the loss must drop well below the uniform-byte 5.55
-    nats within one short epoch."""
-    from analytics_zoo_tpu import init_zoo_context
-    from tools.transformer_convergence import corpus_bytes, run
+    nats within one short epoch.
 
-    data = corpus_bytes()[:32768]
-    try:
-        hist, bpc, _ = run(seq=64, blocks=2, hidden=64, heads=2, batch=8,
-                           epochs=1, data=data)
-    finally:
-        # run() switches the global context to bf16 compute; restore the
-        # default so fixture-less tests later in the suite keep f32
-        init_zoo_context(seed=0)
-    assert hist[-1] < 4.0, hist          # uniform = ln(256) = 5.55 nats
-    assert bpc < 6.5, bpc                # held-out follows
+    Runs in a SUBPROCESS: under full-suite memory/thread pressure the
+    XLA CPU runtime intermittently SIGABRTs inside this training loop
+    (observed twice, never reproducible standalone in 7 attempts);
+    isolation keeps a runtime-level abort from killing the whole suite
+    run, and the fresh interpreter also leaves the parent's global
+    context untouched (run() switches it to bf16)."""
+    import json
+    import subprocess
+    import sys
+
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json, sys
+sys.path.insert(0, ".")
+from tools.transformer_convergence import corpus_bytes, run
+data = corpus_bytes()[:32768]
+hist, bpc, _ = run(seq=64, blocks=2, hidden=64, heads=2, batch=8,
+                   epochs=1, data=data)
+print("RESULT " + json.dumps({"last": float(hist[-1]),
+                              "bpc": float(bpc)}))
+"""
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PYTHONPATH", None)   # keep the axon plugin out entirely
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                         env=env, capture_output=True, text=True,
+                         timeout=900)
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    r = json.loads(line[len("RESULT "):])
+    assert r["last"] < 4.0, r            # uniform = ln(256) = 5.55 nats
+    assert r["bpc"] < 6.5, r             # held-out follows
 
 
 def test_lenet_augmented_recipe_learns(zoo_ctx):
