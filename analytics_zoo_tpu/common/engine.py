@@ -28,6 +28,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
+if not hasattr(jax, "shard_map"):  # pragma: no branch
+    # Compat: this image ships jax 0.4.x, where shard_map lives in
+    # jax.experimental with `check_rep` instead of the later `check_vma`
+    # keyword.  The framework is written against the public jax.shard_map
+    # surface; adapt here ONCE (engine is imported before any parallel
+    # module) instead of forking every call site.
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, mesh, in_specs, out_specs, check_vma=True,
+                          **kwargs):
+        kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    # marker for call sites that must fail LOUDLY where the 0.4.x
+    # semantics are known not to match (parallel/pipeline.py hetero+DP)
+    _compat_shard_map._zoo_compat_04x = True
+    jax.shard_map = _compat_shard_map
+
+try:
+    # Same 0.4.x-era rename: pallas-TPU CompilerParams was
+    # TPUCompilerParams (same dataclass fields).
+    from jax.experimental.pallas import tpu as _pltpu
+
+    if not hasattr(_pltpu, "CompilerParams") \
+            and hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except Exception:  # pragma: no cover - pallas absent on some builds
+    pass
+
 # Canonical mesh-axis names, ordered outermost-first.  DCN-crossing axes
 # (multi-slice data parallelism) must come first so that XLA lays collectives
 # on ICI for the inner axes.

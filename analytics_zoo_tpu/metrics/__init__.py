@@ -1,0 +1,54 @@
+"""``analytics_zoo_tpu.metrics`` — unified observability subsystem.
+
+One measurement substrate for the whole stack (ISSUE 1): a process-global
+:class:`MetricsRegistry` of labeled Counter/Gauge/Histogram families, a
+contextvar-nested :func:`span` tracer exporting Chrome-trace JSON, and
+exporters for Prometheus text, JSONL, and the in-repo TensorBoard
+writers.  Instrumented by default in the estimator fit loop
+(`zoo_train_*`), Cluster Serving (`zoo_serving_*`), pooled inference
+(`zoo_inference_*`) and the pipeline-parallel schedules
+(`zoo_pipeline_*`); disable with ``ZOO_METRICS=0`` / ``ZOO_TRACE=0``
+(then every recording call is a shared no-op — zero per-step cost).
+
+See ``docs/observability.md`` for the API tour and metric catalogue.
+"""
+
+from analytics_zoo_tpu.metrics.exporters import (
+    JsonlExporter,
+    TensorBoardExporter,
+    prometheus_text,
+    sample_key,
+    snapshot,
+    write_jsonl,
+)
+from analytics_zoo_tpu.metrics.registry import (
+    DEFAULT_BUCKETS,
+    NULL,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullMetric,
+    get_registry,
+    set_registry,
+)
+from analytics_zoo_tpu.metrics.runtime import (
+    ServingMetrics,
+    StepMetrics,
+    record_device_memory,
+)
+from analytics_zoo_tpu.metrics.tracing import (
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "NullMetric",
+    "NULL", "DEFAULT_BUCKETS", "get_registry", "set_registry",
+    "Tracer", "span", "get_tracer", "set_tracer",
+    "prometheus_text", "snapshot", "sample_key", "JsonlExporter",
+    "write_jsonl", "TensorBoardExporter",
+    "StepMetrics", "ServingMetrics", "record_device_memory",
+]

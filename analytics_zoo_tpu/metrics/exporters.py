@@ -1,0 +1,172 @@
+"""Exporters: Prometheus text exposition, JSONL append, TensorBoard bridge.
+
+One registry snapshot, three render targets:
+
+- :func:`prometheus_text` — the `text exposition format
+  <https://prometheus.io/docs/instrumenting/exposition_formats/>`_ a
+  scraper (or a human with curl) reads; histograms expose cumulative
+  ``_bucket{le=...}`` series plus ``_sum``/``_count``.
+- :class:`JsonlExporter` / :func:`write_jsonl` — append one JSON object
+  per snapshot to a file; ``tools/metrics_dump.py`` renders these into a
+  latency/throughput table.
+- :class:`TensorBoardExporter` — bridge into the existing event-file
+  writers (:mod:`analytics_zoo_tpu.tensorboard.writer`): every sample
+  becomes an ``add_scalar`` so serving/estimator telemetry lands next to
+  the Loss/Throughput curves already written there.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+from analytics_zoo_tpu.metrics.registry import MetricsRegistry, get_registry
+
+__all__ = [
+    "prometheus_text", "JsonlExporter", "write_jsonl",
+    "TensorBoardExporter", "sample_key",
+]
+
+
+def sample_key(sample: dict) -> str:
+    """Canonical flat key for one :func:`snapshot` sample —
+    ``name`` or ``name{label=value,...}`` — shared by every consumer
+    that needs a dict key per labeled series (``tools/metrics_dump.py``,
+    ``tools/serving_bench.py``), so the two JSON outputs agree."""
+    labels = sample.get("labels")
+    if not labels:
+        return sample["name"]
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{sample['name']}{{{inner}}}"
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace(
+        '"', r'\"')
+
+
+def _label_str(labels: dict, extra: dict | None = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(items.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def prometheus_text(registry: MetricsRegistry | None = None) -> str:
+    """Render a registry snapshot in Prometheus text exposition format."""
+    reg = registry if registry is not None else get_registry()
+    lines: list[str] = []
+    for fam in reg.collect():
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for labels, child in fam.samples():
+            if fam.kind == "histogram":
+                # one snapshot for buckets AND sum/count: the exposition
+                # must satisfy _bucket{le="+Inf"} == _count even with
+                # concurrent observes mid-scrape
+                bkts, h_sum, h_count = child.export_state()
+                for bound, cum in bkts:
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_label_str(labels, {'le': _fmt(bound)})}"
+                        f" {cum}")
+                lines.append(
+                    f"{fam.name}_sum{_label_str(labels)}"
+                    f" {_fmt(h_sum)}")
+                lines.append(
+                    f"{fam.name}_count{_label_str(labels)} {h_count}")
+            else:
+                lines.append(
+                    f"{fam.name}{_label_str(labels)} {_fmt(child.get())}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot(registry: MetricsRegistry | None = None,
+             step: int | None = None) -> dict:
+    """One registry snapshot as a plain JSON-able dict — the JSONL line
+    shape (also what ``bench.py`` embeds in its result line)."""
+    reg = registry if registry is not None else get_registry()
+    samples = []
+    for fam in reg.collect():
+        for labels, child in fam.samples():
+            s = {"name": fam.name, "kind": fam.kind}
+            if labels:
+                s["labels"] = labels
+            if fam.kind == "histogram":
+                s.update(child.summary())
+            else:
+                s["value"] = child.get()
+            samples.append(s)
+    doc = {"ts": time.time(), "samples": samples}
+    if step is not None:
+        doc["step"] = int(step)
+    return doc
+
+
+class JsonlExporter:
+    """Append registry snapshots to a JSONL file (one object per line)."""
+
+    def __init__(self, path: str,
+                 registry: MetricsRegistry | None = None):
+        self.path = path
+        self._registry = registry
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def write(self, step: int | None = None) -> dict:
+        doc = snapshot(self._registry, step=step)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(doc) + "\n")
+        return doc
+
+
+def write_jsonl(path: str, registry: MetricsRegistry | None = None,
+                step: int | None = None) -> dict:
+    """One-shot :class:`JsonlExporter` append."""
+    return JsonlExporter(path, registry).write(step=step)
+
+
+class TensorBoardExporter:
+    """Bridge a registry snapshot into an event-file writer.
+
+    ``writer`` is anything with ``add_scalar(tag, value, step)`` — a
+    :class:`~analytics_zoo_tpu.tensorboard.writer.FileWriter` or any of
+    the TrainSummary/ValidationSummary/InferenceSummary wrappers.
+    Histograms export their summary as ``<name>/p50`` etc. (event files
+    carry scalars; the full bucket vector stays in Prometheus/JSONL).
+    """
+
+    def __init__(self, writer, registry: MetricsRegistry | None = None):
+        self._writer = writer
+        self._registry = registry
+
+    def export(self, step: int) -> int:
+        """Write every sample at ``step``; returns #scalars written."""
+        reg = (self._registry if self._registry is not None
+               else get_registry())
+        n = 0
+        for fam in reg.collect():
+            for labels, child in fam.samples():
+                tag = fam.name + _label_str(labels)
+                if fam.kind == "histogram":
+                    for k, v in child.summary().items():
+                        self._writer.add_scalar(f"{tag}/{k}", v, step)
+                        n += 1
+                else:
+                    self._writer.add_scalar(tag, child.get(), step)
+                    n += 1
+        return n

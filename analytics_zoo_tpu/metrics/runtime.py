@@ -1,0 +1,153 @@
+"""Runtime collectors: per-step breakdown and device-memory gauges.
+
+The tf.data lesson (PAPERS.md: "tf.data: A Machine Learning Data
+Processing Framework"): the data-wait vs. compute split must be measured
+*inside* the framework, per step, not reconstructed per-benchmark.
+:class:`StepMetrics` is that split for the estimator fit loop:
+
+- ``data_wait``   — blocking on the infeed queue (host batch assembly +
+  H2D dispatch the double-buffered feeder failed to hide);
+- ``dispatch``    — handing the sharded batch to the jitted step
+  (host-side async dispatch cost);
+- ``step``        — one full loop iteration wall time (data_wait +
+  dispatch + callback/trigger work; device compute overlaps it).
+
+All three are histograms, so the exporters carry p50/p95/p99 — tail
+behavior (a stalling input pipeline shows up as a fat data_wait p99 long
+before it moves the mean).
+
+:func:`record_device_memory` snapshots ``device.memory_stats()`` into
+gauges when the backend provides it (TPU does; CPU returns None — the
+collector is a silent no-op there).
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.metrics.registry import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+)
+
+__all__ = ["StepMetrics", "ServingMetrics", "record_device_memory"]
+
+# Step-time shaped buckets (seconds): the shared latency bounds minus
+# the 30s tail — a 30s TRAIN step is not a resolution we need, and
+# deriving (not copying) keeps the two tables in sync.
+STEP_BUCKETS = DEFAULT_BUCKETS[:-1]
+
+# Batch sizes are small integers; bound buckets cover 1..4096.
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class StepMetrics:
+    """Fit-loop breakdown recorder.
+
+    Children are resolved ONCE at construction, so the per-step cost is
+    three ``observe`` + two ``inc`` calls — and on a disabled registry
+    every one of those is the shared no-op singleton (no allocation)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.data_wait = reg.histogram(
+            "zoo_train_data_wait_seconds",
+            "time blocked on the infeed queue per step",
+            buckets=STEP_BUCKETS)
+        self.dispatch = reg.histogram(
+            "zoo_train_step_dispatch_seconds",
+            "host-side jitted-step dispatch time per step",
+            buckets=STEP_BUCKETS)
+        self.step = reg.histogram(
+            "zoo_train_step_seconds",
+            "full loop-iteration wall time per step",
+            buckets=STEP_BUCKETS)
+        self.steps = reg.counter(
+            "zoo_train_steps_total", "train steps dispatched")
+        self.records = reg.counter(
+            "zoo_train_records_total", "training records consumed")
+        self.throughput = reg.gauge(
+            "zoo_train_throughput_records_per_sec",
+            "end-to-end fit throughput, updated per epoch")
+        self.epoch = reg.gauge("zoo_train_epoch", "current epoch")
+
+    def record_step(self, data_wait_s: float, dispatch_s: float,
+                    step_s: float, batch_size: int):
+        self.data_wait.observe(data_wait_s)
+        self.dispatch.observe(dispatch_s)
+        self.step.observe(step_s)
+        self.steps.inc()
+        self.records.inc(batch_size)
+
+    def record_epoch(self, epoch: int, throughput: float):
+        self.epoch.set(epoch)
+        self.throughput.set(throughput)
+
+
+class ServingMetrics:
+    """Cluster Serving telemetry (one instance per :class:`ClusterServing`).
+
+    Gauges/histograms follow the queueing-system canon: offered depth,
+    service batch size, end-to-end service latency, broker pressure."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        # callers gate work done ONLY to feed a metric (e.g. the extra
+        # broker xlen round-trip for queue_depth) on this flag — the
+        # NULL children silently discard values, but the side channel
+        # that produced them is not free
+        self.enabled = reg.enabled
+        self.queue_depth = reg.gauge(
+            "zoo_serving_queue_depth",
+            "input-stream backlog after the poll")
+        self.batch_size = reg.histogram(
+            "zoo_serving_batch_size",
+            "records per served micro-batch", buckets=BATCH_BUCKETS)
+        self.latency = reg.histogram(
+            "zoo_serving_step_latency_seconds",
+            "decode -> predict -> write-back latency per non-empty step "
+            "(poll/block wait excluded)")
+        self.predict_latency = reg.histogram(
+            "zoo_serving_predict_seconds",
+            "model predict time per micro-batch group")
+        self.records = reg.counter(
+            "zoo_serving_records_total", "records served")
+        self.trims = reg.counter(
+            "zoo_serving_backpressure_trims_total",
+            "backpressure stream cuts (ClusterServing.scala:128-134 role)")
+        self.memory_ratio = reg.gauge(
+            "zoo_serving_broker_memory_ratio",
+            "broker used/max memory in [0,1]")
+
+
+def record_device_memory(registry: MetricsRegistry | None = None) -> int:
+    """Snapshot per-device memory stats into gauges.
+
+    Returns the number of devices that reported stats (0 on backends
+    without ``memory_stats``, e.g. CPU — then no gauges are touched)."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return 0
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return 0
+    reported = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        reported += 1
+        dev = str(d.id)
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                reg.gauge(
+                    f"zoo_device_{key}",
+                    "per-device HBM usage (jax memory_stats)",
+                    labelnames=("device",),
+                ).labels(device=dev).set(stats[key])
+    return reported

@@ -1,0 +1,207 @@
+"""Step tracing — nested ``span()`` blocks exported as Chrome-trace JSON.
+
+The role of the reference's ``Utils.timeIt`` logging, upgraded to a
+structured timeline: every ``with span("zoo.train.step")`` records one
+complete event (``ph: "X"``) into the process-global :class:`Tracer`;
+``Tracer.to_chrome_trace()`` renders the ``chrome://tracing`` /
+Perfetto-loadable document, the same format ``jax.profiler`` traces use
+so the two timelines can be eyeballed side by side.
+
+Spans nest through a :mod:`contextvars` variable, so nesting is correct
+across threads (the serving loop thread and the infeed thread each get
+their own span stack) and each event records its parent span's name.
+
+Two optional device hooks, both gated on jax being importable so the
+module stays dependency-free:
+
+- ``span(..., sync=tree)`` calls ``jax.block_until_ready`` on the tree
+  before closing the span — an explicit device-sync point, because an
+  async-dispatched step's host-side duration is otherwise just the
+  dispatch cost (the same reason ``Estimator.measure_pure_step``
+  fetch-forces its loss).
+- ``Tracer(jax_bridge=True)`` (default) additionally wraps each span in
+  ``jax.profiler.TraceAnnotation`` when jax is initialized, so zoo spans
+  show up inside ``jax.profiler`` captures (ZOO_PROFILE_DIR).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+
+__all__ = ["Tracer", "span", "get_tracer", "set_tracer"]
+
+# Innermost open span's name (per execution context / thread).
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "zoo_current_span", default=None)
+
+
+def _block_until_ready(tree):
+    """Device-sync a pytree if jax is importable; no-op otherwise."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is always in this image
+        return
+    jax.block_until_ready(tree)
+
+
+class Tracer:
+    """Bounded in-memory event sink.
+
+    ``max_events`` caps memory on multi-day jobs as a RING buffer: past
+    the cap the OLDEST events are evicted and counted (``dropped``),
+    never silently — the export carries the eviction count as metadata.
+    Keeping the newest window is the debugging-shaped choice: the trace
+    an operator saves after a day-2 anomaly must contain day 2, not the
+    first hour of startup spans.
+    """
+
+    def __init__(self, enabled: bool = True, max_events: int = 50_000,
+                 jax_bridge: bool = True):
+        import collections
+
+        self.enabled = bool(enabled)
+        self.max_events = int(max_events)
+        self.jax_bridge = bool(jax_bridge)
+        self.dropped = 0
+        self._events: collections.deque = collections.deque(
+            maxlen=self.max_events)
+        self._lock = threading.Lock()
+        # perf_counter origin so ts fields are small positive microseconds
+        self._t0 = time.perf_counter()
+
+    # -- recording ------------------------------------------------------
+    def now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def add_event(self, name: str, ts_us: float, dur_us: float,
+                  args: dict | None = None):
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "cat": "zoo",
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) == self.max_events:
+                self.dropped += 1  # deque evicts the oldest on append
+            self._events.append(ev)
+
+    # -- export ---------------------------------------------------------
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome_trace(self) -> dict:
+        """The ``chrome://tracing`` JSON object format."""
+        doc = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "metadata": {"producer": "analytics_zoo_tpu.metrics.tracing",
+                         "dropped_events": self.dropped},
+        }
+        return doc
+
+    def save(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+
+@contextlib.contextmanager
+def span(name: str, sync=None, args: dict | None = None,
+         tracer: Tracer | None = None):
+    """Time a block as one trace event; nests via contextvars.
+
+    Args:
+      name: event name (dotted convention: ``zoo.train.step``).
+      sync: optional pytree passed to ``jax.block_until_ready`` before the
+        span closes — makes the span cover device execution, not just the
+        async dispatch.
+      args: extra key/values attached to the event.
+      tracer: override the process-global tracer (tests).
+    """
+    t = tracer if tracer is not None else get_tracer()
+    if not t.enabled:
+        # cheap disabled path: no contextvar churn, no event dict
+        yield
+        if sync is not None:
+            _block_until_ready(sync)
+        return
+    parent = _current_span.get()
+    token = _current_span.set(name)
+    annot = None
+    if t.jax_bridge:
+        try:
+            import jax
+
+            annot = jax.profiler.TraceAnnotation(name)
+            annot.__enter__()
+        except Exception:
+            annot = None
+    t0 = t.now_us()
+    try:
+        yield
+        if sync is not None:
+            _block_until_ready(sync)
+    finally:
+        dur = t.now_us() - t0
+        if annot is not None:
+            try:
+                annot.__exit__(None, None, None)
+            except Exception:
+                pass
+        _current_span.reset(token)
+        ev_args = dict(args) if args else {}
+        if parent is not None:
+            ev_args["parent"] = parent
+        t.add_event(name, t0, dur, ev_args or None)
+
+
+# ---------------------------------------------------------------------------
+# Process-global default tracer.  ZOO_TRACE=0 disables span recording;
+# ZOO_TRACE_EVENTS overrides the event cap.
+# ---------------------------------------------------------------------------
+
+_default: Tracer | None = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                env = os.environ
+                _default = Tracer(
+                    enabled=env.get("ZOO_TRACE", "1") != "0",
+                    max_events=int(env.get("ZOO_TRACE_EVENTS", "50000")),
+                )
+    return _default
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-global tracer; returns the previous one."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, tracer
+    return prev

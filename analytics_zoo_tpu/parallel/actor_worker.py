@@ -24,20 +24,88 @@ then on the driver::
     h = MyActor.options(worker="host2:9040").remote(...)
     # or worker=1 (index into the registered list), or unset: round-robin
 
-SECURITY: frames are pickle — run worker servers only on a trusted,
-private interconnect (the TPU pod network), exactly like Ray's raylet
-protocol.  The server binds 0.0.0.0 by default for pod use; bind
-127.0.0.1 for local testing.
+SECURITY (ADVICE r05 medium): frames are pickle, so a reachable port is
+arbitrary code execution for whoever can speak the protocol.  Three
+layers of defence:
+
+- the server binds **127.0.0.1 by default**; a non-loopback bind (pod
+  use) must be requested explicitly;
+- a **mutual shared-secret handshake** runs before any unpickling ON
+  EITHER END: the server's first frame is a raw (non-pickle) hello
+  announcing its auth mode; with a secret it carries a random challenge,
+  the client answers with a fresh nonce plus
+  ``HMAC-SHA256(secret, client_ctx || challenge || nonce)``, and the
+  server must respond with
+  ``HMAC-SHA256(secret, server_ctx || challenge || nonce)`` before the
+  driver sends (or unpickles) anything — a spoofed worker endpoint
+  cannot produce the server proof itself (it could only relay a live
+  handshake to a real worker, which is the on-path case below), and
+  the per-side nonces make both proofs non-replayable.  A secret-presence mismatch between the two
+  ends fails immediately with a clear error.  The secret comes from
+  ``ZOO_ACTOR_SECRET`` on both ends (or the ``secret=`` argument); set
+  it on every pod host.
+- binding a non-loopback address WITHOUT a secret raises unless
+  ``allow_unauthenticated=True`` is passed (the explicit "I know this
+  port is open RCE on a trusted private interconnect" opt-in).
+
+Threat model: the handshake stops UNAUTHENTICATED peers (port scanners,
+spoofed endpoints, secretless clients) from reaching either side's
+deserializer.  Post-handshake frames are NOT individually MACed or
+encrypted, so an active on-path attacker — one who can splice into an
+established connection, or relay a live handshake between the driver
+and a real worker and then inject its own frames — is out of scope: the
+transport trusts the network path, exactly like Ray's raylet protocol;
+run pod traffic on a private interconnect or under WireGuard/TLS if the
+path itself is hostile.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import struct
 import threading
 
 _LEN = struct.Struct(">Q")
+
+_CLIENT_CONTEXT = b"zoo-actor-auth-client-v1"
+_SERVER_CONTEXT = b"zoo-actor-auth-server-v1"
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
+# Server's first (raw, non-pickle) frame announces the auth mode, so a
+# secret-presence mismatch between driver and worker fails instantly
+# with a clear error instead of a 30s hang waiting for a frame the
+# other side will never send.
+_HELLO_AUTH = b"zoo-hello-1 auth "  # + 32-byte challenge
+_HELLO_OPEN = b"zoo-hello-1 open"
+
+
+def _client_proof(secret: bytes, challenge: bytes,
+                  nonce: bytes) -> bytes:
+    """Driver's answer to the server's challenge; the fresh client nonce
+    keeps it non-replayable even against a reused challenge."""
+    return hmac.new(secret, _CLIENT_CONTEXT + challenge + nonce,
+                    hashlib.sha256).digest()
+
+
+def _server_proof(secret: bytes, challenge: bytes,
+                  nonce: bytes) -> bytes:
+    """Server's proof it knows the secret too (distinct context string,
+    bound to the client's nonce): the driver verifies this BEFORE
+    unpickling any reply, so a spoofed worker endpoint never reaches the
+    driver-side deserializer."""
+    return hmac.new(secret, _SERVER_CONTEXT + challenge + nonce,
+                    hashlib.sha256).digest()
+
+
+def _resolve_secret(secret) -> bytes | None:
+    """Explicit arg > ZOO_ACTOR_SECRET env > None (no handshake)."""
+    if secret is None:
+        env = os.environ.get("ZOO_ACTOR_SECRET")
+        return env.encode() if env else None
+    return secret.encode() if isinstance(secret, str) else bytes(secret)
 
 
 class SockConn:
@@ -56,7 +124,11 @@ class SockConn:
         self._buf = bytearray()
 
     def send(self, obj):
-        payload = pickle.dumps(obj)
+        self.send_bytes(pickle.dumps(obj))
+
+    def send_bytes(self, payload: bytes):
+        """One raw length-prefixed frame (no pickle — the pre-auth
+        handshake must not involve the deserializer at all)."""
         self._sock.sendall(_LEN.pack(len(payload)) + payload)
 
     def _frame_len(self):
@@ -66,13 +138,20 @@ class SockConn:
         (n,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
         return n if len(self._buf) >= _LEN.size + n else None
 
-    def _fill(self, timeout) -> bool:
-        """Buffer until a full frame is present; False on timeout."""
+    def _fill(self, timeout, max_len: int | None = None) -> bool:
+        """Buffer until a full frame is present; False on timeout.
+        ``max_len`` rejects oversized frames from the HEADER, before the
+        body is buffered (pre-auth flood guard)."""
         import select
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
         while self._frame_len() is None:
+            if max_len is not None and len(self._buf) >= _LEN.size:
+                (n,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
+                if n > max_len:
+                    raise ValueError(f"frame of {n} bytes exceeds "
+                                     f"pre-auth limit {max_len}")
             remaining = None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
             r, _, _ = select.select([self._sock], [], [], remaining)
@@ -85,11 +164,22 @@ class SockConn:
         return True
 
     def recv(self):
-        self._fill(None)
+        return pickle.loads(self.recv_bytes())
+
+    def recv_bytes(self, timeout=None, max_len: int | None = None):
+        """One raw frame.  ``max_len`` bounds pre-auth frames so an
+        unauthenticated peer cannot make the server buffer gigabytes."""
+        if not self._fill(timeout, max_len=max_len):
+            raise TimeoutError("actor frame timed out")
         n = self._frame_len()
+        if max_len is not None and n > max_len:
+            # frame arrived whole in one recv: the header short-circuit
+            # in _fill never ran
+            raise ValueError(f"frame of {n} bytes exceeds pre-auth "
+                             f"limit {max_len}")
         payload = bytes(self._buf[_LEN.size:_LEN.size + n])
         del self._buf[:_LEN.size + n]
-        return pickle.loads(payload)
+        return payload
 
     def poll(self, timeout=None) -> bool:
         return self._fill(timeout)
@@ -101,13 +191,33 @@ class SockConn:
             pass
 
 
-def _serve_connection(sock: socket.socket):
+def _serve_connection(sock: socket.socket, secret: bytes | None = None):
     """One accepted driver connection == one actor lifetime."""
     import multiprocessing as mp
 
     conn = SockConn(sock)
     proc = None
     try:
+        if secret is not None:
+            # Mutual challenge-response BEFORE any unpickling: raw
+            # frames only.  Client reply = 32-byte nonce || proof; the
+            # server's counter-proof goes back only to an authenticated
+            # client (leaking it to anyone would be a proof oracle).
+            challenge = os.urandom(32)
+            conn.send_bytes(_HELLO_AUTH + challenge)
+            try:
+                reply = conn.recv_bytes(timeout=10, max_len=64)
+            except (TimeoutError, ValueError, EOFError, OSError):
+                conn.close()
+                return
+            nonce, proof = reply[:32], reply[32:]
+            if not hmac.compare_digest(
+                    proof, _client_proof(secret, challenge, nonce)):
+                conn.close()
+                return
+            conn.send_bytes(_server_proof(secret, challenge, nonce))
+        else:
+            conn.send_bytes(_HELLO_OPEN)
         kind, payload = conn.recv()
         if kind != "spawn":
             conn.send(("init_error", f"bad first frame {kind!r}"))
@@ -166,11 +276,26 @@ def _serve_connection(sock: socket.socket):
         conn.close()
 
 
-def start_worker_server(port: int, bind: str = "0.0.0.0",
-                        block: bool = True):
+def start_worker_server(port: int, bind: str = "127.0.0.1",
+                        block: bool = True, secret=None,
+                        allow_unauthenticated: bool = False):
     """Accept actor placements on this host (the raylet role).  With
     ``block=False`` returns the listening socket and serves from a
-    daemon thread (tests / embedding in a launcher)."""
+    daemon thread (tests / embedding in a launcher).
+
+    Binds loopback by default.  A non-loopback ``bind`` (pod use)
+    requires a shared ``secret`` (arg or ``ZOO_ACTOR_SECRET`` env) so
+    unauthenticated peers never reach the pickle layer — or the explicit
+    ``allow_unauthenticated=True`` opt-in for a physically private
+    interconnect."""
+    secret = _resolve_secret(secret)
+    if bind not in _LOOPBACK and secret is None \
+            and not allow_unauthenticated:
+        raise ValueError(
+            f"binding {bind!r} exposes a pickle endpoint (code "
+            "execution) to the network: set a shared secret "
+            "(ZOO_ACTOR_SECRET or secret=) or pass "
+            "allow_unauthenticated=True to opt in explicitly")
     srv = socket.create_server((bind, port), reuse_port=False)
 
     def loop():
@@ -179,8 +304,8 @@ def start_worker_server(port: int, bind: str = "0.0.0.0",
                 sock, _ = srv.accept()
             except OSError:  # closed
                 return
-            threading.Thread(target=_serve_connection, args=(sock,),
-                             daemon=True).start()
+            threading.Thread(target=_serve_connection,
+                             args=(sock, secret), daemon=True).start()
 
     if block:
         loop()  # returns only when the listen socket dies/closes
@@ -190,14 +315,66 @@ def start_worker_server(port: int, bind: str = "0.0.0.0",
     return srv
 
 
-def connect_and_spawn(addr: str, payload: bytes) -> SockConn:
+def connect_and_spawn(addr: str, payload: bytes,
+                      secret=None) -> SockConn:
     """Driver side: open the actor's connection and send the spawn
     payload; returns the live conn (first reply is the ready/err frame,
-    read by ActorHandle exactly as on the local path)."""
+    read by ActorHandle exactly as on the local path).  The server's
+    hello frame announces its auth mode; a secret-presence mismatch
+    (arg or ``ZOO_ACTOR_SECRET`` on one end only) raises immediately
+    with the fix spelled out instead of hanging until timeout."""
+    secret = _resolve_secret(secret)
     host, port = addr.rsplit(":", 1)
     conn = SockConn(socket.create_connection((host, int(port)),
                                              timeout=30))
     conn._sock.settimeout(None)
+    try:
+        hello = conn.recv_bytes(timeout=30, max_len=64)
+        if hello.startswith(_HELLO_AUTH):
+            if secret is None:
+                raise RuntimeError(
+                    f"worker {addr} requires a shared secret; set "
+                    "ZOO_ACTOR_SECRET (to the worker's value) or pass "
+                    "secret= to connect")
+            challenge = hello[len(_HELLO_AUTH):]
+            nonce = os.urandom(32)
+            conn.send_bytes(nonce + _client_proof(secret, challenge,
+                                                  nonce))
+            # the server must prove it knows the secret too, BEFORE we
+            # unpickle anything it sends: a spoofed endpoint on a dead
+            # worker's port cannot forge this.  A server that closed
+            # instead of answering rejected OUR proof — surface that as
+            # the auth failure it is, not a bare connection error
+            try:
+                counter = conn.recv_bytes(timeout=30, max_len=64)
+            except (EOFError, TimeoutError, OSError) as e:
+                raise RuntimeError(
+                    f"worker {addr} dropped the connection during the "
+                    "auth handshake — usually a WRONG shared secret "
+                    "(ZOO_ACTOR_SECRET values differ between driver "
+                    "and worker)") from e
+            if not hmac.compare_digest(
+                    counter, _server_proof(secret, challenge, nonce)):
+                raise RuntimeError(
+                    f"worker {addr} failed to prove knowledge of the "
+                    "shared secret (wrong ZOO_ACTOR_SECRET on the "
+                    "worker, or a spoofed endpoint): refusing to "
+                    "deserialize its replies")
+        elif hello == _HELLO_OPEN:
+            if secret is not None:
+                raise RuntimeError(
+                    f"worker {addr} runs unauthenticated but this "
+                    "driver has a secret configured (ZOO_ACTOR_SECRET "
+                    "or secret=): refusing the downgrade — restart the "
+                    "worker with the same secret, or connect with "
+                    "secret=None after unsetting ZOO_ACTOR_SECRET")
+        else:
+            raise RuntimeError(
+                f"worker {addr} sent unrecognized hello {hello[:24]!r} "
+                "— not a zoo actor worker (or a version mismatch)")
+    except BaseException:
+        conn.close()
+        raise
     conn.send(("spawn", payload))
     return conn
 
@@ -207,10 +384,16 @@ def main():
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--port", type=int, default=9040)
-    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="listen address; non-loopback requires "
+                        "ZOO_ACTOR_SECRET or --allow-unauthenticated")
+    p.add_argument("--allow-unauthenticated", action="store_true",
+                   help="serve a non-loopback bind WITHOUT a shared "
+                        "secret (trusted private interconnect only)")
     a = p.parse_args()
     print(f"actor worker serving on {a.bind}:{a.port}")
-    start_worker_server(a.port, a.bind)
+    start_worker_server(a.port, a.bind,
+                        allow_unauthenticated=a.allow_unauthenticated)
 
 
 if __name__ == "__main__":

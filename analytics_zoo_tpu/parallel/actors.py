@@ -130,7 +130,8 @@ class ActorHandle:
     lock while others sleep on a condition variable, and ``get(timeout)``
     is a TOTAL deadline, not per-message."""
 
-    def __init__(self, cls, args, kwargs, ctx, worker: str | None = None):
+    def __init__(self, cls, args, kwargs, ctx, worker: str | None = None,
+                 secret=None):
         import cloudpickle
 
         self._ctx = ctx
@@ -145,7 +146,8 @@ class ActorHandle:
                 connect_and_spawn,
             )
 
-            self._conn = connect_and_spawn(worker, payload)
+            self._conn = connect_and_spawn(worker, payload,
+                                           secret=secret)
             self._proc = None
         else:
             spawn = mp.get_context("spawn")  # fork-unsafe next to JAX
@@ -255,20 +257,32 @@ class ActorHandle:
 
 
 class _RemoteClass:
-    def __init__(self, cls, worker=None):
+    def __init__(self, cls, worker=None, secret=None):
         self._cls = cls
         self._worker = worker
+        self._secret = secret
 
-    def options(self, worker=None) -> "_RemoteClass":
+    _UNSET = object()
+
+    def options(self, worker=_UNSET, secret=_UNSET) -> "_RemoteClass":
         """Placement options (the ``.options()`` surface of ray):
         ``worker`` is a registered worker address ("host:port"), an index
-        into ``ActorContext.init(workers=[...])``, or None (local)."""
-        return _RemoteClass(self._cls, worker=worker)
+        into ``ActorContext.init(workers=[...])``, or None (local);
+        ``secret`` is the worker server's shared auth secret for drivers
+        that cannot set ZOO_ACTOR_SECRET (actor_worker.py handshake).
+        Omitted fields carry over from this instance, so chained
+        ``.options(worker=...).options(secret=...)`` calls compose."""
+        u = _RemoteClass._UNSET
+        return _RemoteClass(
+            self._cls,
+            worker=self._worker if worker is u else worker,
+            secret=self._secret if secret is u else secret)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         ctx = ActorContext.current()
         return ActorHandle(self._cls, args, kwargs, ctx,
-                           worker=ctx._resolve_worker(self._worker))
+                           worker=ctx._resolve_worker(self._worker),
+                           secret=self._secret)
 
     def __call__(self, *args, **kwargs):
         return self._cls(*args, **kwargs)  # local construction still works

@@ -27,6 +27,32 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from analytics_zoo_tpu.common.engine import PIPE_AXIS, get_zoo_context
+from analytics_zoo_tpu.metrics import get_registry
+
+
+def _record_schedule(schedule: str, n_stages: int, n_micro: int,
+                     bubble_ticks: int, total_ticks: int):
+    """Publish the schedule's bubble structure to the metrics registry.
+
+    The schedule runs INSIDE jit, so host wall-clock per microbatch is
+    unobservable here; what is exact (and what a capacity planner needs)
+    is the analytic bubble: idle fill/drain ticks per schedule, per
+    microbatch, and as a fraction of total ticks.  Recorded once per
+    trace (the call site executes at trace time), labeled by schedule."""
+    reg = get_registry()
+    labels = ("schedule",)
+    reg.gauge("zoo_pipeline_stages", "pipeline stage count",
+              labels).labels(schedule=schedule).set(n_stages)
+    reg.gauge("zoo_pipeline_microbatches", "microbatch count M",
+              labels).labels(schedule=schedule).set(n_micro)
+    reg.gauge("zoo_pipeline_bubble_fraction",
+              "idle fill/drain ticks / total schedule ticks",
+              labels).labels(schedule=schedule).set(
+                  bubble_ticks / max(total_ticks, 1))
+    reg.gauge("zoo_pipeline_bubble_ticks_per_microbatch",
+              "per-microbatch bubble time in stage-tick units",
+              labels).labels(schedule=schedule).set(
+                  bubble_ticks / max(n_micro, 1))
 
 
 def _pipeline_local(stage_params, x_mb, *, stage_fn, axis_name, n_stages,
@@ -127,6 +153,9 @@ def gpipe(stage_fn, stage_params, x, *, n_microbatch, mesh=None,
             f"circular schedule needs n_microbatch >= pipe size "
             f"({n_microbatch} < {n_stages})")
     x_mb = x.reshape((n_microbatch, b // n_microbatch) + x.shape[1:])
+    _record_schedule("gpipe" if v == 1 else "gpipe_circular",
+                     n_stages, n_microbatch, n_stages - 1,
+                     v * n_microbatch + n_stages - 1)
     mb_spec = P(None, batch_axis)  # rows of each microbatch over DP axis
     if v == 1:
         local = partial(_pipeline_local, stage_fn=stage_fn,
@@ -313,6 +342,18 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
       output (leading dim of every output leaf must be the microbatch row
       count).
     """
+    if batch_axis is not None and getattr(jax.shard_map,
+                                          "_zoo_compat_04x", False):
+        # fail loudly: under the jax-0.4.x shard_map shim this exact
+        # combination computes WRONG numbers (outputs scaled by the
+        # data-axis size — tests/test_pipeline_parallel.py
+        # TestGPipeHetero::test_full_lm_with_data_parallel), and a
+        # silently corrupted forward is worse than no forward
+        raise NotImplementedError(
+            "gpipe_hetero with a data-parallel batch_axis produces "
+            "incorrect results under the jax 0.4.x shard_map compat "
+            "shim; upgrade jax or drop batch_axis (run DP outside the "
+            "hetero pipeline)")
     mesh = mesh or get_zoo_context().mesh
     n_stages = dict(mesh.shape).get(axis_name, 1)
     if len(stage_fns) != n_stages:
@@ -340,6 +381,8 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
         return jax.tree_util.tree_map(
             lambda a: a.reshape((b,) + a.shape[2:]), out_mb)
 
+    _record_schedule("gpipe_hetero", n_stages, n_microbatch,
+                     n_stages - 1, n_microbatch + n_stages - 1)
     fn = jax.shard_map(
         partial(_pipeline_local_hetero, stage_fns=stage_fns,
                 axis_name=axis_name, n_stages=n_stages,
@@ -555,6 +598,12 @@ def gpipe_1f1b_grads(stage_fn, loss_fn, stage_params, x, y, *,
 
         return jax.value_and_grad(whole)(stage_params)
 
+    # dual fwd/bwd schedule runs T = M + 2S - 1 ticks with M useful
+    # slots per stream per stage, so each stream idles T - M = 2S - 1
+    # ticks (fill + drain + the one-tick fwd->bwd offset at the last
+    # stage)
+    _record_schedule("1f1b", n_stages, n_microbatch,
+                     2 * n_stages - 1, n_microbatch + 2 * n_stages - 1)
     fn = jax.shard_map(
         partial(_pipeline_local_1f1b, stage_fn=stage_fn, loss_fn=loss_fn,
                 axis_name=axis_name, n_stages=n_stages,
@@ -736,6 +785,8 @@ def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
     bound, flen, ilen = _infer_boundaries(stage_fns, edge_params,
                                           stacked_params, x_mb, mb)
 
+    _record_schedule("1f1b_hetero", n_stages, n_microbatch,
+                     2 * n_stages - 1, n_microbatch + 2 * n_stages - 1)
     fn = jax.shard_map(
         partial(_pipeline_local_1f1b_hetero, stage_fns=stage_fns,
                 loss_fn=loss_fn, axis_name=axis_name, n_stages=n_stages,

@@ -184,7 +184,8 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     return jax.jit(step, donate_argnums=(0, 1, 2)), init_opt_state
 
 
-def reshard_zero1_opt_state(opt_state, params, mesh=None):
+def reshard_zero1_opt_state(opt_state, params, mesh=None,
+                            n_old: int | None = None):
     """Re-lay an explicit-ZeRO-1 optimizer state (the
     :func:`make_zero1_train_step` layout) for a DIFFERENT data-axis size —
     the elastic slice-down/up restart (SURVEY §5): save on ``{data: 8}``,
@@ -200,6 +201,19 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None):
     The estimator's GSPMD ZeRO-1 path needs none of this: its checkpoint
     stores global logical arrays, so restoring onto a different mesh is
     just a device_put (tests/test_elastic_resume.py proves both paths).
+
+    Flat-vector leaves are matched by EXACT padded length (ADVICE r05
+    low), not by ``size >= param_size``: pass ``n_old`` (the data-axis
+    size the state was saved under) for the exact expected length
+    ``size + (-size) % n_old``; without it, the length is inferred as
+    the smallest 1-D leaf length >= the param count that is SHARED by at
+    least two leaves (the moment mirrors always agree on one padded
+    length; a coincidental unrelated 1-D leaf is almost surely unique),
+    falling back to the smallest overall for single-mirror states.
+    Pass ``n_old`` when the state shape is unusual.  Leaves that do NOT
+    match the flat-vector layout are left untouched and placed
+    REPLICATED — never truncated, never force-sharded onto a dimension
+    the new mesh cannot divide.
     """
     from jax.flatten_util import ravel_pytree
     from jax.sharding import NamedSharding
@@ -211,21 +225,39 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None):
     size = ravel_pytree(params)[0].size
     pad_new = (-size) % n_new
 
+    if n_old is not None:
+        expected = size + ((-size) % int(n_old))
+    else:
+        cands = [np.size(l) for l in jax.tree_util.tree_leaves(opt_state)
+                 if np.ndim(l) == 1 and np.size(l) >= size]
+        # prefer a length SHARED by >=2 leaves: the moment mirrors (mu,
+        # nu) always agree on the padded length, while a coincidental
+        # unrelated 1-D leaf in [size, size+pad) is almost surely unique
+        # — picking it would truncate it AND leave the real flat vectors
+        # un-resharded
+        shared = [c for c in cands if cands.count(c) >= 2]
+        expected = min(shared) if shared else (
+            min(cands) if cands else None)
+
+    def is_flat_vec(leaf) -> bool:
+        return np.ndim(leaf) == 1 and np.size(leaf) == expected
+
     def fix(leaf):
         # stay on the HOST until the final sharded device_put: jnp ops
         # here would transiently materialize every params-sized moment on
         # one device — the allocation ZeRO-1 exists to avoid
         leaf = np.asarray(leaf)
-        if leaf.ndim == 1 and leaf.size >= size:
+        if is_flat_vec(leaf):
             return np.pad(leaf[:size], (0, pad_new))
         return leaf
 
     out = jax.tree_util.tree_map(fix, opt_state)
-    return jax.device_put(
-        out,
-        jax.tree_util.tree_map(
-            lambda l: NamedSharding(
-                mesh, P(DATA_AXIS) if l.ndim >= 1 else P()), out))
+    # shardings keyed on the ORIGINAL leaves (the re-padded length of a
+    # matched leaf differs from `expected` whenever n_new != n_old)
+    shardings = jax.tree_util.tree_map(
+        lambda l: NamedSharding(
+            mesh, P(DATA_AXIS) if is_flat_vec(l) else P()), opt_state)
+    return jax.device_put(out, shardings)
 
 
 # ---------------------------------------------------------------------------

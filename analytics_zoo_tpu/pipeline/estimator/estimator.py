@@ -60,6 +60,11 @@ from analytics_zoo_tpu.common.triggers import (
 )
 from analytics_zoo_tpu.common.utils import time_it
 from analytics_zoo_tpu.feature.dataset import FeatureSet
+from analytics_zoo_tpu.metrics import (
+    StepMetrics,
+    record_device_memory,
+    span,
+)
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -620,6 +625,10 @@ class Estimator:
         prof_dir = cfg.profile_dir
         prof_at = self.global_step + 3 if (
             prof_dir and not self._profiled) else None
+        # Observability (metrics/): children resolved once here, so the
+        # per-step cost is a handful of observe/inc calls — and on a
+        # disabled registry those are the shared no-op singleton.
+        step_metrics = StepMetrics()
         while not end_trigger(tstate):
             epoch_t0 = time.perf_counter()
             n_records = 0
@@ -636,8 +645,10 @@ class Estimator:
             try:
                 feeder_iter = iter(feeder)
                 while True:
+                    t_iter0 = time.perf_counter()
                     with time_it("zoo.infeed"):
                         sharded = next(feeder_iter, _SENTINEL)
+                    t_data = time.perf_counter()
                     if sharded is _SENTINEL:
                         break
                     if prof_at is not None and not prof_active \
@@ -646,11 +657,17 @@ class Estimator:
                         jax.profiler.start_trace(prof_dir)
                         prof_active = True
                         prof_at = self.global_step  # anchor the stop check
-                    with time_it("zoo.step_dispatch"):
+                    # span covers HOST-side dispatch only (the jitted
+                    # step is async; device time shows in the
+                    # jax.profiler capture, not here) — named to match
+                    # zoo_train_step_dispatch_seconds
+                    with time_it("zoo.step_dispatch"), \
+                            span("zoo.train.step_dispatch"):
                         params, opt_state, state, loss_dev = step_fn(
                             params, opt_state, state, seed_arr,
                             np.asarray(self.global_step, np.int32), sharded
                         )
+                    t_disp = time.perf_counter()
                     self.global_step += 1
                     if prof_active and self.global_step == \
                             prof_at + cfg.profile_steps:
@@ -669,6 +686,11 @@ class Estimator:
                         validation_trigger, epoch, bi, seed, batch_size,
                     )
                     params, opt_state, state = fired
+                    # step-time breakdown: data-wait (infeed the feeder
+                    # failed to hide) / dispatch / full iteration
+                    step_metrics.record_step(
+                        t_data - t_iter0, t_disp - t_data,
+                        time.perf_counter() - t_iter0, batch_size)
             finally:
                 feeder.stop()
                 if prof_active:
@@ -695,6 +717,8 @@ class Estimator:
                 self._writers[0].add_scalar(
                     "Throughput", throughput, self.global_step
                 )
+            step_metrics.record_epoch(epoch, throughput)
+            record_device_memory()  # HBM gauges (no-op on CPU backends)
             tstate.epoch_finished = True
             epoch += 1
             tstate.epoch = epoch

@@ -23,6 +23,7 @@ from typing import Sequence
 import jax
 import numpy as np
 
+from analytics_zoo_tpu.metrics import get_registry, span
 from analytics_zoo_tpu.pipeline.inference.quantize import (
     dequantize_params,
     quantize_params,
@@ -57,6 +58,18 @@ class InferenceModel:
         self._quantized = False
         self._int8_model = None
         self._bf16 = False
+        # Telemetry (metrics/): compile count + execution latency per
+        # batch bucket — the bucketed-compile-cache health signals (a
+        # growing compile count means shape churn is defeating the cache)
+        reg = get_registry()
+        self._m_compiles = reg.counter(
+            "zoo_inference_compiles_total",
+            "XLA compiles by input shape bucket", ("bucket",))
+        self._m_latency = reg.histogram(
+            "zoo_inference_predict_seconds",
+            "executable run time per micro-batch", ("bucket",))
+        self._m_records = reg.counter(
+            "zoo_inference_records_total", "records predicted")
 
     # ------------------------------------------------------------------
     # doLoad* family (InferenceModel.scala:81-657)
@@ -198,12 +211,15 @@ class InferenceModel:
                     int8 = getattr(self, "_int8_model", None)
                     ctx = int8.installed() if int8 is not None \
                         else HOOK_LOCK
-                    with ctx:
+                    bucket = str(xs[0].shape[0]) if np.ndim(xs[0]) else "0"
+                    with ctx, span("zoo.inference.compile",
+                                   args={"bucket": bucket}):
                         exe = (
                             jax.jit(self._forward_fn())
                             .lower(self._params, self._state, list(xs))
                             .compile()
                         )
+                    self._m_compiles.labels(bucket=bucket).inc()
                     self._compiled[key] = exe
         return exe
 
@@ -275,7 +291,7 @@ class InferenceModel:
                     for a in chunk
                 ]
             exe = self._get_compiled(chunk)
-            with self._sem:
+            with self._sem, self._m_latency.labels(bucket=str(b)).time():
                 out = exe(self._params, self._state, chunk)
                 # materialize inside the semaphore so concurrent_num truly
                 # bounds in-flight device work (dispatch is async)
@@ -284,6 +300,7 @@ class InferenceModel:
                 else:
                     out = np.asarray(out)[:m]
             outs.append(out)
+            self._m_records.inc(m)
         if isinstance(outs[0], list):
             return [np.concatenate([o[i] for o in outs])
                     for i in range(len(outs[0]))]

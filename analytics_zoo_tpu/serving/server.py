@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from ..metrics import ServingMetrics, span
 from ..tensorboard import InferenceSummary
 from .broker import connect_broker
 from .client import INPUT_STREAM, RESULT_PREFIX, decode_ndarray, \
@@ -85,6 +86,9 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread = None
         self.total_count = 0
+        # Serving telemetry (metrics/): queue depth, batch size, latency
+        # histograms per step() — no-op singletons when ZOO_METRICS=0.
+        self.metrics = ServingMetrics()
 
     # ------------------------------------------------------------------
 
@@ -130,7 +134,10 @@ class ClusterServing:
             groups[arr.shape][0].append(uri)
             groups[arr.shape][1].append(arr)
         for g_uris, g_arrs in groups.values():
-            preds = self.model.predict(np.stack(g_arrs))
+            with self.metrics.predict_latency.time(), \
+                    span("zoo.serving.predict",
+                         args={"batch": len(g_uris)}):
+                preds = self.model.predict(np.stack(g_arrs))
             if isinstance(preds, list):  # multi-output: report first head
                 preds = preds[0]
             for uri, out in zip(g_uris, np.asarray(preds)):
@@ -145,19 +152,48 @@ class ClusterServing:
 
     def step(self, block_ms: int = 100) -> int:
         """One poll + predict + write-back cycle; returns #records served."""
-        if self.db.memory_ratio() >= self.INPUT_THRESHOLD:
+        ratio = self.db.memory_ratio()
+        self.metrics.memory_ratio.set(ratio)
+        if ratio >= self.INPUT_THRESHOLD:
             keep = int(self.db.xlen(INPUT_STREAM) * self.CUT_RATIO)
             self.db.xtrim(INPUT_STREAM, keep)
+            self.metrics.trims.inc()
         records = self.db.xread(INPUT_STREAM, self.helper.batch_size,
                                 last_id=self._last_id, block_ms=block_ms)
+        t0 = time.perf_counter()
         if records:
             self._last_id = records[-1][0]
         try:
-            n = self.process_batch(records)
+            if records:
+                # span only on non-empty cycles: an idle loop at
+                # block_ms=100 would otherwise flood the bounded tracer
+                # with ~10 zero-information events/sec
+                with span("zoo.serving.step"):
+                    n = self.process_batch(records)
+            else:
+                n = 0
         finally:
             if records:
                 # ack consumed records so the stream cannot grow unbounded
                 self.db.ack(INPUT_STREAM, self._last_id)
+        # service latency endpoint taken BEFORE any metrics-only broker
+        # traffic below, so enabling metrics cannot inflate the very
+        # latency being measured
+        t_end = time.perf_counter()
+        # true backlog: what remains AFTER this cycle's records were
+        # acked — the xlen is an extra broker round-trip, so it only
+        # runs when metrics are on and this cycle actually served
+        # (an empty poll means the backlog was already drained)
+        if records and self.metrics.enabled:
+            self.metrics.queue_depth.set(self.db.xlen(INPUT_STREAM))
+        if records:
+            # service latency for this cycle: decode + batch formation +
+            # predict + write-back (poll wait excluded — the records
+            # arrived by t0).  Queueing delay before the poll shows up in
+            # queue_depth, not here.
+            self.metrics.latency.observe(t_end - t0)
+            self.metrics.batch_size.observe(len(records))
+            self.metrics.records.inc(n)
         return n
 
     def run(self, max_records: int | None = None,

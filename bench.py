@@ -58,18 +58,22 @@ TPU_PEAK_FLOPS = (
 PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
 
 
-def probe_backend(timeout: float) -> tuple[bool, str]:
+def probe_backend(timeout: float, env: dict | None = None) \
+        -> tuple[bool, str]:
     """Try `jax.devices()` in a subprocess with a hard timeout.
 
     A subprocess is the only reliable guard: the axon plugin can hang inside
     C++ without releasing the GIL, so an in-process watchdog thread could
-    detect but never cancel it.
+    detect but never cancel it.  ``env`` overrides the child environment —
+    the sweep-flag adoption path probes with candidate XLA_FLAGS applied,
+    so a flag the (possibly fallen-back) backend would fatally reject
+    aborts only the probe child, never this process.
     """
     try:
         r = subprocess.run(
             [sys.executable, "-c", PROBE_CODE],
             capture_output=True, text=True, timeout=timeout,
-            env=dict(os.environ),
+            env=dict(os.environ) if env is None else env,
         )
     except subprocess.TimeoutExpired:
         return False, f"probe timed out after {timeout:.0f}s"
@@ -100,13 +104,25 @@ def peak_flops_for(device_kind: str) -> float | None:
     return None
 
 
-def adopt_sweep_flags():
+def adopt_sweep_flags(probe=probe_backend, probe_timeout: float = 150.0,
+                      path: str | None = None):
     """If the XLA flag sweep (tools/flag_sweep.py -> FLAGSWEEP_r05.json)
     found a combo beating baseline by >=1%, adopt its flags for the
     headline run.  Must run BEFORE any jax import: XLA_FLAGS is read at
-    backend init.  Returns the adopted combo name or None."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "FLAGSWEEP_r05.json")
+    backend init.  Returns the adopted combo name or None.
+
+    ADVICE r05 low (bench.py:136): the candidate flags are VALIDATED in a
+    probe subprocess with XLA_FLAGS applied before this process commits
+    to them.  `xla_tpu_*` flags are a fatal 'Unknown flag' abort on the
+    CPU backend, so if the flagged probe fails or lands on a non-TPU
+    platform, adoption is skipped and the a-number-always-lands contract
+    survives.  Residual window: a plugin flaky enough to hand the probe
+    child a TPU and the in-process init a CPU fallback still aborts
+    (the C++ FATAL cannot be caught); the probe narrows the race to
+    two inits moments apart but cannot close it."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FLAGSWEEP_r05.json")
     try:
         with open(path) as f:
             sweep = json.load(f)
@@ -116,8 +132,12 @@ def adopt_sweep_flags():
     if not best or best == "baseline" or not gain or gain < 1.0:
         return None
     flags = sweep["results"][best]["flags"]
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " " + flags).strip()
+    candidate = (os.environ.get("XLA_FLAGS", "") + " " + flags).strip()
+    ok, detail = probe(probe_timeout,
+                       env=dict(os.environ, XLA_FLAGS=candidate))
+    if not ok or not detail.startswith("tpu"):
+        return None
+    os.environ["XLA_FLAGS"] = candidate
     return f"{best} (+{gain}%)"
 
 
@@ -127,11 +147,11 @@ def main():
     else:
         platform, diags = resolve_platform()
     fell_back = platform == "cpu"
-    # adopt only once the platform resolved to TPU: the sweep's xla_tpu_*
-    # flags are a FATAL 'Unknown flag' abort on the CPU backend, which
-    # would break every fallback path's a-number-always-lands contract
-    # (probe runs in a subprocess, so setting XLA_FLAGS here still
-    # precedes the in-process backend init)
+    # adopt only once the platform resolved to TPU, and only after the
+    # candidate flags survive a probe subprocess WITH the flags applied
+    # (adopt_sweep_flags): the sweep's xla_tpu_* flags are a FATAL
+    # 'Unknown flag' abort on the CPU backend, which would break every
+    # fallback path's a-number-always-lands contract
     pre_adopt_flags = os.environ.get("XLA_FLAGS")
     adopted = None if fell_back else adopt_sweep_flags()
     if fell_back:
@@ -240,6 +260,24 @@ def main():
     if fell_back:
         out["note"] = "TPU backend unavailable; CPU fallback at reduced size"
         out["tpu_init_diagnostics"] = diags
+    # Step-time breakdown from the metrics registry — the estimator's
+    # built-in instrumentation (analytics_zoo_tpu.metrics), not a
+    # bench-private timer: the same numbers a production scrape sees.
+    from analytics_zoo_tpu.metrics import snapshot, write_jsonl
+
+    breakdown = {}
+    for s in snapshot()["samples"]:
+        if s["name"] in ("zoo_train_data_wait_seconds",
+                         "zoo_train_step_dispatch_seconds",
+                         "zoo_train_step_seconds"):
+            breakdown[s["name"]] = {
+                k: round(float(s[k]), 6)
+                for k in ("count", "p50", "p95", "p99")}
+    if breakdown:
+        out["step_breakdown"] = breakdown
+    jsonl_path = os.environ.get("ZOO_METRICS_JSONL")
+    if jsonl_path:
+        write_jsonl(jsonl_path)
     print(json.dumps(out))
 
 

@@ -36,7 +36,7 @@ QUICK_FILES = {
     "test_serving.py", "test_keras2.py", "test_caffe.py",
     "test_layer_oracle_enforcement.py", "test_api_docs.py",
     "test_textset.py", "test_image3d.py", "test_transfer_learning.py",
-    "test_layer_serialization.py",
+    "test_layer_serialization.py", "test_metrics.py",
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier
 }
@@ -45,6 +45,9 @@ QUICK_FILES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "quick: fast per-commit tier (<2 min; see conftest)")
+    config.addinivalue_line(
+        "markers", "metrics: observability-subsystem telemetry tests "
+        "(analytics_zoo_tpu.metrics; tier-1 — not marked slow)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,115 @@
+"""Render a JSONL metrics file as a latency/throughput summary table.
+
+Reads the output of ``analytics_zoo_tpu.metrics.exporters.write_jsonl``
+(one registry snapshot per line — e.g. what ``bench.py`` appends when
+``ZOO_METRICS_JSONL`` is set) and prints, for the LATEST snapshot:
+
+- histograms: count, mean, p50/p95/p99 (seconds-named metrics shown in
+  ms);
+- counters/gauges: the value, plus the delta and rate against the FIRST
+  snapshot in the file when more than one line is present.
+
+Usage:
+  python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
+  python tools/metrics_dump.py METRICS.jsonl --prometheus   # re-render
+"""
+
+import argparse
+import json
+import sys
+
+
+def load(path):
+    docs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: skipping unparseable line", file=sys.stderr)
+    if not docs:
+        raise SystemExit(f"{path}: no snapshots found")
+    return docs
+
+
+def _key(sample):
+    from analytics_zoo_tpu.metrics import sample_key
+
+    return sample_key(sample)
+
+
+def _scale(name, value):
+    """seconds-named metrics print in ms — latencies live there."""
+    if name.endswith("_seconds") or "_seconds{" in name:
+        return value * 1e3, "ms"
+    return value, ""
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="JSONL metrics file")
+    p.add_argument("--prefix", default="",
+                   help="only metrics whose name starts with this")
+    p.add_argument("--prometheus", action="store_true",
+                   help="ignored for histograms' full buckets (JSONL "
+                        "carries summaries); prints name=value lines "
+                        "instead of the table")
+    a = p.parse_args()
+
+    docs = load(a.path)
+    first, last = docs[0], docs[-1]
+    first_vals = {_key(s): s for s in first.get("samples", [])}
+    dt = max(last.get("ts", 0) - first.get("ts", 0), 0.0)
+
+    hist_rows, val_rows = [], []
+    for s in last.get("samples", []):
+        key = _key(s)
+        if a.prefix and not s["name"].startswith(a.prefix):
+            continue
+        if s["kind"] == "histogram":
+            unit_vals = [_scale(key, s[k])[0]
+                         for k in ("mean", "p50", "p95", "p99")]
+            unit = _scale(key, 0.0)[1]
+            hist_rows.append((key, int(s["count"]), unit) +
+                             tuple(unit_vals))
+        else:
+            v = s.get("value", 0.0)
+            delta = ""
+            rate = ""
+            prev = first_vals.get(key)
+            if prev is not None and len(docs) > 1 \
+                    and s["kind"] == "counter":
+                d = v - prev.get("value", 0.0)
+                delta = f"{d:+.6g}"
+                if dt > 0:
+                    rate = f"{d / dt:.6g}/s"
+            val_rows.append((key, s["kind"], f"{v:.6g}", delta, rate))
+
+    if a.prometheus:
+        for row in val_rows:
+            print(f"{row[0]} {row[2]}")
+        for row in hist_rows:
+            print(f"{row[0]}_count {row[1]}")
+        return
+
+    print(f"# {a.path}: {len(docs)} snapshot(s), window {dt:.1f}s")
+    if hist_rows:
+        print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
+              f"{'p50':>11}{'p95':>11}{'p99':>11}")
+        for key, count, unit, mean, p50, p95, p99 in hist_rows:
+            u = f" {unit}" if unit else ""
+            print(f"{key:<52}{count:>9}"
+                  f"{mean:>10.3f}{u}{p50:>10.3f}{u}"
+                  f"{p95:>10.3f}{u}{p99:>10.3f}{u}")
+    if val_rows:
+        print(f"\n{'metric':<52}{'kind':>9}{'value':>14}"
+              f"{'delta':>12}{'rate':>12}")
+        for key, kind, v, delta, rate in val_rows:
+            print(f"{key:<52}{kind:>9}{v:>14}{delta:>12}{rate:>12}")
+
+
+if __name__ == "__main__":
+    main()

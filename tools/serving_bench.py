@@ -122,6 +122,21 @@ def main():
     wall = time.perf_counter() - t0
     serving.stop()
 
+    # Per-record latencies go through the metrics registry (the same
+    # substrate the server's own telemetry uses — ISSUE 1: no more
+    # bench-private timers as the only signal).  The headline p50/p99
+    # stay exact-from-samples; the registry section carries the
+    # histogram summary plus the SERVER-side telemetry recorded by
+    # ClusterServing.step() during this very run.
+    from analytics_zoo_tpu.metrics import (
+        get_registry, sample_key, snapshot)
+
+    client_lat = get_registry().histogram(
+        "zoo_serving_client_latency_seconds",
+        "enqueue -> result-available latency per record")
+    for u in done_t:
+        client_lat.observe(done_t[u] - enq_t[u])
+
     lats = np.array(sorted(
         (done_t[u] - enq_t[u]) * 1e3 for u in done_t))
     completed = len(lats)
@@ -155,6 +170,19 @@ def main():
         out["note"] = ("SATURATED: offered load exceeds capacity, latency "
                        "is queueing delay, not service time — see a "
                        "stable-queue run for the latency number")
+    # registry section: server-side serving telemetry + the client
+    # latency histogram summary (same names a Prometheus scrape exposes)
+    reg_doc = {}
+    for s in snapshot()["samples"]:
+        if not s["name"].startswith("zoo_serving"):
+            continue
+        key = sample_key(s)
+        if s["kind"] == "histogram":
+            reg_doc[key] = {k: round(float(s[k]), 6)
+                            for k in ("count", "p50", "p95", "p99")}
+        else:
+            reg_doc[key] = round(float(s["value"]), 6)
+    out["registry"] = reg_doc
     print(json.dumps(out))
     path = a.out or os.path.join(os.path.dirname(__file__), "..",
                                  "SERVING_r05.json")
