@@ -92,6 +92,13 @@ class ZooConfig:
       ZOO_SHARD_OPTIMIZER      "1": ZeRO-1 — shard optimizer state over
                                the data axis (1/n memory + update compute
                                per chip; params stay replicated)
+      ZOO_METRICS_PORT         serve /metrics /varz /trace /healthz
+                               /flightz over HTTP from the serving loop /
+                               estimator fit (metrics/http.py; bind
+                               address via ZOO_METRICS_HOST)
+      ZOO_FLIGHT_DIR           arm the crash flight recorder's dump
+                               (metrics/flight.py; ZOO_FLIGHT=0 disables,
+                               ZOO_FLIGHT_EVENTS caps the ring)
     """
 
     app_name: str = "analytics-zoo-tpu"

@@ -47,8 +47,35 @@ _ALLOWED_EXACT = {
     ("numpy.core.multiarray", "scalar"),
     ("jax._src.array", "_reconstruct_array"),
     ("jax._src.tree_util", "default_registry"),
-    ("jaxlib._jax.pytree", "PyTreeDef"),
 }
+
+# PyTreeDef's home module drifts across jaxlib versions
+# (jaxlib.xla_extension.pytree -> jaxlib._jax.pytree -> ...).  Known
+# historical homes are allowed so checkpoints written under one jaxlib
+# still load under another that keeps the old module as an alias; the
+# CURRENT home is probed from the live class the first time it is
+# needed, so the allowlist tracks whatever this environment's jaxlib
+# calls it without a per-version table.  Only the exact (module,
+# "PyTreeDef") pair is allowed — never a jaxlib module root.
+_PYTREEDEF_KNOWN = {
+    "jaxlib._jax.pytree",
+    "jaxlib.xla_extension.pytree",
+}
+_pytreedef_live: tuple[str, str] | None = None
+
+
+def _pytreedef_entry() -> tuple[str, str]:
+    """(module, qualname) of THIS environment's PyTreeDef, cached."""
+    global _pytreedef_live
+    if _pytreedef_live is None:
+        try:
+            import jax
+
+            cls = type(jax.tree_util.tree_structure(0))
+            _pytreedef_live = (cls.__module__, cls.__qualname__)
+        except Exception:  # jax unavailable: fall back to the known set
+            _pytreedef_live = ("jaxlib._jax.pytree", "PyTreeDef")
+    return _pytreedef_live
 
 _JNP_DTYPES = frozenset({
     "bfloat16", "float16", "float32", "float64",
@@ -61,6 +88,10 @@ def _allowed(module: str, name: str) -> bool:
     if module.split(".", 1)[0] == "analytics_zoo_tpu":
         return True
     if (module, name) in _ALLOWED_EXACT:
+        return True
+    if name == "PyTreeDef" and (
+            module in _PYTREEDEF_KNOWN or
+            (module, name) == _pytreedef_entry()):
         return True
     if module == "jax.numpy" and name in _JNP_DTYPES:
         return True

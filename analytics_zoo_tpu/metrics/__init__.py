@@ -10,6 +10,16 @@ writers.  Instrumented by default in the estimator fit loop
 (`zoo_pipeline_*`); disable with ``ZOO_METRICS=0`` / ``ZOO_TRACE=0``
 (then every recording call is a shared no-op — zero per-step cost).
 
+The distributed plane (ISSUE 2): :class:`MetricsServer` serves
+``/metrics`` ``/varz`` ``/trace`` ``/healthz`` ``/flightz`` over HTTP
+(opt-in via ``ZOO_METRICS_PORT``); :mod:`merge` defines the mergeable
+cross-process snapshot format and the driver-side
+:class:`TelemetryAggregator`; :mod:`health` is the component-heartbeat
+registry behind ``/healthz``; :mod:`flight` is the bounded crash flight
+recorder dumped to ``ZOO_FLIGHT_DIR`` on exit/SIGTERM/crash.  Remote
+actor and worker processes ship snapshots to the driver over the
+``__zoo_telemetry__`` control frame (``ActorContext.metrics()``).
+
 See ``docs/observability.md`` for the API tour and metric catalogue.
 """
 
@@ -18,8 +28,30 @@ from analytics_zoo_tpu.metrics.exporters import (
     TensorBoardExporter,
     prometheus_text,
     sample_key,
+    sanitize_label_name,
+    sanitize_metric_name,
     snapshot,
     write_jsonl,
+)
+from analytics_zoo_tpu.metrics.flight import (
+    FlightRecorder,
+    StragglerDetector,
+    get_flight_recorder,
+    set_flight_recorder,
+)
+from analytics_zoo_tpu.metrics.health import (
+    HealthRegistry,
+    get_health,
+    set_health,
+)
+from analytics_zoo_tpu.metrics.http import (
+    MetricsServer,
+    maybe_start_from_env,
+)
+from analytics_zoo_tpu.metrics.merge import (
+    TelemetryAggregator,
+    merge_samples,
+    telemetry_snapshot,
 )
 from analytics_zoo_tpu.metrics.registry import (
     DEFAULT_BUCKETS,
@@ -50,5 +82,11 @@ __all__ = [
     "Tracer", "span", "get_tracer", "set_tracer",
     "prometheus_text", "snapshot", "sample_key", "JsonlExporter",
     "write_jsonl", "TensorBoardExporter",
+    "sanitize_metric_name", "sanitize_label_name",
     "StepMetrics", "ServingMetrics", "record_device_memory",
+    "MetricsServer", "maybe_start_from_env",
+    "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
+    "HealthRegistry", "get_health", "set_health",
+    "FlightRecorder", "StragglerDetector", "get_flight_recorder",
+    "set_flight_recorder",
 ]

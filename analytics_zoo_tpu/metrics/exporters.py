@@ -17,9 +17,11 @@ One registry snapshot, three render targets:
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 import os
+import re
 import time
 
 from analytics_zoo_tpu.metrics.registry import MetricsRegistry, get_registry
@@ -27,7 +29,61 @@ from analytics_zoo_tpu.metrics.registry import MetricsRegistry, get_registry
 __all__ = [
     "prometheus_text", "JsonlExporter", "write_jsonl",
     "TensorBoardExporter", "sample_key",
+    "sanitize_metric_name", "sanitize_label_name",
+    "unique_exposition_names",
 ]
+
+# Prometheus charsets: metric names allow colons, label names do not.
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+@functools.lru_cache(maxsize=1024)
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary registry name onto the Prometheus metric-name
+    charset (``[a-zA-Z_:][a-zA-Z0-9_:]*``): dots and other invalid
+    characters become underscores; a leading digit gets a ``_`` prefix.
+    Valid names pass through unchanged (the common case — cached so the
+    exposition hot path pays one dict lookup, not a regex pass)."""
+    if _METRIC_NAME_RE.match(name):
+        return name
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+@functools.lru_cache(maxsize=1024)
+def sanitize_label_name(name: str) -> str:
+    """Label-name variant (``[a-zA-Z_][a-zA-Z0-9_]*`` — no colons)."""
+    if _LABEL_NAME_RE.match(name):
+        return name
+    out = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_]", out[0]):
+        out = "_" + out
+    return out
+
+
+def unique_exposition_names(names) -> dict:
+    """raw family name -> COLLISION-FREE sanitized exposition name.
+
+    Two distinct registry names can sanitize to the same string
+    (``zoo.lat.seconds`` vs ``zoo_lat_seconds``); emitting both under
+    one name would produce duplicate ``# TYPE`` blocks and make a
+    Prometheus parser reject the whole scrape body.  The later name (in
+    iteration order) gets a deterministic crc32 suffix instead — stable
+    across processes and scrapes, unlike ``hash()``."""
+    import zlib
+
+    out: dict = {}
+    owner: dict = {}
+    for raw in names:
+        s = sanitize_metric_name(raw)
+        if owner.get(s, raw) != raw:
+            s = f"{s}_x{zlib.crc32(raw.encode()) & 0xFFFFFFFF:08x}"
+        owner[s] = raw
+        out[raw] = s
+    return out
 
 
 def sample_key(sample: dict) -> str:
@@ -53,9 +109,21 @@ def _label_str(labels: dict, extra: dict | None = None) -> str:
         items.update(extra)
     if not items:
         return ""
-    inner = ",".join(f'{k}="{_escape_label(v)}"'
-                     for k, v in sorted(items.items()))
-    return "{" + inner + "}"
+    # collision-free label names: two raw keys sanitizing to one name
+    # ("a.b" and "a_b") would render a duplicate label inside one
+    # sample, which the Prometheus parser rejects wholesale — same
+    # crc32-suffix rule as unique_exposition_names
+    import zlib
+
+    parts = []
+    owner: dict = {}
+    for k, v in sorted(items.items()):
+        name = sanitize_label_name(k)
+        if owner.get(name, k) != k:
+            name = f"{name}_x{zlib.crc32(k.encode()) & 0xFFFFFFFF:08x}"
+        owner[name] = k
+        parts.append(f'{name}="{_escape_label(v)}"')
+    return "{" + ",".join(parts) + "}"
 
 
 def _fmt(v: float) -> str:
@@ -68,10 +136,16 @@ def prometheus_text(registry: MetricsRegistry | None = None) -> str:
     """Render a registry snapshot in Prometheus text exposition format."""
     reg = registry if registry is not None else get_registry()
     lines: list[str] = []
-    for fam in reg.collect():
+    families = reg.collect()
+    # registry names are unconstrained (dots are natural for spans); the
+    # EXPOSITION must stay inside the Prometheus charset — and stay
+    # collision-free after mapping — or the scraper rejects the whole body
+    names = unique_exposition_names(f.name for f in families)
+    for fam in families:
+        name = names[fam.name]
         if fam.help:
-            lines.append(f"# HELP {fam.name} {fam.help}")
-        lines.append(f"# TYPE {fam.name} {fam.kind}")
+            lines.append(f"# HELP {name} {fam.help}")
+        lines.append(f"# TYPE {name} {fam.kind}")
         for labels, child in fam.samples():
             if fam.kind == "histogram":
                 # one snapshot for buckets AND sum/count: the exposition
@@ -80,17 +154,17 @@ def prometheus_text(registry: MetricsRegistry | None = None) -> str:
                 bkts, h_sum, h_count = child.export_state()
                 for bound, cum in bkts:
                     lines.append(
-                        f"{fam.name}_bucket"
+                        f"{name}_bucket"
                         f"{_label_str(labels, {'le': _fmt(bound)})}"
                         f" {cum}")
                 lines.append(
-                    f"{fam.name}_sum{_label_str(labels)}"
+                    f"{name}_sum{_label_str(labels)}"
                     f" {_fmt(h_sum)}")
                 lines.append(
-                    f"{fam.name}_count{_label_str(labels)} {h_count}")
+                    f"{name}_count{_label_str(labels)} {h_count}")
             else:
                 lines.append(
-                    f"{fam.name}{_label_str(labels)} {_fmt(child.get())}")
+                    f"{name}{_label_str(labels)} {_fmt(child.get())}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

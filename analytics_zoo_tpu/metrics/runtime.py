@@ -63,6 +63,10 @@ class StepMetrics:
             buckets=STEP_BUCKETS)
         self.steps = reg.counter(
             "zoo_train_steps_total", "train steps dispatched")
+        self.stragglers = reg.counter(
+            "zoo_train_stragglers_total",
+            "steps flagged by the flight recorder's straggler detector "
+            "(> k x rolling p50)")
         self.records = reg.counter(
             "zoo_train_records_total", "training records consumed")
         self.throughput = reg.gauge(
@@ -114,6 +118,10 @@ class ServingMetrics:
         self.trims = reg.counter(
             "zoo_serving_backpressure_trims_total",
             "backpressure stream cuts (ClusterServing.scala:128-134 role)")
+        self.stragglers = reg.counter(
+            "zoo_serving_stragglers_total",
+            "serving cycles flagged > k x rolling p50 by the flight "
+            "recorder's straggler detector")
         self.memory_ratio = reg.gauge(
             "zoo_serving_broker_memory_ratio",
             "broker used/max memory in [0,1]")

@@ -71,6 +71,10 @@ class Tracer:
         self._events: collections.deque = collections.deque(
             maxlen=self.max_events)
         self._lock = threading.Lock()
+        # registry counter mirroring `dropped`, resolved lazily on the
+        # first eviction (constructing a Tracer must not force the
+        # process-global registry into existence)
+        self._drop_counter = None
         # perf_counter origin so ts fields are small positive microseconds
         self._t0 = time.perf_counter()
 
@@ -93,10 +97,23 @@ class Tracer:
         }
         if args:
             ev["args"] = args
+        evicting = False
         with self._lock:
             if len(self._events) == self.max_events:
                 self.dropped += 1  # deque evicts the oldest on append
+                evicting = True
             self._events.append(ev)
+        if evicting:
+            # ring evictions were silent before (ISSUE 2 satellite): a
+            # scraper watching zoo_trace_spans_dropped_total now sees a
+            # trace outgrowing its window without pulling /trace
+            if self._drop_counter is None:
+                from analytics_zoo_tpu.metrics.registry import get_registry
+
+                self._drop_counter = get_registry().counter(
+                    "zoo_trace_spans_dropped_total",
+                    "span events evicted from the tracer ring buffer")
+            self._drop_counter.inc()
 
     # -- export ---------------------------------------------------------
     def events(self) -> list[dict]:

@@ -219,6 +219,16 @@ def _serve_connection(sock: socket.socket, secret: bytes | None = None):
         else:
             conn.send_bytes(_HELLO_OPEN)
         kind, payload = conn.recv()
+        if kind == "__zoo_telemetry__":
+            # Reserved control frame (ISSUE 2): the driver pulls THIS
+            # worker-server process's telemetry (registry + health,
+            # metrics/merge.py format) — one authed connection per pull,
+            # answered post-handshake so unauthenticated peers never see
+            # the snapshot either.
+            from analytics_zoo_tpu.metrics.merge import telemetry_snapshot
+
+            conn.send(("telemetry", telemetry_snapshot()))
+            return
         if kind != "spawn":
             conn.send(("init_error", f"bad first frame {kind!r}"))
             return
@@ -315,21 +325,20 @@ def start_worker_server(port: int, bind: str = "127.0.0.1",
     return srv
 
 
-def connect_and_spawn(addr: str, payload: bytes,
-                      secret=None) -> SockConn:
-    """Driver side: open the actor's connection and send the spawn
-    payload; returns the live conn (first reply is the ready/err frame,
-    read by ActorHandle exactly as on the local path).  The server's
-    hello frame announces its auth mode; a secret-presence mismatch
-    (arg or ``ZOO_ACTOR_SECRET`` on one end only) raises immediately
-    with the fix spelled out instead of hanging until timeout."""
+def _connect_authed(addr: str, secret, timeout: float = 30) -> SockConn:
+    """Open one authenticated connection to a worker server (the mutual
+    HMAC handshake from the module doc, shared verbatim by actor spawns
+    and telemetry pulls).  The server's hello frame announces its auth
+    mode; a secret-presence mismatch (arg or ``ZOO_ACTOR_SECRET`` on one
+    end only) raises immediately with the fix spelled out instead of
+    hanging until timeout."""
     secret = _resolve_secret(secret)
     host, port = addr.rsplit(":", 1)
     conn = SockConn(socket.create_connection((host, int(port)),
-                                             timeout=30))
+                                             timeout=timeout))
     conn._sock.settimeout(None)
     try:
-        hello = conn.recv_bytes(timeout=30, max_len=64)
+        hello = conn.recv_bytes(timeout=timeout, max_len=64)
         if hello.startswith(_HELLO_AUTH):
             if secret is None:
                 raise RuntimeError(
@@ -346,7 +355,7 @@ def connect_and_spawn(addr: str, payload: bytes,
             # instead of answering rejected OUR proof — surface that as
             # the auth failure it is, not a bare connection error
             try:
-                counter = conn.recv_bytes(timeout=30, max_len=64)
+                counter = conn.recv_bytes(timeout=timeout, max_len=64)
             except (EOFError, TimeoutError, OSError) as e:
                 raise RuntimeError(
                     f"worker {addr} dropped the connection during the "
@@ -375,8 +384,39 @@ def connect_and_spawn(addr: str, payload: bytes,
     except BaseException:
         conn.close()
         raise
+    return conn
+
+
+def connect_and_spawn(addr: str, payload: bytes,
+                      secret=None) -> SockConn:
+    """Driver side: open the actor's connection and send the spawn
+    payload; returns the live conn (first reply is the ready/err frame,
+    read by ActorHandle exactly as on the local path)."""
+    conn = _connect_authed(addr, secret)
     conn.send(("spawn", payload))
     return conn
+
+
+def fetch_worker_telemetry(addr: str, secret=None,
+                           timeout: float = 30) -> dict:
+    """Pull the worker SERVER process's telemetry snapshot (registry +
+    health, metrics/merge.py format) over one authed connection carrying
+    the reserved ``__zoo_telemetry__`` frame.  Complements per-actor
+    pulls (``ActorHandle.telemetry``): spawned actors answer for
+    themselves; this answers for the server that hosts them."""
+    conn = _connect_authed(addr, secret, timeout=timeout)
+    try:
+        conn.send(("__zoo_telemetry__", None))
+        if not conn.poll(timeout):
+            raise TimeoutError(f"worker {addr} telemetry timed out")
+        kind, snap = conn.recv()
+        if kind != "telemetry":
+            raise RuntimeError(
+                f"worker {addr} answered {kind!r} to a telemetry pull "
+                "(version mismatch?)")
+        return snap
+    finally:
+        conn.close()
 
 
 def main():

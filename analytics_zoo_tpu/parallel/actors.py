@@ -43,6 +43,14 @@ from typing import Any
 
 _CONTEXT: "ActorContext | None" = None
 
+# Reserved control method (ISSUE 2): a call frame whose method slot is
+# this name never reaches the user object — the actor process answers
+# with its own telemetry snapshot (metrics/merge.py format: registry +
+# health), so the driver can pull per-actor metrics over the SAME
+# ordered channel user calls travel on (no second socket, the HMAC
+# handshake and framing are reused unchanged on the TCP path).
+TELEMETRY_METHOD = "__zoo_telemetry__"
+
 
 class ActorError(RuntimeError):
     """An exception raised inside an actor, re-raised at ``get``."""
@@ -67,7 +75,14 @@ def _actor_loop(payload, conn):
             return
         call_id, method, m_args, m_kwargs = msg
         try:
-            result = getattr(obj, method)(*m_args, **m_kwargs)
+            if method == TELEMETRY_METHOD:
+                from analytics_zoo_tpu.metrics.merge import (
+                    telemetry_snapshot,
+                )
+
+                result = telemetry_snapshot()
+            else:
+                result = getattr(obj, method)(*m_args, **m_kwargs)
             conn.send((call_id, "ok", result))
         except BaseException:
             conn.send((call_id, "error", traceback.format_exc()))
@@ -135,6 +150,9 @@ class ActorHandle:
         import cloudpickle
 
         self._ctx = ctx
+        self._cls_name = cls.__name__
+        self._worker = worker
+        self._closed = False
         # cloudpickle-by-value: the spawned interpreter has no import path
         # to nested/test-local classes, and module-level ones are shadowed
         # by the @remote wrapper anyway
@@ -173,6 +191,27 @@ class ActorHandle:
             raise ActorError(f"actor {cls.__name__} failed to start:\n"
                              f"{detail}")
         ctx._actors.append(self)
+        # health model (metrics/health.py): an actor connection is
+        # idle-OK but break-FAIL — explicit verdict, not a heartbeat age
+        self._health_name = (
+            f"actor:{self._cls_name}-{len(ctx._actors) - 1}")
+        self._set_health(True)
+
+    def _set_health(self, ok: bool):
+        try:
+            from analytics_zoo_tpu.metrics.health import get_health
+
+            get_health().set_status(self._health_name, ok)
+        except Exception:
+            pass  # telemetry must never take an actor call down
+
+    def _drop_health(self):
+        try:
+            from analytics_zoo_tpu.metrics.health import get_health
+
+            get_health().unregister(self._health_name)
+        except Exception:
+            pass
 
     def _call(self, method, args, kwargs) -> ObjectRef:
         with self._send_lock:
@@ -214,7 +253,13 @@ class ActorHandle:
                     if remaining is not None and \
                             not self._conn.poll(remaining):
                         raise TimeoutError(f"call {call_id} timed out")
-                    got_id, status, payload = self._conn.recv()
+                    try:
+                        got_id, status, payload = self._conn.recv()
+                    except (EOFError, OSError):
+                        # the actor process / socket died mid-call:
+                        # surface it in /healthz before re-raising
+                        self._set_health(False)
+                        raise
                     with self._cv:
                         # drop replies nobody holds a ref to (the
                         # fire-and-forget pattern), and purge stored
@@ -242,7 +287,17 @@ class ActorHandle:
             raise AttributeError(name)
         return _RemoteMethod(self, name)
 
+    def telemetry(self, timeout: float | None = 30.0) -> dict:
+        """Pull this actor process's telemetry snapshot (registry +
+        health, metrics/merge.py format) over the reserved
+        ``__zoo_telemetry__`` frame — same ordered channel as user
+        calls, so the snapshot reflects every call completed before it.
+        """
+        return self._call(TELEMETRY_METHOD, (), {}).get(timeout)
+
     def terminate(self):
+        self._closed = True  # metrics() pulls skip a shut-down actor
+        self._drop_health()  # a DELIBERATE shutdown is not a failure
         try:
             self._conn.send(None)
             if self._proc is not None:
@@ -403,6 +458,60 @@ class ActorContext:
         if _CONTEXT is None:
             return cls.init()
         return _CONTEXT
+
+    def metrics(self, timeout: float | None = 30.0,
+                aggregator=None) -> dict:
+        """Pod-level telemetry pull (ISSUE 2): one ``__zoo_telemetry__``
+        round-trip per live actor plus one per registered worker server,
+        folded into a :class:`~analytics_zoo_tpu.metrics.merge.
+        TelemetryAggregator` — actor series labeled ``actor=<Cls-i>``,
+        worker-server series ``host=<addr>`` — and returned as its
+        ``merged()`` doc (per-source series, cluster totals, the driver
+        registry alongside).  Unreachable sources are skipped and listed
+        under ``"errors"``: a metrics pull must never raise because one
+        actor died.  Pass ``aggregator=`` to fold into an existing one
+        (e.g. the one a :class:`MetricsServer` is serving)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from analytics_zoo_tpu.metrics.merge import TelemetryAggregator
+        from analytics_zoo_tpu.parallel.actor_worker import (
+            fetch_worker_telemetry,
+        )
+
+        agg = aggregator if aggregator is not None else TelemetryAggregator()
+        # one pull job per source: (error key, source labels, fetch fn)
+        jobs = []
+        for i, a in enumerate(self._actors):
+            if a._closed:
+                continue  # deliberately terminated: not an error source
+            source = {"actor": f"{a._cls_name}-{i}"}
+            if a._worker is not None:
+                source["host"] = a._worker
+            jobs.append((f"actor:{a._cls_name}-{i}", source,
+                         lambda a=a: a.telemetry(timeout)))
+        for addr in self._workers:
+            jobs.append((f"worker:{addr}", {"host": addr},
+                         lambda addr=addr: fetch_worker_telemetry(
+                             addr, timeout=timeout)))
+        errors = {}
+        if jobs:
+            # concurrent pulls: one wedged source costs max(RTT), not
+            # sum(RTT) — a scrape loop over a 16-actor pod with one dead
+            # host must not stall 16 x timeout
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(jobs)),
+                    thread_name_prefix="zoo-telemetry-pull") as pool:
+                futures = [(key, labels, pool.submit(fn))
+                           for key, labels, fn in jobs]
+                for key, labels, fut in futures:
+                    try:
+                        agg.ingest(fut.result(), **labels)
+                    except Exception as e:
+                        errors[key] = repr(e)
+        doc = agg.merged()
+        if errors:
+            doc["errors"] = errors
+        return doc
 
     def stop(self):
         global _CONTEXT

@@ -62,6 +62,10 @@ from analytics_zoo_tpu.common.utils import time_it
 from analytics_zoo_tpu.feature.dataset import FeatureSet
 from analytics_zoo_tpu.metrics import (
     StepMetrics,
+    StragglerDetector,
+    get_flight_recorder,
+    get_health,
+    maybe_start_from_env,
     record_device_memory,
     span,
 )
@@ -132,7 +136,8 @@ class _DeviceFeeder:
 
     _END = object()
 
-    def __init__(self, batches, shard_fn, depth: int = 2):
+    def __init__(self, batches, shard_fn, depth: int = 2,
+                 heartbeat=None, on_exit=None):
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: BaseException | None = None
@@ -140,18 +145,37 @@ class _DeviceFeeder:
         def run():
             try:
                 for b in batches:
+                    if heartbeat is not None:
+                        heartbeat()  # /healthz: the feeder is alive
                     item = shard_fn(b)
                     while not self._stop.is_set():
                         try:
                             self._q.put(item, timeout=0.1)
                             break
                         except queue.Full:
+                            # keep beating while blocked on a full
+                            # queue: waiting for the consumer (e.g.
+                            # through a multi-minute first-step compile)
+                            # is not being wedged
+                            if heartbeat is not None:
+                                heartbeat()
                             continue
                     if self._stop.is_set():
                         return
             except BaseException as e:  # re-raised on the consumer side
                 self._err = e
             finally:
+                # on_exit runs ON THIS THREAD, sequenced after every
+                # beat above — the estimator uses it to unregister the
+                # infeed health component, so a feeder that finished
+                # early (small epoch fully buffered) cannot read as
+                # stale during a slow step, and no beat can resurrect
+                # the component after its unregister
+                if on_exit is not None:
+                    try:
+                        on_exit()
+                    except Exception:
+                        pass
                 while not self._stop.is_set():
                     try:
                         self._q.put(self._END, timeout=0.1)
@@ -567,8 +591,12 @@ class Estimator:
                 break
             except (KeyboardInterrupt, ValueError, TypeError):
                 raise
-            except Exception:
+            except Exception as e:
                 # retry-from-checkpoint loop (Topology.scala:1171-1253)
+                # — recorded in the flight ring BEFORE the retry, so a
+                # postmortem shows every attempt's failure, not just the
+                # one that finally escaped
+                get_flight_recorder().record_exception(e, where="train")
                 retries += 1
                 if self._ckpt is None or retries > retry_times:
                     raise
@@ -629,6 +657,19 @@ class Estimator:
         # per-step cost is a handful of observe/inc calls — and on a
         # disabled registry those are the shared no-op singleton.
         step_metrics = StepMetrics()
+        # Distributed telemetry plane (ISSUE 2): scrape endpoints opt in
+        # via ZOO_METRICS_PORT; the flight recorder arms its crash dump
+        # (ZOO_FLIGHT_DIR); the loop and the infeed feeder heartbeat
+        # /healthz; steps beyond k x rolling-p50 are flagged stragglers.
+        maybe_start_from_env()
+        flight = get_flight_recorder().install()
+        straggler = StragglerDetector()
+        health = get_health()
+        # The loop only beats once per COMPLETED step, and the first
+        # step includes the XLA compile (routinely minutes on a big
+        # model) — the silence budget must cover that, or /healthz
+        # would 503 a healthy process through every warmup.
+        health.register("train_loop", stale_after=600.0)
         while not end_trigger(tstate):
             epoch_t0 = time.perf_counter()
             n_records = 0
@@ -639,8 +680,16 @@ class Estimator:
             )
             loss_dev = None
             bi = start_batch
-            feeder = _DeviceFeeder(batch_iter, ctx.shard_batch,
-                                   depth=cfg.infeed_depth)
+            # 60s budget: the feeder beats per batch AND while blocked
+            # on a full queue, so only a truly stalled input pipeline
+            # (the tf.data failure mode) exceeds it.  The feeder THREAD
+            # unregisters the component when it exits (on_exit), so the
+            # main thread never races a late beat.
+            health.register("infeed", stale_after=60.0)
+            feeder = _DeviceFeeder(
+                batch_iter, ctx.shard_batch, depth=cfg.infeed_depth,
+                heartbeat=lambda: health.heartbeat("infeed"),
+                on_exit=lambda: health.unregister("infeed"))
             prof_active = False
             try:
                 feeder_iter = iter(feeder)
@@ -688,9 +737,27 @@ class Estimator:
                     params, opt_state, state = fired
                     # step-time breakdown: data-wait (infeed the feeder
                     # failed to hide) / dispatch / full iteration
+                    step_s = time.perf_counter() - t_iter0
                     step_metrics.record_step(
                         t_data - t_iter0, t_disp - t_data,
-                        time.perf_counter() - t_iter0, batch_size)
+                        step_s, batch_size)
+                    health.heartbeat("train_loop")
+                    # flight recorder: one structured record per step
+                    # (bounded ring — a postmortem shows the FINAL
+                    # steps), stragglers flagged against rolling p50
+                    flight.record(
+                        "step", loop="train", step=self.global_step,
+                        epoch=epoch, data_wait_s=round(t_data - t_iter0, 6),
+                        dispatch_s=round(t_disp - t_data, 6),
+                        step_s=round(step_s, 6))
+                    if straggler.observe(step_s):
+                        step_metrics.stragglers.inc()
+                        flight.record(
+                            "straggler", loop="train",
+                            step=self.global_step,
+                            step_s=round(step_s, 6),
+                            rolling_p50_s=round(
+                                straggler.rolling_p50(), 6))
             finally:
                 feeder.stop()
                 if prof_active:
@@ -729,6 +796,7 @@ class Estimator:
                 epoch, 0, seed, batch_size,
             )
         self.epoch = epoch
+        health.unregister("train_loop")  # finished on purpose, not wedged
         return params, opt_state, state
 
     def _flush_loss_buffer(self):

@@ -17,7 +17,14 @@ import time
 
 import numpy as np
 
-from ..metrics import ServingMetrics, span
+from ..metrics import (
+    ServingMetrics,
+    StragglerDetector,
+    get_flight_recorder,
+    get_health,
+    maybe_start_from_env,
+    span,
+)
 from ..tensorboard import InferenceSummary
 from .broker import connect_broker
 from .client import INPUT_STREAM, RESULT_PREFIX, decode_ndarray, \
@@ -89,6 +96,11 @@ class ClusterServing:
         # Serving telemetry (metrics/): queue depth, batch size, latency
         # histograms per step() — no-op singletons when ZOO_METRICS=0.
         self.metrics = ServingMetrics()
+        # Flight recorder + straggler detector (ISSUE 2): non-empty
+        # cycles land in the bounded ring; a crashed step's final events
+        # survive at /flightz and in the ZOO_FLIGHT_DIR dump.
+        self._flight = get_flight_recorder()
+        self._straggler = StragglerDetector()
 
     # ------------------------------------------------------------------
 
@@ -172,6 +184,11 @@ class ClusterServing:
                     n = self.process_batch(records)
             else:
                 n = 0
+        except BaseException as e:
+            # a crashed step's last act: land in the flight ring, so
+            # /flightz and the ZOO_FLIGHT_DIR dump show WHICH batch died
+            self._flight.record_exception(e, where="serving.step")
+            raise
         finally:
             if records:
                 # ack consumed records so the stream cannot grow unbounded
@@ -194,6 +211,18 @@ class ClusterServing:
             self.metrics.latency.observe(t_end - t0)
             self.metrics.batch_size.observe(len(records))
             self.metrics.records.inc(n)
+            # flight ring: non-empty cycles only (the idle poll would
+            # flood the postmortem window with zero-information events)
+            self._flight.record(
+                "step", loop="serving", records=len(records), served=n,
+                latency_s=round(t_end - t0, 6))
+            if self._straggler.observe(t_end - t0):
+                self.metrics.stragglers.inc()
+                self._flight.record(
+                    "straggler", loop="serving",
+                    latency_s=round(t_end - t0, 6),
+                    rolling_p50_s=round(
+                        self._straggler.rolling_p50(), 6))
         return n
 
     def run(self, max_records: int | None = None,
@@ -207,6 +236,18 @@ class ClusterServing:
             self.summary = InferenceSummary(
                 self.helper.log_dir,
                 time.strftime("%Y%m%d-%H%M%S") + "-ClusterServing")
+        # Distributed telemetry plane (ISSUE 2): scrape endpoints opt in
+        # via ZOO_METRICS_PORT; crash dumps arm via ZOO_FLIGHT_DIR; the
+        # loop heartbeats /healthz every cycle (even idle polls — an
+        # idle loop is alive; a WEDGED one goes 503 after 15s).
+        maybe_start_from_env()
+        self._flight.install()
+        health = get_health()
+        # 120s budget: one beat per cycle, and the first non-empty batch
+        # pays the bucketed XLA compile — tens of seconds on big models;
+        # /healthz must not 503 a process that is compiling, only one
+        # that stopped cycling.
+        health.register("serving_loop", stale_after=120.0)
         last_active = time.monotonic()
         while not self._stop.is_set():
             try:
@@ -215,6 +256,7 @@ class ClusterServing:
                 # a bad batch must not kill the serving loop/thread
                 logger.exception("serving: batch failed; continuing")
                 n = 0
+            health.heartbeat("serving_loop")
             served += n
             if n:
                 last_active = time.monotonic()
@@ -223,6 +265,7 @@ class ClusterServing:
             if idle_timeout is not None and \
                     time.monotonic() - last_active > idle_timeout:
                 break
+        health.unregister("serving_loop")  # stopped on purpose
         self.summary.close()
         return served
 

@@ -796,3 +796,166 @@ class TestReshardZero1:
             assert out["mat"].sharding.is_fully_replicated
             assert not out["mu"].sharding.is_fully_replicated
             assert out["count"].shape == ()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 2 satellites: exposition name hygiene, disabled-mode exporters,
+# tracer eviction counter
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestPrometheusNameHygiene:
+    """Satellite regression: registry names are unconstrained (dotted
+    span-style names are natural), but the exposition must stay inside
+    the Prometheus charset instead of emitting invalid series."""
+
+    def test_dots_and_invalid_chars_sanitized(self):
+        from analytics_zoo_tpu.metrics import sanitize_metric_name
+
+        reg = MetricsRegistry()
+        reg.counter("zoo.serving.step_total", "dotted").inc(2)
+        reg.gauge("weird name-metric", "").set(1)
+        h = reg.histogram("zoo.lat.seconds", "", buckets=(1.0,))
+        h.observe(0.5)
+        text = prometheus_text(reg)
+        import re
+
+        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert name_re.match(name), f"invalid exposition name {name!r}"
+        assert "zoo_serving_step_total 2.0" in text
+        assert "weird_name_metric 1.0" in text
+        assert 'zoo_lat_seconds_bucket{le="1.0"} 1' in text
+        # leading digit gets a prefix, valid names pass through untouched
+        assert sanitize_metric_name("9lives") == "_9lives"
+        assert sanitize_metric_name("zoo_ok_total") == "zoo_ok_total"
+
+    def test_label_names_sanitized(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "", ("my.label",)).labels(
+            **{"my.label": "v"}).inc()
+        text = prometheus_text(reg)
+        assert 'c_total{my_label="v"} 1.0' in text
+
+    def test_label_name_collisions_get_deterministic_suffix(self):
+        # "a.b" and "a_b" both sanitize to a_b: a duplicate label name
+        # inside one sample is invalid exposition, so one key gets a
+        # stable crc32 suffix
+        reg = MetricsRegistry()
+        reg.counter("c_total", "", ("a.b", "a_b")).labels(
+            **{"a.b": "1", "a_b": "2"}).inc()
+        text = prometheus_text(reg)
+        line = [l for l in text.splitlines()
+                if l.startswith("c_total{")][0]
+        import re
+
+        names = re.findall(r'([a-zA-Z0-9_]+)="', line)
+        assert len(names) == len(set(names)) == 2
+        assert "a_b" in names
+        assert prometheus_text(reg) == text  # deterministic
+
+    def test_sanitize_collisions_get_deterministic_suffix(self):
+        # two DISTINCT registry names mapping onto one exposition name
+        # must not emit duplicate TYPE blocks (a parser rejects the
+        # whole body) — the later one gets a stable crc32 suffix
+        reg = MetricsRegistry()
+        reg.counter("zoo.lat_total", "").inc(1)
+        reg.counter("zoo_lat_total", "").inc(2)
+        text = prometheus_text(reg)
+        type_lines = [l for l in text.splitlines()
+                      if l.startswith("# TYPE")]
+        names = [l.split()[2] for l in type_lines]
+        assert len(names) == len(set(names)) == 2
+        assert "zoo_lat_total" in names
+        suffixed = next(n for n in names if n != "zoo_lat_total")
+        assert suffixed.startswith("zoo_lat_total_x")
+        # deterministic: a second render produces the same names
+        assert prometheus_text(reg) == text
+
+
+@metrics_mark
+class TestDisabledExporters:
+    """Satellite: every exporter against the ZOO_METRICS=0 no-op
+    registry must produce empty-but-valid output and allocate no
+    families/children per call."""
+
+    def test_disabled_registry_hands_out_null_only(self):
+        reg = MetricsRegistry(enabled=False)
+        assert reg.counter("a_total", "") is NULL
+        assert reg.gauge("g", "") is NULL
+        assert reg.gauge("g", "").labels() is NULL
+        assert reg.histogram("h_seconds", "") is NULL
+        assert reg.counter("a_total", "", ("l",)).labels(l="x") is NULL
+        assert reg.collect() == []  # nothing was ever allocated
+
+    def test_prometheus_text_empty_but_valid(self):
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("a_total", "").inc(5)
+        assert prometheus_text(reg) == ""
+
+    def test_jsonl_empty_but_valid(self, tmp_path):
+        reg = MetricsRegistry(enabled=False)
+        reg.histogram("h", "").observe(1.0)
+        path = str(tmp_path / "m.jsonl")
+        doc = JsonlExporter(path, reg).write(step=7)
+        assert doc["samples"] == [] and doc["step"] == 7
+        line = json.loads(open(path).read())
+        assert line["samples"] == []
+
+    def test_tensorboard_export_writes_nothing(self):
+        class Writer:
+            def __init__(self):
+                self.calls = []
+
+            def add_scalar(self, *a):
+                self.calls.append(a)
+
+        reg = MetricsRegistry(enabled=False)
+        reg.gauge("g", "").set(3)
+        w = Writer()
+        assert TensorBoardExporter(w, reg).export(step=1) == 0
+        assert w.calls == []
+
+    def test_no_allocation_per_call(self):
+        reg = MetricsRegistry(enabled=False)
+        for _ in range(100):
+            reg.counter("x_total", "").inc()
+            reg.histogram("y_seconds", "").observe(0.1)
+        assert reg.collect() == []  # still zero families
+        # the snapshot side allocates nothing either
+        from analytics_zoo_tpu.metrics import telemetry_snapshot
+
+        assert telemetry_snapshot(reg)["samples"] == []
+
+
+@metrics_mark
+class TestTracerDropCounter:
+    def test_ring_evictions_increment_registry_counter(self,
+                                                       fresh_registry):
+        t = Tracer(jax_bridge=False, max_events=2)
+        for i in range(5):
+            with span(f"s{i}", tracer=t):
+                pass
+        assert t.dropped == 3
+        c = fresh_registry.counter(
+            "zoo_trace_spans_dropped_total", "")
+        assert c.get() == 3
+        # and /varz carries the same number without needing /trace
+        from analytics_zoo_tpu.metrics import MetricsServer
+
+        srv = MetricsServer(port=0, host="127.0.0.1",
+                            registry=fresh_registry, tracer=t).start()
+        try:
+            import urllib.request
+
+            doc = json.loads(urllib.request.urlopen(
+                srv.url + "/varz", timeout=10).read())
+            assert doc["trace"]["dropped_spans"] == 3
+            assert any(s["name"] == "zoo_trace_spans_dropped_total"
+                       and s["value"] == 3 for s in doc["samples"])
+        finally:
+            srv.stop()

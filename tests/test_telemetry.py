@@ -1,0 +1,649 @@
+"""Distributed telemetry plane (ISSUE 2): mergeable snapshots and the
+driver-side aggregator, the `__zoo_telemetry__` actor/worker control
+frame, the HTTP scrape endpoints, the health rollup behind /healthz, and
+the crash flight recorder."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.metrics import (
+    FlightRecorder,
+    HealthRegistry,
+    MetricsRegistry,
+    MetricsServer,
+    StragglerDetector,
+    TelemetryAggregator,
+    Tracer,
+    get_health,
+    merge_samples,
+    set_flight_recorder,
+    set_registry,
+    telemetry_snapshot,
+)
+
+metrics_mark = pytest.mark.metrics
+
+
+@pytest.fixture()
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture()
+def fresh_flight():
+    fr = FlightRecorder(capacity=256)
+    prev = set_flight_recorder(fr)
+    try:
+        yield fr
+    finally:
+        set_flight_recorder(prev)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# snapshot + merge semantics
+# ---------------------------------------------------------------------------
+
+
+def _metered_registry(c_val, h_obs):
+    reg = MetricsRegistry()
+    reg.counter("work_total", "items", ("kind",)).labels(
+        kind="a").inc(c_val)
+    reg.gauge("depth", "backlog").set(c_val)
+    h = reg.histogram("lat_seconds", "", buckets=(0.1, 1.0))
+    for v in h_obs:
+        h.observe(v)
+    return reg
+
+
+@metrics_mark
+class TestMergeSemantics:
+    def test_snapshot_is_json_roundtrippable(self):
+        snap = telemetry_snapshot(_metered_registry(3, [0.05, 5.0]),
+                                  health=HealthRegistry())
+        snap2 = json.loads(json.dumps(snap))  # +Inf encoded as null
+        hist = [s for s in snap2["samples"]
+                if s["kind"] == "histogram"][0]
+        assert hist["buckets"][-1][0] is None
+        assert hist["buckets"][-1][1] == hist["count"] == 2
+
+    def test_counters_sum_histograms_bucket_merge(self):
+        h = HealthRegistry()
+        a = telemetry_snapshot(_metered_registry(3, [0.05]), health=h)
+        b = telemetry_snapshot(_metered_registry(5, [0.5, 5.0]), health=h)
+        totals = {s["name"]: s for s in merge_samples(
+            [a["samples"], b["samples"]])}
+        assert totals["work_total"]["value"] == 8
+        assert totals["work_total"]["labels"] == {"kind": "a"}
+        merged = totals["lat_seconds"]
+        assert merged["count"] == 3
+        assert [cum for _, cum in merged["buckets"]] == [1, 2, 3]
+        # gauges have no meaningful cross-source total
+        assert "depth" not in totals
+
+    def test_conflicting_histogram_buckets_not_merged(self):
+        h = HealthRegistry()
+        a = telemetry_snapshot(_metered_registry(1, [0.05]), health=h)
+        other = MetricsRegistry()
+        other.histogram("lat_seconds", "", buckets=(7.0,)).observe(1.0)
+        b = telemetry_snapshot(other, health=h)
+        totals = {s["name"] for s in merge_samples(
+            [a["samples"], b["samples"]])}
+        assert "lat_seconds" not in totals  # silently adding would lie
+
+    def test_aggregator_labels_per_source_and_replaces(self):
+        h = HealthRegistry()
+        agg = TelemetryAggregator(registry=MetricsRegistry())
+        agg.ingest(telemetry_snapshot(_metered_registry(3, [0.05]),
+                                      health=h), actor="a0")
+        agg.ingest(telemetry_snapshot(_metered_registry(5, []),
+                                      health=h), actor="a1")
+        doc = agg.merged()
+        per_source = {(s["labels"].get("actor"), s["name"]): s
+                      for s in doc["samples"]}
+        assert per_source[("a0", "work_total")]["value"] == 3
+        assert per_source[("a1", "work_total")]["value"] == 5
+        # gauges stay per-source labeled series
+        assert per_source[("a0", "depth")]["value"] == 3
+        # re-ingesting the same source REPLACES, never double-counts
+        agg.ingest(telemetry_snapshot(_metered_registry(7, []),
+                                      health=h), actor="a1")
+        totals = {s["name"]: s for s in agg.merged()["totals"]}
+        assert totals["work_total"]["value"] == 10
+        assert set(agg.merged()["sources"]) == {"actor=a0", "actor=a1"}
+
+    def test_unlabeled_ingest_rejected(self):
+        agg = TelemetryAggregator()
+        with pytest.raises(ValueError, match="source label"):
+            agg.ingest({"samples": []})
+
+    def test_aggregator_prometheus_text_is_valid(self):
+        import re
+
+        h = HealthRegistry()
+        agg = TelemetryAggregator()
+        agg.ingest(telemetry_snapshot(_metered_registry(2, [0.5]),
+                                      health=h), host="h1", actor="x")
+        text = agg.prometheus_text()
+        assert 'work_total{actor="x",host="h1",kind="a"} 2.0' in text
+        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{|\s)")
+        for line in text.splitlines():
+            if not line.startswith("#"):
+                assert name_re.match(line), line
+        inf = [l for l in text.splitlines() if 'le="+Inf"' in l][0]
+        cnt = [l for l in text.splitlines()
+               if l.startswith("lat_seconds_count")][0]
+        assert inf.split()[-1] == cnt.split()[-1]
+
+
+# ---------------------------------------------------------------------------
+# health model
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestHealthRegistry:
+    def test_stale_rollup_and_recovery(self, fresh_flight):
+        now = [0.0]
+        h = HealthRegistry(clock=lambda: now[0])
+        h.register("serving_loop", stale_after=5.0)
+        h.register("infeed", stale_after=50.0)
+        assert h.status()["healthy"]
+        now[0] = 10.0  # serving_loop silent past its budget
+        st = h.status()
+        assert not st["healthy"]
+        assert not st["components"]["serving_loop"]["healthy"]
+        assert st["components"]["infeed"]["healthy"]
+        h.heartbeat("serving_loop")
+        assert h.status()["healthy"]
+        # both transitions landed in the flight ring
+        trans = [(e["component"], e["state"])
+                 for e in fresh_flight.events("health")]
+        assert trans == [("serving_loop", "stale"),
+                         ("serving_loop", "healthy")]
+
+    def test_explicit_status_overrides_age(self):
+        now = [0.0]
+        h = HealthRegistry(clock=lambda: now[0])
+        h.set_status("actor:PS-0", True)
+        now[0] = 1e6  # idle forever is fine for a connection
+        assert h.status()["healthy"]
+        h.set_status("actor:PS-0", False)
+        assert not h.status()["healthy"]
+        h.heartbeat("actor:PS-0")  # a beat clears the forced verdict
+        assert h.status()["healthy"]
+
+    def test_unregister_removes_component(self):
+        h = HealthRegistry()
+        h.set_status("x", False)
+        assert not h.status()["healthy"]
+        h.unregister("x")
+        assert h.status()["healthy"] and h.status()["components"] == {}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestFlightRecorder:
+    def test_ring_keeps_newest_counts_drops(self):
+        fr = FlightRecorder(capacity=3)
+        for i in range(7):
+            fr.record("step", i=i)
+        assert [e["i"] for e in fr.events()] == [4, 5, 6]
+        assert fr.dropped == 4
+
+    def test_disabled_records_nothing(self):
+        fr = FlightRecorder(enabled=False)
+        assert fr.record("step") is None
+        assert fr.events() == []
+
+    def test_dump_once_per_reason_atomic(self, tmp_path):
+        fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+        fr.record("step", i=1)
+        p = fr.dump("crash")
+        assert p and json.load(open(p))["events"][0]["i"] == 1
+        assert fr.dump("crash") is None  # once per reason
+        assert fr.dump("exit") is not None  # distinct reason still dumps
+
+    def test_record_exception_carries_type_and_traceback(self):
+        fr = FlightRecorder()
+        try:
+            raise RuntimeError("device burned down")
+        except RuntimeError as e:
+            fr.record_exception(e, where="serving.step")
+        (ev,) = fr.events("exception")
+        assert ev["exc_type"] == "RuntimeError"
+        assert "device burned down" in ev["message"]
+        assert "RuntimeError" in ev["traceback"]
+        assert ev["where"] == "serving.step"
+
+    def test_excepthook_chain_dumps_and_calls_previous(self, tmp_path):
+        import sys
+
+        fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+        seen = []
+        prev_hook = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            fr.install()
+            try:
+                raise ValueError("unhandled boom")
+            except ValueError as e:
+                sys.excepthook(type(e), e, e.__traceback__)
+            assert len(seen) == 1  # prior hook still ran
+            (dump,) = [f for f in tmp_path.iterdir()
+                       if "crash" in f.name]
+            doc = json.load(open(dump))
+            assert doc["reason"] == "crash"
+            assert any(e["kind"] == "exception" and
+                       "unhandled boom" in e["message"]
+                       for e in doc["events"])
+        finally:
+            sys.excepthook = prev_hook
+
+    def test_straggler_detector_flags_against_rolling_p50(self):
+        sd = StragglerDetector(k=3.0, window=32, min_steps=8)
+        for _ in range(8):
+            assert not sd.observe(0.1)  # warmup: no verdicts
+        assert sd.observe(0.5)          # 5x the p50
+        assert not sd.observe(0.12)     # normal step
+        assert sd.rolling_p50() == pytest.approx(0.1, abs=0.05)
+        with pytest.raises(ValueError):
+            StragglerDetector(k=1.0)
+
+
+# ---------------------------------------------------------------------------
+# MetricsServer endpoints (acceptance: port 0, prometheus parse, healthz
+# flip, flightz carries a crashed step's events)
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestMetricsServer:
+    def test_endpoints_end_to_end(self):
+        import re
+
+        now = [0.0]
+        reg = _metered_registry(4, [0.05, 0.5])
+        health = HealthRegistry(clock=lambda: now[0])
+        health.register("serving_loop", stale_after=5.0)
+        flight = FlightRecorder(capacity=16)
+        tracer = Tracer(jax_bridge=False)
+        srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                            health=health, flight=flight,
+                            tracer=tracer).start()
+        try:
+            assert srv.port != 0  # ephemeral bind resolved
+            # /metrics parses as Prometheus text exposition
+            status, text = _get(srv.url + "/metrics")
+            assert status == 200
+            line_re = re.compile(
+                r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+                r'(\{[a-zA-Z_][a-zA-Z0-9_]*=".*"(,[a-zA-Z_]'
+                r'[a-zA-Z0-9_]*=".*")*\})? '
+                r"[-+0-9.eInf]+$")
+            body = [l for l in text.splitlines() if not l.startswith("#")]
+            assert body
+            for line in body:
+                assert line_re.match(line), line
+            assert 'work_total{kind="a"} 4.0' in body
+            # /varz is the JSONL snapshot shape + health/trace/flight
+            status, varz = _get(srv.url + "/varz")
+            doc = json.loads(varz)
+            assert {s["name"] for s in doc["samples"]} >= {
+                "work_total", "lat_seconds"}
+            assert doc["health"]["healthy"] is True
+            assert doc["trace"]["dropped_spans"] == 0
+            # /trace is chrome-trace JSON
+            with tracer_span(tracer):
+                pass
+            _, tr = _get(srv.url + "/trace")
+            assert json.loads(tr)["traceEvents"][0]["name"] == "probe"
+            # /healthz flips 200 -> 503 when a heartbeat goes stale
+            assert _get(srv.url + "/healthz")[0] == 200
+            now[0] = 60.0
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url + "/healthz")
+            assert err.value.code == 503
+            stale = json.loads(err.value.read())
+            assert not stale["components"]["serving_loop"]["healthy"]
+            # /flightz returns what a simulated crashed step recorded
+            flight.record("step", loop="serving", records=8)
+            try:
+                raise RuntimeError("XLA halted")
+            except RuntimeError as e:
+                flight.record_exception(e, where="serving.step")
+            _, fl = _get(srv.url + "/flightz")
+            events = json.loads(fl)["events"]
+            assert events[0]["kind"] == "step"
+            assert events[-1]["kind"] == "exception"
+            assert "XLA halted" in events[-1]["message"]
+            # unknown path: 404 with the endpoint directory
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url + "/nope")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_metrics_includes_aggregated_sources(self):
+        h = HealthRegistry()
+        agg = TelemetryAggregator()
+        agg.ingest(telemetry_snapshot(_metered_registry(9, []), health=h),
+                   actor="w0")
+        # the DRIVER registry shares family names with the sources: the
+        # exposition must still emit ONE group with ONE TYPE line per
+        # family, or a Prometheus parser rejects the whole body
+        driver_reg = _metered_registry(2, [0.5])
+        srv = MetricsServer(port=0, host="127.0.0.1",
+                            registry=driver_reg,
+                            aggregator=agg).start()
+        try:
+            _, text = _get(srv.url + "/metrics")
+            assert 'work_total{actor="w0",kind="a"} 9.0' in text
+            assert 'work_total{kind="a"} 2.0' in text  # driver's own
+            type_lines = [l for l in text.splitlines()
+                          if l.startswith("# TYPE work_total")]
+            assert len(type_lines) == 1
+            # family groups are contiguous (exposition-format contract)
+            names = [l.split("{")[0].split(" ")[0].split("_bucket")[0]
+                     for l in text.splitlines() if not l.startswith("#")]
+            seen, prev = set(), None
+            for n in names:
+                assert not (n != prev and n in seen), f"{n} split"
+                seen.add(n)
+                prev = n
+            _, varz = _get(srv.url + "/varz")
+            doc = json.loads(varz)
+            assert doc["aggregate"]["totals"][0]["name"] in (
+                "depth", "lat_seconds", "work_total")
+            assert "actor=w0" in doc["aggregate"]["sources"]
+        finally:
+            srv.stop()
+
+    def test_env_opt_in(self, monkeypatch):
+        import analytics_zoo_tpu.metrics.http as http_mod
+
+        monkeypatch.setattr(http_mod, "_env_server", None)
+        monkeypatch.delenv("ZOO_METRICS_PORT", raising=False)
+        assert http_mod.maybe_start_from_env() is None
+        monkeypatch.setenv("ZOO_METRICS_PORT", "0")
+        monkeypatch.setenv("ZOO_METRICS_HOST", "127.0.0.1")
+        srv = http_mod.maybe_start_from_env()
+        try:
+            assert srv is not None
+            assert http_mod.maybe_start_from_env() is srv  # idempotent
+            assert _get(srv.url + "/metrics")[0] == 200
+        finally:
+            srv.stop()
+            monkeypatch.setattr(http_mod, "_env_server", None)
+
+
+def tracer_span(tracer):
+    from analytics_zoo_tpu.metrics import span
+
+    return span("probe", tracer=tracer)
+
+
+# ---------------------------------------------------------------------------
+# serving loop wiring: crashed step lands in the flight ring
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestServingFlightWiring:
+    def test_crashed_step_records_exception(self, tmp_path,
+                                            fresh_registry, fresh_flight):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Dense,
+            Flatten,
+        )
+        from analytics_zoo_tpu.pipeline.api.keras.topology import (
+            Sequential,
+        )
+        from analytics_zoo_tpu.serving import (
+            ClusterServing,
+            ClusterServingHelper,
+            InMemoryBroker,
+            InputQueue,
+        )
+
+        m = Sequential()
+        m.add(Flatten(input_shape=(4, 4, 1)))
+        m.add(Dense(5, activation="softmax"))
+        m.build_params()
+        path = str(tmp_path / "model.zoo")
+        m.save(path)
+        broker = InMemoryBroker()
+        serving = ClusterServing(
+            ClusterServingHelper(model_path=path, batch_size=4,
+                                 data_shape=(4, 4, 1),
+                                 log_dir=str(tmp_path / "logs")),
+            broker=broker)
+        inq = InputQueue(broker=broker)
+        inq.enqueue_image("ok", np.zeros((4, 4, 1), np.float32))
+        assert serving.step(block_ms=0) == 1
+        # a healthy non-empty cycle recorded one step event
+        (step_ev,) = fresh_flight.events("step")
+        assert step_ev["loop"] == "serving" and step_ev["served"] == 1
+        # now crash the model mid-step: the exception must land in the
+        # ring before propagating
+        serving.model = _Boom()
+        inq.enqueue_image("bad", np.zeros((4, 4, 1), np.float32))
+        with pytest.raises(RuntimeError, match="model exploded"):
+            serving.step(block_ms=0)
+        (exc_ev,) = fresh_flight.events("exception")
+        assert exc_ev["where"] == "serving.step"
+        assert "model exploded" in exc_ev["message"]
+        serving.summary.close()
+
+
+class _Boom:
+    def predict(self, x):
+        raise RuntimeError("model exploded")
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: >=2 actor processes doing metered work, snapshots
+# pulled over the __zoo_telemetry__ frame, merged driver-side
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestActorTelemetryE2E:
+    def test_two_actor_pull_merge(self, fresh_registry):
+        from analytics_zoo_tpu.parallel.actors import (
+            ActorContext,
+            get,
+            remote,
+        )
+
+        @remote
+        class Metered:
+            def __init__(self):
+                from analytics_zoo_tpu.metrics import get_registry
+
+                self.reg = get_registry()
+
+            def work(self, n):
+                c = self.reg.counter("zoo_e2e_work_total", "work",
+                                     ("kind",))
+                h = self.reg.histogram("zoo_e2e_work_seconds", "",
+                                       buckets=(0.01, 0.1))
+                for _ in range(n):
+                    c.labels(kind="unit").inc()
+                    h.observe(0.05)
+                return n
+
+        ctx = ActorContext.init()
+        try:
+            a = Metered.remote()
+            b = Metered.remote()
+            assert get([a.work.remote(3), b.work.remote(5)],
+                       timeout=60) == [3, 5]
+            # driver-side metric so the merged doc carries the driver
+            # registry alongside
+            fresh_registry.counter("zoo_e2e_driver_total", "").inc()
+            doc = ctx.metrics(timeout=60)
+            assert not doc.get("errors")
+            # summed counters across the two actor processes
+            totals = {s["name"]: s for s in doc["totals"]}
+            assert totals["zoo_e2e_work_total"]["value"] == 8
+            assert totals["zoo_e2e_work_total"]["labels"] == {
+                "kind": "unit"}
+            # bucket-merged histogram: all 8 obs in the (0.01, 0.1] bucket
+            merged_h = totals["zoo_e2e_work_seconds"]
+            assert merged_h["count"] == 8
+            assert [cum for _, cum in merged_h["buckets"]] == [0, 8, 8]
+            # per-source series labeled actor=Metered-<i>
+            per_source = {
+                (s["labels"]["actor"], s["name"]): s["value"]
+                for s in doc["samples"]
+                if s["name"] == "zoo_e2e_work_total"}
+            assert per_source[("Metered-0", "zoo_e2e_work_total")] == 3
+            assert per_source[("Metered-1", "zoo_e2e_work_total")] == 5
+            # both actor processes report healthy in their snapshots
+            assert all(src["healthy"]
+                       for src in doc["sources"].values())
+            # the driver registry rides alongside
+            assert any(s["name"] == "zoo_e2e_driver_total"
+                       for s in doc["driver"]["samples"])
+            # actor connections appear in the DRIVER health rollup
+            comps = get_health().status()["components"]
+            assert "actor:Metered-0" in comps
+            assert comps["actor:Metered-0"]["healthy"]
+        finally:
+            ctx.stop()
+
+    def test_terminated_actor_skipped_by_metrics_pull(self,
+                                                      fresh_registry):
+        from analytics_zoo_tpu.parallel.actors import (
+            ActorContext,
+            remote,
+        )
+
+        @remote
+        class Idle:
+            def ping(self):
+                return "pong"
+
+        ctx = ActorContext.init()
+        try:
+            a = Idle.remote()
+            b = Idle.remote()
+            assert a.ping.remote().get(timeout=60) == "pong"
+            a.terminate()  # deliberate shutdown: not an error source
+            doc = ctx.metrics(timeout=60)
+            assert not doc.get("errors")
+            assert set(doc["sources"]) == {"actor=Idle-1"}
+            # ...and the driver health rollup dropped its component
+            assert "actor:Idle-0" not in get_health().status()[
+                "components"]
+        finally:
+            ctx.stop()
+
+    def test_worker_server_telemetry_frame(self):
+        from analytics_zoo_tpu.metrics import get_registry
+        from analytics_zoo_tpu.parallel.actor_worker import (
+            fetch_worker_telemetry,
+            start_worker_server,
+        )
+
+        srv = start_worker_server(0, bind="127.0.0.1", block=False)
+        try:
+            addr = f"127.0.0.1:{srv.getsockname()[1]}"
+            get_registry().counter("zoo_worker_probe_total", "").inc(2)
+            snap = fetch_worker_telemetry(addr, timeout=30)
+            assert snap["health"]["healthy"] in (True, False)
+            names = {s["name"] for s in snap["samples"]}
+            # the worker "server" here runs in-process, so its snapshot
+            # sees this process's registry — the frame works end to end
+            assert "zoo_worker_probe_total" in names
+        finally:
+            srv.close()
+
+    def test_worker_telemetry_requires_auth(self):
+        from analytics_zoo_tpu.parallel.actor_worker import (
+            fetch_worker_telemetry,
+            start_worker_server,
+        )
+
+        srv = start_worker_server(0, bind="127.0.0.1", block=False,
+                                  secret="sesame")
+        try:
+            addr = f"127.0.0.1:{srv.getsockname()[1]}"
+            with pytest.raises(RuntimeError, match="secret"):
+                fetch_worker_telemetry(addr, timeout=10)
+            snap = fetch_worker_telemetry(addr, secret="sesame",
+                                          timeout=30)
+            assert "samples" in snap
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# tools/metrics_dump.py --url scrapes a live /varz
+# ---------------------------------------------------------------------------
+
+
+@metrics_mark
+class TestMetricsDumpUrl:
+    def _load_tool(self):
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "metrics_dump.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_scrapes_live_varz(self, capsys):
+        import sys
+
+        srv = MetricsServer(port=0, host="127.0.0.1",
+                            registry=_metered_registry(6, [0.05, 0.5]),
+                            health=HealthRegistry(),
+                            flight=FlightRecorder(),
+                            tracer=Tracer(jax_bridge=False)).start()
+        mod = self._load_tool()
+        old_argv = sys.argv
+        try:
+            # host:port shorthand: /varz implied
+            sys.argv = ["metrics_dump.py", "--url",
+                        f"127.0.0.1:{srv.port}"]
+            mod.main()
+        finally:
+            sys.argv = old_argv
+            srv.stop()
+        out = capsys.readouterr().out
+        assert "work_total" in out and "lat_seconds" in out
+        assert "1 snapshot(s)" in out
+
+    def test_path_and_url_mutually_exclusive(self):
+        import sys
+
+        mod = self._load_tool()
+        old_argv = sys.argv
+        sys.argv = ["metrics_dump.py"]
+        try:
+            with pytest.raises(SystemExit):
+                mod.main()
+        finally:
+            sys.argv = old_argv

@@ -1,17 +1,23 @@
-"""Render a JSONL metrics file as a latency/throughput summary table.
+"""Render a JSONL metrics file (or a live /varz endpoint) as a
+latency/throughput summary table.
 
 Reads the output of ``analytics_zoo_tpu.metrics.exporters.write_jsonl``
 (one registry snapshot per line — e.g. what ``bench.py`` appends when
-``ZOO_METRICS_JSONL`` is set) and prints, for the LATEST snapshot:
+``ZOO_METRICS_JSONL`` is set), or scrapes one snapshot from a running
+process's ``/varz`` endpoint (``MetricsServer``, ZOO_METRICS_PORT), and
+prints, for the LATEST snapshot:
 
 - histograms: count, mean, p50/p95/p99 (seconds-named metrics shown in
   ms);
 - counters/gauges: the value, plus the delta and rate against the FIRST
-  snapshot in the file when more than one line is present.
+  snapshot in the file when more than one line is present (file mode
+  only — a single live scrape has no baseline).
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
   python tools/metrics_dump.py METRICS.jsonl --prometheus   # re-render
+  python tools/metrics_dump.py --url http://host:9090/varz
+  python tools/metrics_dump.py --url host:9090   # /varz implied
 """
 
 import argparse
@@ -35,9 +41,39 @@ def load(path):
     return docs
 
 
-def _key(sample):
-    from analytics_zoo_tpu.metrics import sample_key
+def fetch(url):
+    """One live /varz snapshot as a single-doc list (the same downstream
+    shape as a one-line JSONL file).  Accepts ``host:port`` shorthand
+    and a bare server root; ``/varz`` is implied."""
+    import urllib.request
 
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/varz"):
+        url = url.rstrip("/") + "/varz"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.load(r)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SystemExit(f"{url}: scrape failed: {e}")
+    if "samples" not in doc:
+        raise SystemExit(f"{url}: no samples in response — not a "
+                         "MetricsServer /varz endpoint?")
+    return [doc]
+
+
+def _key(sample):
+    try:
+        from analytics_zoo_tpu.metrics import sample_key
+    except ModuleNotFoundError:
+        # standalone invocation (`python tools/metrics_dump.py ...`) puts
+        # tools/ on sys.path, not the repo root: fall back to the same
+        # canonical shape so the tool works without an installed package
+        labels = sample.get("labels")
+        if not labels:
+            return sample["name"]
+        inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        return f"{sample['name']}{{{inner}}}"
     return sample_key(sample)
 
 
@@ -50,7 +86,11 @@ def _scale(name, value):
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("path", help="JSONL metrics file")
+    p.add_argument("path", nargs="?", help="JSONL metrics file")
+    p.add_argument("--url", default=None,
+                   help="scrape a live /varz endpoint instead of "
+                        "reading a file (http://host:port[/varz] or "
+                        "host:port)")
     p.add_argument("--prefix", default="",
                    help="only metrics whose name starts with this")
     p.add_argument("--prometheus", action="store_true",
@@ -59,7 +99,9 @@ def main():
                         "instead of the table")
     a = p.parse_args()
 
-    docs = load(a.path)
+    if bool(a.path) == bool(a.url):
+        p.error("exactly one of PATH or --url is required")
+    docs = fetch(a.url) if a.url else load(a.path)
     first, last = docs[0], docs[-1]
     first_vals = {_key(s): s for s in first.get("samples", [])}
     dt = max(last.get("ts", 0) - first.get("ts", 0), 0.0)
@@ -95,7 +137,8 @@ def main():
             print(f"{row[0]}_count {row[1]}")
         return
 
-    print(f"# {a.path}: {len(docs)} snapshot(s), window {dt:.1f}s")
+    src = a.url if a.url else a.path
+    print(f"# {src}: {len(docs)} snapshot(s), window {dt:.1f}s")
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
