@@ -89,6 +89,15 @@ class ZooConfig:
                                train steps per fit() into this directory
       ZOO_PROFILE_STEPS        steps per captured trace (default 5)
       ZOO_INFEED_DEPTH         host->device feeder queue depth (default 2)
+      ZOO_PREFETCH_WORKERS     > 0: the estimator fit loop wraps the train
+                               set in the parallel host data plane
+                               (FeatureSet.prefetch — feature/prefetch.py)
+                               with this many pool workers; 0 (default)
+                               keeps the serial path.  Delivery is ordered,
+                               so the batch stream is byte-identical
+                               either way.
+      ZOO_PREFETCH_DEPTH       bounded prefetch queue depth when the
+                               data plane is on (default 4)
       ZOO_SHARD_OPTIMIZER      "1": ZeRO-1 — shard optimizer state over
                                the data axis (1/n memory + update compute
                                per chip; params stay replicated)
@@ -114,6 +123,11 @@ class ZooConfig:
     profile_dir: str | None = None
     profile_steps: int | None = None
     infeed_depth: int | None = None
+    # Parallel host data plane (feature/prefetch.py): workers > 0 makes
+    # the estimator prefetch the train set; env ZOO_PREFETCH_WORKERS /
+    # ZOO_PREFETCH_DEPTH.
+    prefetch_workers: int | None = None
+    prefetch_depth: int | None = None
     # ZeRO-1: shard optimizer state (Adam moments) over the data axis via
     # GSPMD sharding constraints — 1/n optimizer memory and update compute
     # per chip; parameters stay replicated.  Env: ZOO_SHARD_OPTIMIZER=1.
@@ -135,6 +149,10 @@ class ZooConfig:
             self.profile_steps, "ZOO_PROFILE_STEPS", 5)
         self.infeed_depth = resolve(
             self.infeed_depth, "ZOO_INFEED_DEPTH", 2)
+        self.prefetch_workers = resolve(
+            self.prefetch_workers, "ZOO_PREFETCH_WORKERS", 0)
+        self.prefetch_depth = resolve(
+            self.prefetch_depth, "ZOO_PREFETCH_DEPTH", 4)
         self.shard_optimizer = bool(resolve(
             self.shard_optimizer, "ZOO_SHARD_OPTIMIZER", False))
         if self.profile_dir is None:

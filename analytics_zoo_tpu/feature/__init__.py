@@ -3,3 +3,7 @@ from analytics_zoo_tpu.feature.common import (  # noqa: F401
     Preprocessing,
 )
 from analytics_zoo_tpu.feature.dataset import FeatureSet  # noqa: F401
+from analytics_zoo_tpu.feature.prefetch import (  # noqa: F401
+    PrefetchFeatureSet,
+    PrefetchPipeline,
+)

@@ -144,6 +144,16 @@ class FeatureSet:
             self.device_transform = lambda b, _p=prev, _f=fn: _f(_p(b))
         return self
 
+    def prefetch(self, depth: int = 4, workers: int = 2) -> "FeatureSet":
+        """Run batch production through the parallel host data plane
+        (feature/prefetch.py): shard loading, decode, host transforms and
+        batch assembly move off the consumer thread onto ``workers`` pool
+        threads behind a ``depth``-bounded queue, with ORDERED delivery —
+        the stream stays byte-identical to the serial path for the same
+        seed/epoch/start_batch."""
+        from analytics_zoo_tpu.feature.prefetch import PrefetchFeatureSet
+        return PrefetchFeatureSet(self, depth=depth, workers=workers)
+
     def batches(self, batch_size: int, shuffle: bool = True,
                 seed: int = 0, epoch: int = 0, drop_last: bool = True,
                 start_batch: int = 0,
@@ -299,6 +309,12 @@ class ShardedFeatureSet(FeatureSet):
         self.sizer = sizer
         self._cache: dict[str, dict] = {}
         self._sizes: list[int] | None = None
+        # shard read-ahead (feature/prefetch.py): when a pool is set,
+        # batches() submits loader(path_{k+1}) while slice k is consumed,
+        # so _load() finds the next slice already (being) materialized
+        # instead of stalling the feeder cold on every slice advance
+        self._ra_pool = None
+        self._ra_futures: dict[str, Any] = {}
 
     @staticmethod
     def _default_loader(path: str) -> dict:
@@ -308,19 +324,33 @@ class ShardedFeatureSet(FeatureSet):
     @staticmethod
     def _npz_first_dim(path: str) -> int:
         """Read the leading dim of ``x`` from the npz member header — no
-        array data is read, so sizing a shard costs ~1 KB of IO."""
+        array data is read, so sizing a shard costs ~1 KB of IO.
+
+        Handles npy header versions (1,0), (2,0) AND (3,0) (numpy emits
+        3.0 for long utf-8 field names); an unparseable header falls back
+        to a full member load rather than raising — sizing must never be
+        the thing that kills an epoch."""
         import zipfile
 
         from numpy.lib import format as npformat
 
-        with zipfile.ZipFile(path) as z:
-            with z.open("x.npy") as f:
-                version = npformat.read_magic(f)
-                if version == (1, 0):
-                    shape, _, _ = npformat.read_array_header_1_0(f)
-                else:
-                    shape, _, _ = npformat.read_array_header_2_0(f)
-                return int(shape[0])
+        try:
+            with zipfile.ZipFile(path) as z:
+                with z.open("x.npy") as f:
+                    version = npformat.read_magic(f)
+                    if version == (1, 0):
+                        shape, _, _ = npformat.read_array_header_1_0(f)
+                    elif version == (2, 0):
+                        shape, _, _ = npformat.read_array_header_2_0(f)
+                    else:
+                        # (3,0) shares the 2.0 layout with a utf-8 header;
+                        # numpy's generic reader knows every version it
+                        # can itself write
+                        shape, _, _ = npformat._read_array_header(
+                            f, version)
+                    return int(shape[0])
+        except Exception:
+            return len(np.load(path, allow_pickle=False)["x"])
 
     def _shard_sizes(self):
         if self._sizes is None:
@@ -335,13 +365,35 @@ class ShardedFeatureSet(FeatureSet):
                                for p in self.paths]
         return self._sizes
 
+    def set_read_ahead(self, pool) -> None:
+        """Enable (an executor) / disable (None) shard read-ahead.
+
+        With a pool set, one not-yet-resident shard may be loading in the
+        background — transiently budget+1 slices of memory.  Managed by
+        :class:`~analytics_zoo_tpu.feature.prefetch.PrefetchFeatureSet`
+        around each iteration; usable standalone with any executor."""
+        self._ra_pool = pool
+        if pool is None:
+            self._ra_futures = {}
+
+    def _read_ahead(self, path):
+        if self._ra_pool is None or path in self._cache \
+                or path in self._ra_futures:
+            return
+        try:
+            self._ra_futures[path] = self._ra_pool.submit(self.loader, path)
+        except RuntimeError:
+            pass  # pool shutting down mid-epoch: fall back to sync loads
+
     def _load(self, path):
         if path not in self._cache:
             # keep at most ceil(len/n_slices) shards resident
             budget = -(-len(self.paths) // self.n_slices)
             while len(self._cache) >= max(budget, 1):
                 self._cache.pop(next(iter(self._cache)))
-            self._cache[path] = self.loader(path)
+            fut = self._ra_futures.pop(path, None)
+            self._cache[path] = (fut.result() if fut is not None
+                                 else self.loader(path))
         return self._cache[path]
 
     @property
@@ -378,7 +430,7 @@ class ShardedFeatureSet(FeatureSet):
         b = 0
         cum = 0
         leftover = None  # None | dict (real rows) | int (virtual row count)
-        for si in shard_order:
+        for j, si in enumerate(shard_order):
             if sizes is not None and cum + sizes[si] <= stream_start:
                 n = sizes[si]
                 if shuffle:
@@ -389,6 +441,12 @@ class ShardedFeatureSet(FeatureSet):
                 leftover = rem if rem else None
                 continue
             data = self._load(self.paths[si])
+            if j + 1 < len(shard_order):
+                # overlap the NEXT slice's load with this slice's
+                # consumption (no-op without a read-ahead pool); every
+                # shard after a loaded one is itself loaded, so the
+                # speculation can never be wasted work
+                self._read_ahead(self.paths[shard_order[j + 1]])
             xs = _as_list(data["x"])
             ys = _as_list(data.get("y"))
             ws = _as_list(data.get("w"))
@@ -446,6 +504,23 @@ class ShardedFeatureSet(FeatureSet):
             yield _slice_batch_rows(leftover, process_shard)
 
 
+def _preprocess_batch(preprocessing: Preprocessing, batch: dict) -> dict:
+    """Apply a per-record transform to one assembled batch.
+
+    Shared by the serial TransformedFeatureSet path and the prefetch
+    pipeline's pooled map stage (feature/prefetch.py) — one
+    implementation is what makes the two streams byte-identical."""
+    xs = batch["x"]
+    single = not isinstance(xs, list)
+    records = xs if single else list(zip(*xs))
+    out = [preprocessing(r) for r in records]
+    batch = dict(batch)
+    batch["x"] = np.stack(out) if single else [
+        np.stack(col) for col in zip(*out)
+    ]
+    return batch
+
+
 class TransformedFeatureSet(FeatureSet):
     """Per-record preprocessing applied at batch-assembly time."""
 
@@ -469,13 +544,4 @@ class TransformedFeatureSet(FeatureSet):
 
     def batches(self, *args, **kwargs):
         for batch in self.base.batches(*args, **kwargs):
-            xs = batch["x"]
-            single = not isinstance(xs, list)
-            records = xs if single else list(zip(*xs))
-            out = [self.preprocessing(r) for r in
-                   (records if not single else records)]
-            batch = dict(batch)
-            batch["x"] = np.stack(out) if single else [
-                np.stack(col) for col in zip(*out)
-            ]
-            yield batch
+            yield _preprocess_batch(self.preprocessing, batch)

@@ -65,6 +65,7 @@ from analytics_zoo_tpu.metrics.registry import (
     set_registry,
 )
 from analytics_zoo_tpu.metrics.runtime import (
+    DataPipelineMetrics,
     ServingMetrics,
     StepMetrics,
     record_device_memory,
@@ -83,7 +84,8 @@ __all__ = [
     "prometheus_text", "snapshot", "sample_key", "JsonlExporter",
     "write_jsonl", "TensorBoardExporter",
     "sanitize_metric_name", "sanitize_label_name",
-    "StepMetrics", "ServingMetrics", "record_device_memory",
+    "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
+    "record_device_memory",
     "MetricsServer", "maybe_start_from_env",
     "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
     "HealthRegistry", "get_health", "set_health",

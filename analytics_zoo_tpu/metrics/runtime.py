@@ -29,7 +29,8 @@ from analytics_zoo_tpu.metrics.registry import (
     get_registry,
 )
 
-__all__ = ["StepMetrics", "ServingMetrics", "record_device_memory"]
+__all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
+           "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -125,6 +126,45 @@ class ServingMetrics:
         self.memory_ratio = reg.gauge(
             "zoo_serving_broker_memory_ratio",
             "broker used/max memory in [0,1]")
+
+
+class DataPipelineMetrics:
+    """Host data-plane telemetry (``zoo_data_prefetch_*``) for the
+    parallel prefetch pipeline (feature/prefetch.py).
+
+    The two histograms are the pipeline's diagnosis pair: a fat
+    ``consumer_wait`` p99 means the pipeline is the bottleneck (raise
+    ``workers``/``depth``); a fat ``producer_stall`` p99 means the
+    CONSUMER (device step) is — the pipeline is keeping up and further
+    workers buy nothing.  Queue occupancy sits between them: pinned at
+    the depth limit is healthy, pinned at zero is starving."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.queue_depth = reg.gauge(
+            "zoo_data_prefetch_queue_depth",
+            "prefetch queue occupancy (batches ready or in flight)")
+        self.depth_limit = reg.gauge(
+            "zoo_data_prefetch_depth",
+            "configured prefetch queue capacity")
+        self.workers = reg.gauge(
+            "zoo_data_prefetch_workers",
+            "configured prefetch worker threads")
+        self.producer_stall = reg.histogram(
+            "zoo_data_prefetch_producer_stall_seconds",
+            "time the producer blocked on a full prefetch queue per batch",
+            buckets=STEP_BUCKETS)
+        self.consumer_wait = reg.histogram(
+            "zoo_data_prefetch_consumer_wait_seconds",
+            "time the consumer blocked waiting for the next prefetched "
+            "batch", buckets=STEP_BUCKETS)
+        self.batches = reg.counter(
+            "zoo_data_prefetch_batches_total",
+            "batches delivered through the prefetch pipeline")
+        self.errors = reg.counter(
+            "zoo_data_prefetch_errors_total",
+            "exceptions propagated through the prefetch pipeline")
 
 
 def record_device_memory(registry: MetricsRegistry | None = None) -> int:

@@ -543,6 +543,20 @@ class Estimator:
         if validation_set is not None and validation_trigger is None:
             validation_trigger = EveryEpoch()
         seed = ctx.seed if seed is None else seed
+        if ctx.config.prefetch_workers:
+            # Parallel host data plane (ZOO_PREFETCH_WORKERS): shard
+            # loading, host transforms and batch assembly move onto pool
+            # threads with ordered delivery, composing with the
+            # double-buffered device infeed below — the feeder consumes
+            # the prefetched stream instead of the serial generator, and
+            # the stream itself is byte-identical (resume included).
+            from analytics_zoo_tpu.feature.prefetch import (
+                PrefetchFeatureSet,
+            )
+            if not isinstance(train_set, PrefetchFeatureSet):
+                train_set = train_set.prefetch(
+                    depth=ctx.config.prefetch_depth,
+                    workers=ctx.config.prefetch_workers)
 
         params, state = self.model.build_params()
         # Keras continuation semantics: a second fit() on the same estimator

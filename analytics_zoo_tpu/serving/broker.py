@@ -48,6 +48,15 @@ class Broker:
     def hset(self, key: str, mapping: dict) -> None:
         raise NotImplementedError
 
+    def hset_many(self, items: list) -> None:
+        """Write many ``(key, mapping)`` hashes in ONE broker round-trip
+        where the transport can (redis pipeline, one lock acquisition);
+        this base fallback loops :meth:`hset` so brokers that only
+        expose hset stay compatible.  The server writes each
+        micro-batch's results through this — never per-record hset."""
+        for key, mapping in items:
+            self.hset(key, mapping)
+
     def hgetall(self, key: str) -> dict:
         raise NotImplementedError
 
@@ -121,6 +130,13 @@ class InMemoryBroker(Broker):
     def hset(self, key, mapping):
         with self._cv:
             self._hashes.setdefault(key, {}).update(mapping)
+            self._cv.notify_all()
+
+    def hset_many(self, items):
+        # one lock acquisition + one wakeup for the whole micro-batch
+        with self._cv:
+            for key, mapping in items:
+                self._hashes.setdefault(key, {}).update(mapping)
             self._cv.notify_all()
 
     def hgetall(self, key):
@@ -328,6 +344,15 @@ class RedisBroker(Broker):
 
     def hset(self, key, mapping):  # pragma: no cover
         self._r.hset(key, mapping=mapping)
+
+    def hset_many(self, items):  # pragma: no cover
+        # MULTI-free pipeline: one network round-trip per micro-batch
+        # (the reference scripts its write-back the same way,
+        # RedisUtils.scala)
+        p = self._r.pipeline(transaction=False)
+        for key, mapping in items:
+            p.hset(key, mapping=mapping)
+        p.execute()
 
     def hgetall(self, key):  # pragma: no cover
         return self._r.hgetall(key)

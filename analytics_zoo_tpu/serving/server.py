@@ -1,19 +1,32 @@
 """Cluster Serving server (reference serving/ClusterServing.scala:44-230 and
 serving/utils/ClusterServingHelper.scala).
 
-The loop: read up to ``batch_size`` records from the input stream, decode,
+The cycle: read up to ``batch_size`` records from the input stream, decode,
 stack into one micro-batch, run the pooled/bucketed InferenceModel (one
 jitted XLA executable per batch bucket — device math stays on TPU), write
 per-uri result hashes back, apply backpressure by trimming the stream when
 the broker is near memory capacity (ClusterServing.scala:126-134).
+
+:meth:`ClusterServing.run` executes that cycle as a THREE-STAGE PIPELINE
+(the default): a broker-reader thread polls + acks + decodes the next
+micro-batch (decode fanned out on a small pool) while the current one is
+in ``model.predict`` on the main loop, and a write-back thread drains a
+bounded result queue — broker I/O and host decode fully overlap device
+inference, the serving-side analogue of the estimator's double-buffered
+infeed.  Result write-back is batched: ONE ``hset_many`` broker
+round-trip per micro-batch instead of one ``hset`` per record.
+``run(pipelined=False)`` keeps the strictly serial
+read→decode→predict→write cycle (:meth:`step`).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -117,27 +130,41 @@ class ClusterServing:
                 [[int(i), float(out[i])] for i in top])}
         return {"uri": uri, "tensor": encode_ndarray(out)}
 
-    def process_batch(self, records) -> int:
-        if not records:
-            return 0
+    def _decode_one(self, rid: str, fields: dict):
+        """One record -> ndarray, or None (logged) when undecodable or
+        mis-shaped.  Pure per-record work — safe to fan out on a pool."""
+        try:
+            arr = decode_ndarray(fields["image"])
+        except Exception:
+            logger.warning("serving: undecodable record %s", rid)
+            return None
+        if self.helper.data_shape and \
+                tuple(arr.shape) != tuple(self.helper.data_shape):
+            logger.warning("serving: shape %s != expected %s (uri=%s)",
+                           arr.shape, self.helper.data_shape,
+                           fields.get("uri"))
+            return None
+        return arr
+
+    def _decode_records(self, records, pool=None):
+        """records -> (uris, arrs), bad records dropped.  With ``pool``
+        the per-record base64+npy decode runs across pool threads (order
+        preserved — Executor.map)."""
+        if pool is not None:
+            decoded = list(pool.map(
+                lambda rf: self._decode_one(rf[0], rf[1]), records))
+        else:
+            decoded = [self._decode_one(rid, f) for rid, f in records]
         uris, arrs = [], []
-        for rid, fields in records:
-            try:
-                arr = decode_ndarray(fields["image"])
-            except Exception:
-                logger.warning("serving: undecodable record %s", rid)
-                continue
-            if self.helper.data_shape and \
-                    tuple(arr.shape) != tuple(self.helper.data_shape):
-                logger.warning("serving: shape %s != expected %s (uri=%s)",
-                               arr.shape, self.helper.data_shape,
-                               fields.get("uri"))
+        for (rid, fields), arr in zip(records, decoded):
+            if arr is None:
                 continue
             uris.append(fields.get("uri", rid))
             arrs.append(arr)
-        if not arrs:
-            return 0
-        t0 = time.perf_counter()
+        return uris, arrs
+
+    @staticmethod
+    def _group_by_shape(uris, arrs) -> dict:
         # group by shape: with no configured data_shape, clients may send
         # mixed sizes; each group becomes one stacked micro-batch
         groups: dict = {}
@@ -145,6 +172,12 @@ class ClusterServing:
             groups.setdefault(arr.shape, ([], []))
             groups[arr.shape][0].append(uri)
             groups[arr.shape][1].append(arr)
+        return groups
+
+    def _predict_groups(self, groups) -> list:
+        """Run predict per shape group; return the [(key, mapping)]
+        write-back list for ONE batched broker round-trip."""
+        writes = []
         for g_uris, g_arrs in groups.values():
             with self.metrics.predict_latency.time(), \
                     span("zoo.serving.predict",
@@ -153,8 +186,21 @@ class ClusterServing:
             if isinstance(preds, list):  # multi-output: report first head
                 preds = preds[0]
             for uri, out in zip(g_uris, np.asarray(preds)):
-                self.db.hset(RESULT_PREFIX + uri,
-                             self._postprocess(uri, out))
+                writes.append((RESULT_PREFIX + uri,
+                               self._postprocess(uri, out)))
+        return writes
+
+    def process_batch(self, records) -> int:
+        if not records:
+            return 0
+        uris, arrs = self._decode_records(records)
+        if not arrs:
+            return 0
+        t0 = time.perf_counter()
+        writes = self._predict_groups(self._group_by_shape(uris, arrs))
+        # one broker round-trip per micro-batch (hset_many pipelines or
+        # falls back per-broker), not one hset per record
+        self.db.hset_many(writes)
         dt = time.perf_counter() - t0
         self.total_count += len(uris)
         self.summary.add_scalar("Throughput", len(uris) / max(dt, 1e-9),
@@ -208,28 +254,36 @@ class ClusterServing:
             # predict + write-back (poll wait excluded — the records
             # arrived by t0).  Queueing delay before the poll shows up in
             # queue_depth, not here.
-            self.metrics.latency.observe(t_end - t0)
-            self.metrics.batch_size.observe(len(records))
-            self.metrics.records.inc(n)
-            # flight ring: non-empty cycles only (the idle poll would
-            # flood the postmortem window with zero-information events)
-            self._flight.record(
-                "step", loop="serving", records=len(records), served=n,
-                latency_s=round(t_end - t0, 6))
-            if self._straggler.observe(t_end - t0):
-                self.metrics.stragglers.inc()
-                self._flight.record(
-                    "straggler", loop="serving",
-                    latency_s=round(t_end - t0, 6),
-                    rolling_p50_s=round(
-                        self._straggler.rolling_p50(), 6))
+            self._record_cycle(len(records), n, t_end - t0)
         return n
 
+    def _record_cycle(self, n_read: int, n_served: int, dt: float):
+        """Per-cycle telemetry shared by the serial step() and the
+        pipelined loop: latency/batch-size/served metrics, the flight
+        ring record (non-empty cycles only — an idle poll would flood
+        the postmortem window), and straggler detection."""
+        self.metrics.latency.observe(dt)
+        self.metrics.batch_size.observe(n_read)
+        self.metrics.records.inc(n_served)
+        self._flight.record(
+            "step", loop="serving", records=n_read, served=n_served,
+            latency_s=round(dt, 6))
+        if self._straggler.observe(dt):
+            self.metrics.stragglers.inc()
+            self._flight.record(
+                "straggler", loop="serving", latency_s=round(dt, 6),
+                rolling_p50_s=round(self._straggler.rolling_p50(), 6))
+
     def run(self, max_records: int | None = None,
-            idle_timeout: float | None = None) -> int:
+            idle_timeout: float | None = None,
+            pipelined: bool = True) -> int:
         """Blocking serve loop.  Stops after ``max_records`` served, after
-        ``idle_timeout`` seconds without input, or on :meth:`stop`."""
-        served = 0
+        ``idle_timeout`` seconds without input, or on :meth:`stop`.
+
+        ``pipelined=True`` (default) runs the three-stage pipeline —
+        broker read + decode, predict, write-back on separate threads so
+        the stages overlap; ``False`` keeps the strictly serial
+        :meth:`step` cycle."""
         # a previous run() on this server closed its summary on exit (e.g.
         # a warm-up pass before start()): open a fresh event file
         if self.summary.closed:
@@ -248,6 +302,17 @@ class ClusterServing:
         # /healthz must not 503 a process that is compiling, only one
         # that stopped cycling.
         health.register("serving_loop", stale_after=120.0)
+        try:
+            if pipelined:
+                return self._run_pipelined(max_records, idle_timeout,
+                                           health)
+            return self._run_serial(max_records, idle_timeout, health)
+        finally:
+            health.unregister("serving_loop")  # stopped on purpose
+            self.summary.close()
+
+    def _run_serial(self, max_records, idle_timeout, health) -> int:
+        served = 0
         last_active = time.monotonic()
         while not self._stop.is_set():
             try:
@@ -265,8 +330,174 @@ class ClusterServing:
             if idle_timeout is not None and \
                     time.monotonic() - last_active > idle_timeout:
                 break
-        health.unregister("serving_loop")  # stopped on purpose
-        self.summary.close()
+        return served
+
+    _PIPE_DEPTH = 2  # decoded micro-batches buffered ahead of predict
+
+    def _run_pipelined(self, max_records, idle_timeout, health) -> int:
+        """Three-stage pipeline: reader(poll+ack+decode) → predict →
+        writer(batched hset_many).  Bounded queues between stages keep
+        memory flat and deliver backpressure; a ``done`` event local to
+        this run lets max_records/idle exits leave the server
+        restartable (self._stop stays the external kill switch)."""
+        in_q: queue.Queue = queue.Queue(maxsize=self._PIPE_DEPTH)
+        out_q: queue.Queue = queue.Queue(maxsize=self._PIPE_DEPTH * 2)
+        done = threading.Event()
+        end = object()  # pipe sentinel
+        decode_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="zoo-serving-decode")
+
+        def stopped():
+            return done.is_set() or self._stop.is_set()
+
+        def bput(q, item) -> bool:
+            while not stopped():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader():
+            health.register("serving_reader", stale_after=120.0)
+            try:
+                while not stopped():
+                    try:
+                        ratio = self.db.memory_ratio()
+                        self.metrics.memory_ratio.set(ratio)
+                        if ratio >= self.INPUT_THRESHOLD:
+                            keep = int(self.db.xlen(INPUT_STREAM)
+                                       * self.CUT_RATIO)
+                            self.db.xtrim(INPUT_STREAM, keep)
+                            self.metrics.trims.inc()
+                        records = self.db.xread(
+                            INPUT_STREAM, self.helper.batch_size,
+                            last_id=self._last_id, block_ms=100)
+                        health.heartbeat("serving_reader")
+                        if not records:
+                            continue
+                        # advance the READ cursor only; the ack happens in
+                        # the writer AFTER the batch's results are flushed,
+                        # so a batch dropped by shutdown mid-pipeline stays
+                        # in the stream (and the cursor rewind below makes
+                        # the next run() re-read it)
+                        self._last_id = records[-1][0]
+                        uris, arrs = self._decode_records(
+                            records, pool=decode_pool)
+                        if self.metrics.enabled:
+                            self.metrics.queue_depth.set(
+                                self.db.xlen(INPUT_STREAM))
+                        if not bput(in_q, (len(records), self._last_id,
+                                           uris, arrs)):
+                            return
+                    except Exception:
+                        # a bad poll/decode must not kill the pipeline
+                        logger.exception(
+                            "serving: reader failed; continuing")
+                        time.sleep(0.05)
+            finally:
+                health.unregister("serving_reader")
+                bput(in_q, end)  # no-op when the main loop already left
+
+        def writer():
+            health.register("serving_writer", stale_after=120.0)
+            try:
+                while True:
+                    try:
+                        item = out_q.get(timeout=0.5)
+                    except queue.Empty:
+                        # an idle server is healthy — /healthz must not
+                        # 503 a pipeline that simply has no traffic
+                        health.heartbeat("serving_writer")
+                        continue
+                    if item is end:
+                        return
+                    writes, upto_id = item
+                    try:
+                        if writes:
+                            self.db.hset_many(writes)
+                        # results durable (or judged unservable): NOW the
+                        # records may leave the stream
+                        self.db.ack(INPUT_STREAM, upto_id)
+                    except Exception:
+                        logger.exception(
+                            "serving: write-back failed; continuing")
+                    health.heartbeat("serving_writer")
+            finally:
+                health.unregister("serving_writer")
+
+        rt = threading.Thread(target=reader, daemon=True,
+                              name="zoo-serving-reader")
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="zoo-serving-writer")
+        rt.start()
+        wt.start()
+        served = 0
+        # the last stream id whose batch was handed to the writer: the
+        # exit cursor.  Anything the reader read beyond it was neither
+        # predicted nor acked, so rewinding self._last_id here makes the
+        # next run() serve it instead of skipping it.
+        processed_id = self._last_id
+        last_active = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = in_q.get(timeout=0.1)
+                except queue.Empty:
+                    health.heartbeat("serving_loop")
+                    if idle_timeout is not None and \
+                            time.monotonic() - last_active > idle_timeout:
+                        break
+                    continue
+                if item is end:
+                    break
+                n_read, batch_last_id, uris, arrs = item
+                t0 = time.perf_counter()
+                n = 0
+                writes = []
+                try:
+                    if arrs:
+                        with span("zoo.serving.step"):
+                            writes = self._predict_groups(
+                                self._group_by_shape(uris, arrs))
+                        n = len(uris)
+                except Exception as e:
+                    self._flight.record_exception(e, where="serving.step")
+                    logger.exception("serving: batch failed; continuing")
+                    writes = []  # failed batch: ack it (serial parity)
+                # always hand the batch to the writer — even an all-bad or
+                # failed batch must be acked once its fate is sealed
+                if not bput(out_q, (writes, batch_last_id)):
+                    break
+                processed_id = batch_last_id
+                t_end = time.perf_counter()
+                health.heartbeat("serving_loop")
+                if n:
+                    served += n
+                    self.total_count += n
+                    last_active = time.monotonic()
+                    # latency here is the predict stage alone: decode and
+                    # write-back run on their own threads, overlapped —
+                    # that overlap is the point of the pipeline
+                    self.summary.add_scalar(
+                        "Throughput", n / max(t_end - t0, 1e-9),
+                        self.total_count)
+                    self._record_cycle(n_read, n, t_end - t0)
+                if max_records is not None and served >= max_records:
+                    break
+        finally:
+            done.set()
+            rt.join(timeout=5.0)
+            # the sentinel lands AFTER every enqueued write (FIFO), so
+            # the writer flushes (and acks) all handed-off batches first
+            try:
+                out_q.put(end, timeout=5.0)
+            except queue.Full:
+                pass
+            wt.join(timeout=5.0)
+            decode_pool.shutdown(wait=False)
+            self._last_id = processed_id
         return served
 
     def start(self, **kwargs) -> "ClusterServing":

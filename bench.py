@@ -58,6 +58,141 @@ TPU_PEAK_FLOPS = (
 PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
 
 
+# ---------------------------------------------------------------------------
+# --data-pipeline: host data-plane bench (feature/prefetch.py).  No jax —
+# it measures the HOST side: serial FeatureSet.batches() vs the parallel
+# prefetch pipeline on a synthetic loader/transform whose cost is pure
+# sleep (IO-shaped: releases the GIL, like real file reads and cv2).
+# Emits BENCH_DATA_*.json so the gain is pinned, not asserted.
+# ---------------------------------------------------------------------------
+
+def _sleepy_loader(load_sleep_s: float, shard_records: int, feat: int = 16):
+    import numpy as np
+
+    def load(path: str) -> dict:
+        i = int(path.rsplit("-", 1)[-1])
+        time.sleep(load_sleep_s)
+        rng = np.random.default_rng(1234 + i)
+        return {
+            "x": rng.standard_normal((shard_records, feat))
+                    .astype("float32"),
+            "y": rng.integers(0, 10, size=(shard_records,))
+                    .astype("int32"),
+        }
+
+    return load
+
+
+def data_pipeline_bench(workers: int = 4, depth: int = 8,
+                        n_shards: int = 6, shard_records: int = 64,
+                        batch_size: int = 16,
+                        load_sleep_ms: float = 40.0,
+                        transform_sleep_ms: float = 2.0,
+                        seed: int = 7, out_path: str | None = None) -> dict:
+    """Serial vs prefetched host-pipeline throughput + wait breakdown.
+
+    The synthetic loader sleeps per shard (disk/decode IO) and the
+    per-record transform sleeps per record (host preprocessing), so the
+    measured speedup isolates the pipeline machinery from numpy noise.
+    Also verifies the determinism contract: the prefetched stream must be
+    byte-identical to the serial one for the same seed/epoch.
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu.feature.common import FnPreprocessing
+    from analytics_zoo_tpu.feature.dataset import ShardedFeatureSet
+    from analytics_zoo_tpu.feature.prefetch import PrefetchFeatureSet
+    from analytics_zoo_tpu.metrics import (
+        DataPipelineMetrics,
+        MetricsRegistry,
+        snapshot,
+    )
+
+    t_sleep = transform_sleep_ms / 1e3
+    paths = [f"synth://shard-{i}" for i in range(n_shards)]
+    base = ShardedFeatureSet(
+        paths, n_slices=n_shards,
+        loader=_sleepy_loader(load_sleep_ms / 1e3, shard_records),
+        sizer=lambda p: shard_records)
+
+    def slow_identity(record):
+        time.sleep(t_sleep)
+        return record
+
+    fs = base.transform(FnPreprocessing(slow_identity))
+
+    def drain(feature_set):
+        """Iterate one epoch; returns (batches, wall_s, waits list)."""
+        out, waits = [], []
+        it = feature_set.batches(batch_size, shuffle=True, seed=seed,
+                                 epoch=0)
+        t_start = time.perf_counter()
+        while True:
+            t0 = time.perf_counter()
+            batch = next(it, None)
+            if batch is None:
+                break
+            waits.append(time.perf_counter() - t0)
+            out.append(batch)
+        return out, time.perf_counter() - t_start, waits
+
+    def pcts(waits):
+        return {"p50": round(float(np.percentile(waits, 50)), 6),
+                "p99": round(float(np.percentile(waits, 99)), 6)}
+
+    serial_batches, serial_s, serial_waits = drain(fs)
+    # fresh registry so the artifact's zoo_data_prefetch_* series cover
+    # exactly this run (the process-global one may hold training noise)
+    reg = MetricsRegistry(enabled=True)
+    pre = PrefetchFeatureSet(fs, depth=depth, workers=workers,
+                             metrics=DataPipelineMetrics(registry=reg))
+    pre_batches, pre_s, pre_waits = drain(pre)
+
+    def batch_equal(a, b):
+        if set(a) != set(b):
+            return False
+        return all(np.array_equal(a[k], b[k]) for k in a)
+
+    deterministic = len(serial_batches) == len(pre_batches) and all(
+        batch_equal(a, b) for a, b in zip(serial_batches, pre_batches))
+
+    n_batches = len(serial_batches)
+    prefetch_series = {}
+    for s in snapshot(reg)["samples"]:
+        if s["name"].startswith("zoo_data_prefetch") \
+                and s.get("kind") == "histogram":
+            prefetch_series[s["name"]] = {
+                k: round(float(s[k]), 6)
+                for k in ("count", "p50", "p99") if k in s}
+    doc = {
+        "metric": "data_pipeline_host_throughput",
+        "unit": "batches/sec",
+        "serial_batches_per_sec": round(n_batches / max(serial_s, 1e-9), 2),
+        "prefetched_batches_per_sec": round(
+            n_batches / max(pre_s, 1e-9), 2),
+        "speedup": round(serial_s / max(pre_s, 1e-9), 3),
+        "deterministic": bool(deterministic),
+        "batches": n_batches,
+        "workers": workers, "depth": depth, "batch_size": batch_size,
+        "n_shards": n_shards, "shard_records": shard_records,
+        "load_sleep_ms": load_sleep_ms,
+        "transform_sleep_ms": transform_sleep_ms,
+        # the fit-loop data_wait analogue: time the consumer blocked per
+        # next() — what zoo_train_data_wait_seconds would see
+        "consumer_wait_s": {"serial": pcts(serial_waits),
+                            "prefetched": pcts(pre_waits)},
+        "prefetch_metrics": prefetch_series,
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DATA_r06.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
 def probe_backend(timeout: float, env: dict | None = None) \
         -> tuple[bool, str]:
     """Try `jax.devices()` in a subprocess with a hard timeout.
@@ -281,5 +416,20 @@ def main():
     print(json.dumps(out))
 
 
+def _data_pipeline_main(argv):
+    kwargs = {}
+    if "--quick" in argv:
+        # CPU-sized quick-tier configuration (also exercised by
+        # tests/test_prefetch.py so pipeline regressions fail loudly)
+        kwargs = dict(n_shards=4, shard_records=32, batch_size=8,
+                      load_sleep_ms=15.0, transform_sleep_ms=1.0)
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(data_pipeline_bench(**kwargs)))
+
+
 if __name__ == "__main__":
-    main()
+    if "--data-pipeline" in sys.argv:
+        _data_pipeline_main(sys.argv[1:])
+    else:
+        main()

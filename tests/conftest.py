@@ -37,6 +37,7 @@ QUICK_FILES = {
     "test_layer_oracle_enforcement.py", "test_api_docs.py",
     "test_textset.py", "test_image3d.py", "test_transfer_learning.py",
     "test_layer_serialization.py", "test_metrics.py",
+    "test_prefetch.py",  # host data plane + --data-pipeline bench guard
     "test_telemetry.py",  # ~9s incl. two actor spawns
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier

@@ -212,3 +212,186 @@ def test_dequeue_keys_on_original_uri_with_slashes(tmp_path):
     serving.run(max_records=2)
     got = outq.dequeue()
     assert sorted(got) == sorted(uris)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined serving (three-stage read/decode -> predict -> write-back)
+# ---------------------------------------------------------------------------
+
+
+class _SlowBroker(InMemoryBroker):
+    """xread and hset_many each cost a fixed sleep — the 'stage time'
+    knobs for the overlap test — and every write path is counted."""
+
+    def __init__(self, read_s=0.0, write_s=0.0):
+        super().__init__()
+        self.read_s, self.write_s = read_s, write_s
+        self.hset_calls = 0
+        self.hset_many_calls = 0
+
+    def xread(self, stream, count, last_id="0", block_ms=0):
+        recs = super().xread(stream, count, last_id=last_id, block_ms=0)
+        if recs:
+            time.sleep(self.read_s)
+        return recs
+
+    def hset(self, key, mapping):
+        self.hset_calls += 1
+        super().hset(key, mapping)
+
+    def hset_many(self, items):
+        self.hset_many_calls += 1
+        time.sleep(self.write_s)
+        with self._cv:
+            for key, mapping in items:
+                self._hashes.setdefault(key, {}).update(mapping)
+            self._cv.notify_all()
+
+
+class _SlowModel:
+    def __init__(self, predict_s):
+        self.predict_s = predict_s
+
+    def predict(self, arr):
+        time.sleep(self.predict_s)
+        return np.tile(np.arange(5, dtype=np.float32), (arr.shape[0], 1))
+
+
+def test_pipelined_stages_overlap(tmp_path):
+    """Acceptance: a full cycle (read+decode+predict+write) completes in
+    < 0.8x the sum of its serialized stage times — broker I/O and decode
+    overlap device inference."""
+    stage_s, batch, n_batches = 0.05, 4, 6
+    broker = _SlowBroker(read_s=stage_s, write_s=stage_s)
+    inq = InputQueue(broker=broker)
+    for i in range(n_batches * batch):
+        inq.enqueue(f"u{i}", np.full((3,), i, np.float32))
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=None, batch_size=batch,
+                             log_dir=str(tmp_path / "logs")),
+        model=_SlowModel(stage_s), broker=broker)
+    t0 = time.perf_counter()
+    served = serving.run(max_records=n_batches * batch, idle_timeout=10.0)
+    wall = time.perf_counter() - t0
+    serialized = n_batches * 3 * stage_s
+    assert served == n_batches * batch
+    assert wall < 0.8 * serialized, (wall, serialized)
+    # every result flushed before run() returned, one broker write per
+    # micro-batch, zero per-record writes
+    assert len(OutputQueue(broker=broker).dequeue()) == n_batches * batch
+    assert broker.hset_many_calls == n_batches
+    assert broker.hset_calls == 0
+
+
+def test_writeback_batched_per_microbatch(tmp_path):
+    """Satellite: process_batch (the serial cycle) also writes each
+    micro-batch with ONE hset_many round-trip, not per-record hset."""
+    broker = _SlowBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=8,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(8):
+        inq.enqueue_image(f"u{i}", np.zeros((4, 4, 1), np.float32))
+    n = serving.step()
+    assert n == 8
+    assert broker.hset_many_calls == 1
+    assert broker.hset_calls == 0
+
+
+def test_hset_many_falls_back_to_hset():
+    """A broker that only implements hset still works: the Broker base
+    hset_many loops it."""
+
+    class HsetOnlyBroker(InMemoryBroker):
+        hset_many = __import__(
+            "analytics_zoo_tpu.serving.broker", fromlist=["Broker"]
+        ).Broker.hset_many
+
+    broker = HsetOnlyBroker()
+    broker.hset_many([("result:a", {"v": "1"}), ("result:b", {"v": "2"})])
+    assert broker.hgetall("result:a") == {"v": "1"}
+    assert broker.hgetall("result:b") == {"v": "2"}
+
+
+def test_serial_mode_still_available(tmp_path):
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(4):
+        inq.enqueue_image(f"u{i}", np.zeros((4, 4, 1), np.float32))
+    served = serving.run(max_records=4, pipelined=False)
+    assert served == 4
+    assert len(OutputQueue(broker=broker).dequeue()) == 4
+
+
+def test_pipelined_restartable_after_max_records(tmp_path):
+    """max_records/idle exits must leave the server restartable: the
+    done-event is local to each run, self._stop only trips on stop()."""
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(4):
+        inq.enqueue_image(f"a{i}", np.zeros((4, 4, 1), np.float32))
+    assert serving.run(max_records=4) == 4
+    for i in range(4):
+        inq.enqueue_image(f"b{i}", np.zeros((4, 4, 1), np.float32))
+    assert serving.run(max_records=4) == 4
+    assert len(OutputQueue(broker=broker).dequeue()) == 8
+
+
+def test_pipelined_does_not_lose_read_ahead_batches(tmp_path):
+    """Records the reader decoded but the loop never predicted must NOT
+    be lost on exit: acks happen in the writer after results flush, and
+    the read cursor rewinds to the last processed batch."""
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker)
+    inq = InputQueue(broker=broker)
+    for i in range(12):  # 3 batches available, run stops after 1
+        inq.enqueue_image(f"u{i}", np.zeros((4, 4, 1), np.float32))
+    assert serving.run(max_records=4) == 4
+    # the 8 unserved records are still in the stream, and a second run
+    # serves exactly them
+    assert serving.run(max_records=8) == 8
+    assert len(OutputQueue(broker=broker).dequeue()) == 12
+    assert broker.xlen("image_stream") == 0  # everything acked in the end
+
+
+def test_pipelined_idle_writer_stays_healthy(tmp_path):
+    """An idle pipelined server must keep /healthz green: reader, loop
+    AND writer all beat while there is no traffic."""
+    from analytics_zoo_tpu.metrics import get_health
+
+    broker = InMemoryBroker()
+    model_path = _tiny_classifier(tmp_path)
+    serving = ClusterServing(
+        ClusterServingHelper(model_path=model_path, batch_size=4,
+                             data_shape=(4, 4, 1),
+                             log_dir=str(tmp_path / "logs")),
+        broker=broker).start(idle_timeout=30.0)
+    try:
+        time.sleep(1.5)  # idle, past the writer's 0.5s poll interval
+        comps = get_health().status()["components"]
+        for name in ("serving_loop", "serving_reader", "serving_writer"):
+            assert name in comps, comps
+            assert comps[name]["age_seconds"] < 1.0, (name, comps[name])
+    finally:
+        serving.stop()

@@ -127,3 +127,58 @@ class TestImageNetTFRecordFeatureSet:
             paths, imagenet_example_parser(image_size=32, label_offset=-1))
         assert fs.num_samples == 12
         assert calls == []  # sizing decoded nothing
+
+
+class TestBufferedReader:
+    """read_tfrecord_file walks the framing from chunked buffered reads —
+    not four tiny f.read syscalls per record."""
+
+    def _write(self, tmp_path, n=40):
+        path = str(tmp_path / "buf.tfrecord")
+        examples = [encode_example({"label": [i], "vec": [float(i)] * 7})
+                    for i in range(n)]
+        write_tfrecord_file(path, examples)
+        return path, examples
+
+    def test_tiny_chunks_cross_every_boundary(self, tmp_path):
+        """chunk_size smaller than any frame forces refills inside
+        headers, payloads and CRCs — records must still come out exact."""
+        path, examples = self._write(tmp_path)
+        for chunk in (5, 13, 64):
+            got = list(read_tfrecord_file(path, verify_crc=True,
+                                          chunk_size=chunk))
+            assert got == examples
+
+    def test_read_call_count_is_chunked(self, tmp_path, monkeypatch):
+        path, examples = self._write(tmp_path, n=100)
+
+        calls = []
+        import builtins
+        real_open = builtins.open
+
+        def counting_open(file, *a, **kw):
+            f = real_open(file, *a, **kw)
+            if file == path:
+                real_read = f.read
+                f.read = lambda *ra: (calls.append(1), real_read(*ra))[1]
+            return f
+
+        monkeypatch.setattr(builtins, "open", counting_open)
+        got = list(read_tfrecord_file(path))
+        assert len(got) == 100
+        # old walk: 4 reads/record = 400; buffered: whole file in a few
+        assert len(calls) <= 4, len(calls)
+
+    def test_truncated_tail_raises_under_verify(self, tmp_path):
+        """verify_crc callers must not get a silently shortened stream;
+        the lenient path drops the partial record, matching the old
+        framing walk."""
+        path, examples = self._write(tmp_path, n=10)
+        with open(path, "rb") as f:
+            blob = f.read()
+        cut = str(tmp_path / "cut.tfrecord")
+        with open(cut, "wb") as f:
+            f.write(blob[:-9])  # slice off most of the last record
+        with pytest.raises(ValueError, match="truncated"):
+            list(read_tfrecord_file(cut, verify_crc=True))
+        assert list(read_tfrecord_file(cut)) == examples[:-1]
