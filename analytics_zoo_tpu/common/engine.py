@@ -98,6 +98,20 @@ class ZooConfig:
                                either way.
       ZOO_PREFETCH_DEPTH       bounded prefetch queue depth when the
                                data plane is on (default 4)
+      ZOO_STEPS_PER_DISPATCH   K > 1: the estimator fuses K train steps
+                               into ONE jitted dispatch (jax.lax.scan
+                               over a K-stacked super-batch) — amortizes
+                               the Python→device round-trip when the
+                               harness is dispatch-bound.  Loss
+                               trajectory is bit-identical to K=1;
+                               checkpoints/validation/TB move to K-step
+                               boundaries (docs/performance.md).
+                               Default 1 (off).
+      ZOO_COMPILE_CACHE        persistent XLA compilation cache dir
+                               (common/compile_cache.py): a second
+                               process start / warmup() of the same
+                               program skips XLA — cold-vs-warm shows in
+                               zoo_compile_* metrics
       ZOO_SHARD_OPTIMIZER      "1": ZeRO-1 — shard optimizer state over
                                the data axis (1/n memory + update compute
                                per chip; params stay replicated)
@@ -128,6 +142,13 @@ class ZooConfig:
     # ZOO_PREFETCH_DEPTH.
     prefetch_workers: int | None = None
     prefetch_depth: int | None = None
+    # Fused multi-step dispatch: K > 1 runs K train steps inside one
+    # jitted lax.scan per host round-trip (bit-identical trajectory;
+    # K-boundary callbacks).  Env: ZOO_STEPS_PER_DISPATCH.
+    steps_per_dispatch: int | None = None
+    # Persistent XLA compile cache dir (common/compile_cache.py).
+    # Env: ZOO_COMPILE_CACHE.
+    compile_cache: str | None = None
     # ZeRO-1: shard optimizer state (Adam moments) over the data axis via
     # GSPMD sharding constraints — 1/n optimizer memory and update compute
     # per chip; parameters stay replicated.  Env: ZOO_SHARD_OPTIMIZER=1.
@@ -153,10 +174,18 @@ class ZooConfig:
             self.prefetch_workers, "ZOO_PREFETCH_WORKERS", 0)
         self.prefetch_depth = resolve(
             self.prefetch_depth, "ZOO_PREFETCH_DEPTH", 4)
+        self.steps_per_dispatch = resolve(
+            self.steps_per_dispatch, "ZOO_STEPS_PER_DISPATCH", 1)
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, "
+                f"got {self.steps_per_dispatch}")
         self.shard_optimizer = bool(resolve(
             self.shard_optimizer, "ZOO_SHARD_OPTIMIZER", False))
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
+        if self.compile_cache is None:
+            self.compile_cache = env.get("ZOO_COMPILE_CACHE") or None
 
 
 @dataclasses.dataclass
@@ -217,17 +246,45 @@ class ZooContext:
         the per-partition locality the reference gets from RDD partitioning
         (FeatureSet.scala:240-289); host 0's data never crosses hosts.
         """
+        # batch_sharding(0) is replicated, so scalars (n_valid, seeds —
+        # same value on every process) and batch arrays go through the
+        # same call.
+        return self._put_tree(tree, self.batch_sharding)
+
+    def shard_batch_stacked(self, tree):
+        """Device-put a K-STACKED super-batch (leading axis = inner step
+        index, axis 1 = batch) for the fused multi-step dispatch
+        (``ZOO_STEPS_PER_DISPATCH``, Estimator scan-K path).
+
+        Axis 1 is sharded over the data axis — each chip holds the SAME
+        rows of every inner batch it would hold under K=1, so the fused
+        ``lax.scan`` sees per-step shards identical to K single
+        dispatches.  Rank-<2 leaves (stacked per-step scalars like
+        ``n_valid`` → shape [K]) are replicated.
+        """
+        def sharding_of(ndim: int) -> NamedSharding:
+            if ndim < 2:
+                return self.replicated()
+            return NamedSharding(
+                self.mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+
+        return self._put_tree(tree, sharding_of)
+
+    def _put_tree(self, tree, sharding_of):
+        """Shared device-put scaffolding for the batch shard paths:
+        single-process does a sharded ``device_put`` per leaf;
+        multi-process assembles the global array from this host's rows
+        via ``jax.make_array_from_process_local_data``.  ``sharding_of``
+        maps leaf ndim -> NamedSharding."""
         if jax.process_count() > 1:
             def put(x):
-                # batch_sharding(0) is replicated, so scalars (n_valid,
-                # seeds — same value on every process) and batch arrays go
-                # through the same call.
                 x = np.asarray(x)
                 return jax.make_array_from_process_local_data(
-                    self.batch_sharding(np.ndim(x)), x)
+                    sharding_of(np.ndim(x)), x)
             return jax.tree_util.tree_map(put, tree)
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(np.asarray(x), self.batch_sharding(np.ndim(x))),
+            lambda x: jax.device_put(
+                np.asarray(x), sharding_of(np.ndim(x))),
             tree,
         )
 

@@ -49,15 +49,36 @@ class EveryEpoch(ZooTrigger):
 
 
 class SeveralIteration(ZooTrigger):
-    """Fires every ``interval`` optimizer steps (ZooTrigger.scala:69-80)."""
+    """Fires every ``interval`` optimizer steps (ZooTrigger.scala:69-80).
+
+    Boundary-crossing semantics: fires when a multiple of ``interval``
+    lies in ``(previous observed iteration, current iteration]``.  For
+    the classic one-step-at-a-time loop this is exactly the historical
+    ``iteration % interval == 0``; under the fused multi-step dispatch
+    (``ZOO_STEPS_PER_DISPATCH=K``), where the loop observes iterations
+    in strides of K, it keeps the configured cadence (fires at the first
+    boundary past each multiple) instead of collapsing to
+    ``lcm(K, interval)``.  Re-observing the same iteration (the
+    epoch-boundary callback) behaves as before.
+    """
 
     def __init__(self, interval: int):
         if interval <= 0:
             raise ValueError("interval must be positive")
         self.interval = int(interval)
+        self._prev: int | None = None
 
     def __call__(self, state: TrainingState) -> bool:
-        return state.iteration > 0 and state.iteration % self.interval == 0
+        it, n = state.iteration, self.interval
+        if it <= 0:
+            return False
+        prev, self._prev = self._prev, it
+        if prev is None or it <= prev:
+            # first observation (incl. a resume mid-run: no catch-up
+            # firing for multiples crossed before this trigger existed)
+            # or a same-iteration re-call — historical exact-hit rule
+            return it % n == 0
+        return (it // n) > (prev // n)
 
 
 class MaxEpoch(ZooTrigger):

@@ -76,11 +76,17 @@ class StepMetrics:
         self.epoch = reg.gauge("zoo_train_epoch", "current epoch")
 
     def record_step(self, data_wait_s: float, dispatch_s: float,
-                    step_s: float, batch_size: int):
+                    step_s: float, batch_size: int, steps: int = 1):
+        """One loop iteration = one DISPATCH.  Under the fused multi-step
+        path (``ZOO_STEPS_PER_DISPATCH=K``) a dispatch advances ``steps``
+        optimizer steps and consumes ``batch_size`` records total, so the
+        steps/records counters keep their K=1 meaning while the three
+        histograms measure per-dispatch host cost (the quantity fusion
+        amortizes)."""
         self.data_wait.observe(data_wait_s)
         self.dispatch.observe(dispatch_s)
         self.step.observe(step_s)
-        self.steps.inc()
+        self.steps.inc(steps)
         self.records.inc(batch_size)
 
     def record_epoch(self, epoch: int, throughput: float):

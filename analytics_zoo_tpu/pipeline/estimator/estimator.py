@@ -123,6 +123,24 @@ def _clip_grads(grads, grad_clip):
     return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
 
+def _chunk_batches(batch_iter, k: int):
+    """Group a batch stream into K-sized chunks for the fused dispatch.
+
+    Yields ``("scan", [b_0..b_{k-1}])`` for every full chunk and
+    ``("single", b)`` per leftover batch — the partial tail of an epoch
+    (or of a mid-epoch resume window) degrades to the K=1 step, so the
+    optimizer sees exactly the same batch sequence as an unfused run.
+    """
+    chunk = []
+    for b in batch_iter:
+        chunk.append(b)
+        if len(chunk) == k:
+            yield ("scan", chunk)
+            chunk = []
+    for b in chunk:
+        yield ("single", b)
+
+
 class _DeviceFeeder:
     """Double-buffered host→device infeed.
 
@@ -132,6 +150,12 @@ class _DeviceFeeder:
     the reference's per-partition RDD iterators keeping executors fed
     (FeatureSet.scala:240-289), minus the Spark scheduling gap between
     iterations.
+
+    Under ``ZOO_STEPS_PER_DISPATCH > 1`` the estimator hands it the
+    ``_chunk_batches`` stream and a shard_fn that STACKS each full chunk
+    into a [K, batch, ...] super-batch (``ZooContext.shard_batch_stacked``)
+    — the queue then double-buffers super-batches, composing unchanged
+    with ``ZOO_INFEED_DEPTH`` and the PR-4 prefetch plane upstream.
     """
 
     _END = object()
@@ -361,12 +385,20 @@ class Estimator:
         # training state
         self.global_step = 0
         self.epoch = 1
-        self._train_step_fn = None
+        # compiled-step cache, keyed (device_transform, steps_per_dispatch)
+        # — fit() and measure_pure_step() share it, so alternating probes
+        # and training legs never thrash each other's jit cache
+        self._train_step_fns: dict[tuple, Any] = {}
         self._eval_step_fn = None
         self._loss_buffer: list[tuple[int, Any]] = []
         self._opt_state = None  # persists across fit() calls
         self._profiled = False  # one jax.profiler capture per estimator
         self.history: list[dict] = []
+        # measure_pure_step probe bookkeeping: per-signature first-call
+        # warmup time (compile included), so repeated probes report
+        # steady state and the compile cost separately
+        self._pure_step_warm: dict[tuple, float] = {}
+        self.last_probe_warmup_seconds: float | None = None
 
     # ------------------------------------------------------------------
     # ZeRO-1 optimizer-state sharding (ZOO_SHARD_OPTIMIZER)
@@ -397,7 +429,48 @@ class Estimator:
     # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
-    def _build_train_step(self, device_transform=None):
+    def _train_step_for(self, device_transform=None,
+                        steps_per_dispatch: int = 1):
+        """The (cached) jitted train step for this transform/K pair.
+
+        Returning the SAME function object across calls is what makes
+        jax's dispatch cache effective: a fresh ``jax.jit`` closure per
+        call would retrace and recompile an identical program.  Bounded:
+        callers that build a fresh transform closure per fit() would
+        otherwise pin one compiled program per call forever — oldest
+        entries are evicted past 8 (in-flight fns stay alive through the
+        caller's local reference)."""
+        key = (device_transform, int(steps_per_dispatch))
+        fn = self._train_step_fns.get(key)
+        if fn is None:
+            fn = self._build_train_step(device_transform,
+                                        steps_per_dispatch=key[1])
+            while len(self._train_step_fns) >= 8:
+                old = next(iter(self._train_step_fns))
+                self._train_step_fns.pop(old)
+                if old[1] == 1:
+                    # the probe's warmth bookkeeping rode on this entry:
+                    # a future measure_pure_step re-pays compile, so it
+                    # must re-report warmup instead of claiming 0.0
+                    self._pure_step_warm = {
+                        s: v for s, v in self._pure_step_warm.items()
+                        if s[0] is not old[0]}
+            self._train_step_fns[key] = fn
+        return fn
+
+    def _build_train_step(self, device_transform=None,
+                          steps_per_dispatch: int = 1):
+        """Build the jitted train step.
+
+        ``steps_per_dispatch=1``: the classic single-step program.
+        ``steps_per_dispatch=K>1``: the FUSED program — one donated-carry
+        jit whose body is ``jax.lax.scan`` over K inner steps of the
+        SAME per-step math (shared ``one_step`` closure), consuming a
+        [K, batch, ...] super-batch.  Each inner step folds the RNG on
+        the GLOBAL step index (``step0 + i``), so the loss trajectory is
+        bit-identical to K single dispatches; only the Python→device
+        round-trip count changes (1 instead of K).
+        """
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
         compute_dtype = self.ctx.compute_dtype
@@ -416,10 +489,7 @@ class Estimator:
         opt_shardings = (self._opt_sharding_of
                          if self._shard_optimizer_on() else None)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, opt_state, state, seed, step, batch):
-            # RNG derived in-graph: no per-step host-side key splitting.
-            rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        def one_step(params, opt_state, state, rng, batch):
             if device_transform is not None:
                 # On-device preprocessing (uint8 decode/normalize/augment):
                 # fuses into the step, so the host link ships compact dtypes.
@@ -474,7 +544,39 @@ class Estimator:
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, l
 
-        return train_step
+        if steps_per_dispatch <= 1:
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def train_step(params, opt_state, state, seed, step, batch):
+                # RNG derived in-graph: no per-step host-side key
+                # splitting.
+                rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+                return one_step(params, opt_state, state, rng, batch)
+
+            return train_step
+
+        k = int(steps_per_dispatch)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step_scan(params, opt_state, state, seed, step0,
+                            stacked):
+            key = jax.random.PRNGKey(seed)
+
+            def body(carry, xs):
+                p, o, s = carry
+                batch_i, i = xs
+                # GLOBAL step index: inner step i of this dispatch is
+                # global step step0 + i, so the per-step RNG (dropout,
+                # augmentation) matches the K=1 run exactly.
+                rng = jax.random.fold_in(key, step0 + i)
+                p, o, s, l = one_step(p, o, s, rng, batch_i)
+                return (p, o, s), l
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (stacked, jnp.arange(k, dtype=jnp.int32)))
+            return params, opt_state, state, losses
+
+        return train_step_scan
 
     def _build_eval_step(self, device_transform=None):
         model, loss_fn, metrics = self.model, self.loss, self.metrics
@@ -568,9 +670,19 @@ class Estimator:
         params, state = jax.device_put((params, state), repl)
         opt_state = self._place_opt_state(opt_state)
         dev_tf = getattr(train_set, "device_transform", None)
-        if self._train_step_fn is None or self._train_step_fn[0] is not dev_tf:
-            self._train_step_fn = (dev_tf, self._build_train_step(dev_tf))
-        step_fn = self._train_step_fn[1]
+        # Fused multi-step dispatch (ZOO_STEPS_PER_DISPATCH): K>1 runs K
+        # inner steps per jitted dispatch; the K=1 step is always built
+        # too — it serves partial tail chunks.  (K >= 1 is enforced by
+        # ZooConfig.__post_init__ — no silent clamping here.)
+        k = int(ctx.config.steps_per_dispatch or 1)
+        step_fn = self._train_step_for(dev_tf, 1)
+        fused_fn = self._train_step_for(dev_tf, k) if k > 1 else None
+        # Persistent compile plane (ZOO_COMPILE_CACHE): enable before the
+        # first trace so this fit's compiles populate / hit the cache.
+        from analytics_zoo_tpu.common.compile_cache import (
+            maybe_enable_persistent_cache,
+        )
+        maybe_enable_persistent_cache(ctx.config.compile_cache)
 
         start_epoch, start_batch = self.epoch, 0
         # resume from checkpoint if present (Topology.scala:1220-1242)
@@ -597,8 +709,8 @@ class Estimator:
         while True:
             try:
                 params, opt_state, state = self._train_loop(
-                    params, opt_state, state, step_fn, train_set,
-                    batch_size, seed, start_epoch, start_batch,
+                    params, opt_state, state, step_fn, fused_fn, k,
+                    train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger,
                     validation_set, validation_trigger,
                 )
@@ -650,12 +762,14 @@ class Estimator:
             self._ckpt._wait()
         return self
 
-    def _train_loop(self, params, opt_state, state, step_fn, train_set,
+    def _train_loop(self, params, opt_state, state, step_fn, fused_fn,
+                    steps_per_dispatch, train_set,
                     batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger, validation_set,
                     validation_trigger):
         ctx = self.ctx
         cfg = ctx.config
+        k = steps_per_dispatch
         tstate = TrainingState(epoch=start_epoch,
                                iteration=self.global_step)
         epoch = start_epoch
@@ -700,8 +814,28 @@ class Estimator:
             # unregisters the component when it exits (on_exit), so the
             # main thread never races a late beat.
             health.register("infeed", stale_after=60.0)
+            if k > 1:
+                # Fused dispatch: the feeder consumes the CHUNKED stream.
+                # Full chunks are stacked into a [K, batch, ...]
+                # super-batch ON THE FEEDER THREAD (host work overlapping
+                # device compute, like every other shard_fn cost) and
+                # sharded with axis 1 on the data axis, so each inner
+                # scan step sees the same per-chip shards as K=1.
+                def shard_item(item, _stack=ctx.shard_batch_stacked,
+                               _single=ctx.shard_batch):
+                    kind, payload = item
+                    if kind == "scan":
+                        stacked = jax.tree_util.tree_map(
+                            lambda *xs: np.stack(xs), *payload)
+                        return ("scan", _stack(stacked), len(payload))
+                    return ("single", _single(payload), 1)
+
+                feed_src, shard_fn = _chunk_batches(batch_iter, k), \
+                    shard_item
+            else:
+                feed_src, shard_fn = batch_iter, ctx.shard_batch
             feeder = _DeviceFeeder(
-                batch_iter, ctx.shard_batch, depth=cfg.infeed_depth,
+                feed_src, shard_fn, depth=cfg.infeed_depth,
                 heartbeat=lambda: health.heartbeat("infeed"),
                 on_exit=lambda: health.unregister("infeed"))
             prof_active = False
@@ -724,25 +858,63 @@ class Estimator:
                     # step is async; device time shows in the
                     # jax.profiler capture, not here) — named to match
                     # zoo_train_step_dispatch_seconds
+                    losses = None
                     with time_it("zoo.step_dispatch"), \
                             span("zoo.train.step_dispatch"):
-                        params, opt_state, state, loss_dev = step_fn(
-                            params, opt_state, state, seed_arr,
-                            np.asarray(self.global_step, np.int32), sharded
-                        )
+                        if k > 1:
+                            kind, payload, nk = sharded
+                            if kind == "scan":
+                                # ONE dispatch advances nk inner steps;
+                                # losses come back as a [nk] device array
+                                params, opt_state, state, losses = \
+                                    fused_fn(
+                                        params, opt_state, state,
+                                        seed_arr,
+                                        np.asarray(self.global_step,
+                                                   np.int32), payload)
+                                loss_dev = losses[nk - 1]
+                            else:  # partial tail chunk: K=1 fallback
+                                params, opt_state, state, loss_dev = \
+                                    step_fn(
+                                        params, opt_state, state,
+                                        seed_arr,
+                                        np.asarray(self.global_step,
+                                                   np.int32), payload)
+                        else:
+                            nk = 1
+                            params, opt_state, state, loss_dev = step_fn(
+                                params, opt_state, state, seed_arr,
+                                np.asarray(self.global_step, np.int32),
+                                sharded
+                            )
                     t_disp = time.perf_counter()
-                    self.global_step += 1
-                    if prof_active and self.global_step == \
+                    self.global_step += nk
+                    if prof_active and self.global_step >= \
                             prof_at + cfg.profile_steps:
                         jax.block_until_ready(loss_dev)
                         jax.profiler.stop_trace()
                         prof_active = False
                         self._profiled = True
                         logger.info("profiler trace written to %s", prof_dir)
-                    bi += 1
-                    n_records += batch_size
+                    bi += nk
+                    n_records += batch_size * nk
                     tstate.iteration = self.global_step
                     tstate.epoch_finished = False
+                    if losses is not None and self._writers:
+                        # TB gets every inner step's loss, not just the
+                        # boundary one: ONE device slice for the first
+                        # nk-1 (the flush expands it; the last loss is
+                        # buffered as a scalar by _on_iteration) —
+                        # per-element indexing here would reintroduce
+                        # nk host dispatches per fused step
+                        base = self.global_step - nk
+                        if nk > 1:
+                            self._loss_buffer.append(
+                                (base + 1, losses[: nk - 1]))
+                    # Callbacks/triggers fire ONCE per dispatch, at the
+                    # K-step boundary (docs/performance.md caveat):
+                    # checkpoints, validation and loss flushes see
+                    # iteration counts in strides of nk.
                     fired = self._on_iteration(
                         tstate, loss_dev, params, opt_state, state,
                         checkpoint_trigger, validation_set,
@@ -754,7 +926,7 @@ class Estimator:
                     step_s = time.perf_counter() - t_iter0
                     step_metrics.record_step(
                         t_data - t_iter0, t_disp - t_data,
-                        step_s, batch_size)
+                        step_s, batch_size * nk, steps=nk)
                     health.heartbeat("train_loop")
                     # flight recorder: one structured record per step
                     # (bounded ring — a postmortem shows the FINAL
@@ -763,13 +935,20 @@ class Estimator:
                         "step", loop="train", step=self.global_step,
                         epoch=epoch, data_wait_s=round(t_data - t_iter0, 6),
                         dispatch_s=round(t_disp - t_data, 6),
-                        step_s=round(step_s, 6))
-                    if straggler.observe(step_s):
+                        step_s=round(step_s, 6),
+                        **({"fused_steps": nk} if nk > 1 else {}))
+                    # straggler detection on PER-STEP time: a K-step
+                    # fused dispatch is ~K x a tail single dispatch by
+                    # construction, so comparing raw dispatch times
+                    # against one rolling p50 would flag every fused
+                    # dispatch in epochs that end with a tail
+                    if straggler.observe(step_s / nk):
                         step_metrics.stragglers.inc()
                         flight.record(
                             "straggler", loop="train",
                             step=self.global_step,
                             step_s=round(step_s, 6),
+                            per_step_s=round(step_s / nk, 6),
                             rolling_p50_s=round(
                                 straggler.rolling_p50(), 6))
             finally:
@@ -825,9 +1004,18 @@ class Estimator:
         buf, self._loss_buffer = self._loss_buffer, []
         last = None
         for it, ld in buf:
-            last = float(ld)
-            if self._writers:
-                self._writers[0].add_scalar("Loss", last, it)
+            arr = np.asarray(ld)
+            if arr.ndim == 0:
+                vals = [(it, float(arr))]
+            else:
+                # fused dispatch buffered a [K-1] loss slice under its
+                # FIRST inner step's iteration: one device fetch here
+                # expands it
+                vals = [(it + j, float(v)) for j, v in enumerate(arr)]
+            for i, v in vals:
+                last = v
+                if self._writers:
+                    self._writers[0].add_scalar("Loss", v, i)
         return last
 
     def _on_iteration(self, tstate, loss_dev, params, opt_state, state,
@@ -882,15 +1070,18 @@ class Estimator:
         transfer cost are excluded.  This is the "pure step" half of the
         bench's e2e-vs-compute decomposition; the difference to e2e is the
         infeed the feeder failed to hide.
+
+        The compiled step is CACHED (keyed on transform + input
+        signature, sharing the fit-loop cache), so repeated probes
+        measure steady state: only the first call for a signature pays
+        compile, and that warmup cost is reported separately in
+        ``last_probe_warmup_seconds`` (0.0 on cached re-probes) instead
+        of polluting the per-step figure.
         """
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
         ctx = self.ctx
-        if self._train_step_fn is None \
-                or self._train_step_fn[0] is not device_transform:
-            self._train_step_fn = (
-                device_transform, self._build_train_step(device_transform))
-        step_fn = self._train_step_fn[1]
+        step_fn = self._train_step_for(device_transform, 1)
         params, state = self.model.build_params()
         host = jax.tree_util.tree_map(np.asarray, (params, state))
         params, state = jax.device_put(host, ctx.replicated())
@@ -898,12 +1089,25 @@ class Estimator:
                                    ctx.replicated())
         sharded = ctx.shard_batch(batch)
         seed_arr = np.asarray(0, np.int32)
+        sig = (device_transform, tuple(
+            (path, tuple(leaf.shape), str(leaf.dtype))
+            for path, leaf in
+            jax.tree_util.tree_flatten_with_path(sharded)[0]))
+        t_warm = time.perf_counter()
         params, opt_state, state, loss = step_fn(
             params, opt_state, state, seed_arr, np.asarray(0, np.int32),
             sharded)
         float(loss)  # fetch-forced sync: block_until_ready can return
         #              early on some backends (axon); a dependent-scalar
         #              fetch cannot.
+        warm_s = time.perf_counter() - t_warm
+        if sig not in self._pure_step_warm:
+            # first probe at this signature: warm_s is compile + first
+            # step; report it separately so callers can quote cold cost
+            self._pure_step_warm[sig] = warm_s
+            self.last_probe_warmup_seconds = warm_s
+        else:
+            self.last_probe_warmup_seconds = 0.0
         t0 = time.perf_counter()
         for i in range(n_steps):
             params, opt_state, state, loss = step_fn(
@@ -911,6 +1115,92 @@ class Estimator:
                 np.asarray(i + 1, np.int32), sharded)
         float(loss)
         return (time.perf_counter() - t0) / n_steps
+
+    # ------------------------------------------------------------------
+    # AOT warmup (the compile plane, common/compile_cache.py)
+    # ------------------------------------------------------------------
+    def warmup(self, batch: dict, device_transform=None,
+               steps_per_dispatch: int | None = None) -> dict:
+        """Pay XLA compilation for the train step BEFORE the first real
+        batch (``.lower().compile()`` through the compile plane).
+
+        ``batch`` is an example host batch dict (``{"x": ..., "y": ...}``,
+        leading dim = the GLOBAL batch size fit() will use).
+        ``device_transform`` must be the SAME transform the training
+        FeatureSet carries (``train_set.device_transform``; the step
+        cache is keyed on it) — warming with the default ``None`` while
+        fit() uses a transform compiles a program fit never dispatches.
+        Compiles the K=1 step and — when ``steps_per_dispatch`` (default: the
+        configured ``ZOO_STEPS_PER_DISPATCH``) is > 1 — the fused scan-K
+        step too, then runs ONE throwaway dispatch (a full train step on
+        the example batch against fresh random-init buffers; results
+        discarded, live model state untouched) so the in-process jit
+        dispatch cache is warm.  With ``ZOO_COMPILE_CACHE`` set, an AOT
+        ``.lower().compile()`` additionally populates the persistent
+        cache first — the throwaway dispatch (and every later process
+        compiling the same program) then deserializes it instead of
+        re-running XLA; without a cache dir the AOT pass is skipped so
+        each program compiles exactly once.
+
+        Returns ``{label: seconds_to_ready}`` per program (AOT compile,
+        if any, plus the throwaway dispatch); AOT compiles are also
+        recorded in ``zoo_compile_seconds``.
+        """
+        ctx = self.ctx
+        from analytics_zoo_tpu.common.compile_cache import (
+            maybe_enable_persistent_cache,
+            timed_compile,
+        )
+        maybe_enable_persistent_cache(ctx.config.compile_cache)
+        k = steps_per_dispatch if steps_per_dispatch is not None \
+            else int(ctx.config.steps_per_dispatch or 1)
+        if int(k) < 1:
+            # same contract as ZooConfig: misconfigured K fails loudly
+            # on every entry point (and before touching the step cache)
+            raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+        params, state = self.model.build_params()
+        host = jax.tree_util.tree_map(np.asarray, (params, state))
+        out = {}
+        host_batch = jax.tree_util.tree_map(np.asarray, batch)
+        # Multi-host: the batch arg is GLOBAL (the documented contract);
+        # fit()'s shard path consumes process-LOCAL rows, so slice ours
+        # out — otherwise the warm program's batch dim would be
+        # process_count x fit's.
+        from analytics_zoo_tpu.feature.dataset import _slice_batch_rows
+        host_batch = _slice_batch_rows(host_batch, _process_shard())
+        for kk in sorted({1, k}):
+            label = "train_step" if kk == 1 else f"train_step_scan{kk}"
+            step_fn = self._train_step_for(device_transform, kk)
+            # fresh device buffers per variant: the throwaway dispatch
+            # donates them, and the live model buffers are never touched.
+            # opt_state takes the SAME placement fit() will use
+            # (_place_opt_state — ZeRO-1 sharded under
+            # ZOO_SHARD_OPTIMIZER): jit specializes on input shardings,
+            # so a replicated warm here would compile a program fit
+            # never runs.
+            params, state = jax.device_put(host, ctx.replicated())
+            opt_state = self._place_opt_state(self.optimizer.init(params))
+            if kk == 1:
+                sharded = ctx.shard_batch(host_batch)
+            else:
+                sharded = ctx.shard_batch_stacked(jax.tree_util.tree_map(
+                    lambda x: np.stack([x] * kk), host_batch))
+            args = (params, opt_state, state, np.asarray(0, np.int32),
+                    np.asarray(0, np.int32), sharded)
+            t0 = time.perf_counter()
+            from analytics_zoo_tpu.common.compile_cache import cache_dir
+            if cache_dir() is not None:
+                # AOT pass populates the persistent cache; the dispatch
+                # below deserializes it.  Skipped when no cache dir is
+                # enabled — the discarded executable would just make the
+                # dispatch below pay the SAME compile a second time.
+                timed_compile(step_fn.lower(*args), label)
+            # one throwaway dispatch: warms jax's own dispatch cache
+            res = step_fn(*args)
+            jax.block_until_ready(res[-1])
+            out[label] = time.perf_counter() - t0
+        logger.info("warmup compiled %s", out)
+        return out
 
     # ------------------------------------------------------------------
     # evaluate (Estimator.scala:157-176; KerasNet.evaluate)

@@ -9,6 +9,7 @@ replica parallelism collapses into XLA's own intra-chip parallelism.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -30,13 +31,26 @@ class LocalEstimator:
         self.metrics = [get_metric(m) for m in (metrics or [])]
 
     def fit(self, x, y, validation_data=None, batch_size=32, epochs=1,
-            seed=0):
+            seed=0, steps_per_dispatch=None):
+        """``steps_per_dispatch=K>1`` (default: ``ZOO_STEPS_PER_DISPATCH``)
+        fuses K train steps into one jitted ``lax.scan`` dispatch — the
+        single-device twin of the Estimator's fused path, with the same
+        contract: per-step RNG folds on the global iteration index, so
+        the loss trajectory is bit-identical to K=1; a partial tail chunk
+        falls back to single steps."""
         model, loss_fn, opt = self.model, self.loss, self.optimizer
+        if steps_per_dispatch is None:
+            steps_per_dispatch = int(
+                os.environ.get("ZOO_STEPS_PER_DISPATCH", "1"))
+        k = int(steps_per_dispatch)
+        if k < 1:
+            # same contract as ZooConfig.__post_init__: a misconfigured
+            # knob fails loudly on every entry point, never clamps
+            raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
         params, state = model.build_params()
         opt_state = opt.init(params)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step(params, opt_state, state, rng, bx, by):
+        def one_step(params, opt_state, state, rng, bx, by):
             def loss_of(p):
                 preds, new_state = model.forward(p, bx, state=state,
                                                  training=True, rng=rng)
@@ -49,19 +63,58 @@ class LocalEstimator:
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, l
 
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, rng, bx, by):
+            return one_step(params, opt_state, state, rng, bx, by)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step_scan(params, opt_state, state, it0, sbx, sby):
+            key = jax.random.PRNGKey(seed)
+
+            def body(carry, xs):
+                p, o, s = carry
+                bx, by, i = xs
+                p, o, s, l = one_step(p, o, s,
+                                      jax.random.fold_in(key, it0 + i),
+                                      bx, by)
+                return (p, o, s), l
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (sbx, sby, jnp.arange(k, dtype=jnp.int32)))
+            return params, opt_state, state, losses[-1]
+
+        from analytics_zoo_tpu.pipeline.estimator.estimator import (
+            _chunk_batches,
+        )
+
         fs = FeatureSet.of(x, y)
         it = 0
         history = []
         for epoch in range(epochs):
             last = None
-            for batch in fs.batches(batch_size, shuffle=True, seed=seed,
-                                    epoch=epoch):
-                rng = jax.random.fold_in(jax.random.PRNGKey(seed), it)
-                params, opt_state, state, last = step(
-                    params, opt_state, state, rng,
-                    jnp.asarray(batch["x"]), jnp.asarray(batch["y"]),
-                )
-                it += 1
+            batches = fs.batches(batch_size, shuffle=True, seed=seed,
+                                 epoch=epoch)
+            # the estimator's chunker (full chunks fused, tail degrades
+            # to single steps); at K=1 the stream is consumed directly
+            items = (("single", b) for b in batches) if k == 1 \
+                else _chunk_batches(batches, k)
+            for kind, payload in items:
+                if kind == "scan":
+                    params, opt_state, state, last = step_scan(
+                        params, opt_state, state, jnp.int32(it),
+                        jnp.asarray(np.stack([b["x"] for b in payload])),
+                        jnp.asarray(np.stack([b["y"] for b in payload])),
+                    )
+                    it += k
+                else:
+                    rng = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+                    params, opt_state, state, last = step(
+                        params, opt_state, state, rng,
+                        jnp.asarray(payload["x"]),
+                        jnp.asarray(payload["y"]),
+                    )
+                    it += 1
             history.append(float(last) if last is not None else None)
         model.params, model.state = params, state
         self.history = history

@@ -16,7 +16,6 @@ a bounded set of executables serves arbitrary batch sizes.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Sequence
 
@@ -156,10 +155,14 @@ class InferenceModel:
     @staticmethod
     def enable_persistent_compile_cache(cache_dir: str) -> None:
         """Persistent XLA compile cache on disk — the moral equivalent of
-        OpenVINO's saved IR: second process start skips compilation."""
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        OpenVINO's saved IR: second process start skips compilation.
+        Delegates to the shared compile plane
+        (:mod:`analytics_zoo_tpu.common.compile_cache`), same as
+        ``ZOO_COMPILE_CACHE``."""
+        from analytics_zoo_tpu.common.compile_cache import (
+            maybe_enable_persistent_cache,
+        )
+        maybe_enable_persistent_cache(cache_dir)
 
     # ------------------------------------------------------------------
     # compile cache
@@ -208,16 +211,24 @@ class InferenceModel:
                     from analytics_zoo_tpu.pipeline.inference.quantize \
                         import HOOK_LOCK
 
+                    from analytics_zoo_tpu.common.compile_cache import (
+                        maybe_enable_persistent_cache,
+                        timed_compile,
+                    )
+
                     int8 = getattr(self, "_int8_model", None)
                     ctx = int8.installed() if int8 is not None \
                         else HOOK_LOCK
                     bucket = str(xs[0].shape[0]) if np.ndim(xs[0]) else "0"
+                    # ZOO_COMPILE_CACHE: an already-served bucket shape
+                    # compiles as a persistent-cache hit on restart
+                    maybe_enable_persistent_cache()
                     with ctx, span("zoo.inference.compile",
                                    args={"bucket": bucket}):
-                        exe = (
+                        exe = timed_compile(
                             jax.jit(self._forward_fn())
-                            .lower(self._params, self._state, list(xs))
-                            .compile()
+                            .lower(self._params, self._state, list(xs)),
+                            f"inference_b{bucket}",
                         )
                     self._m_compiles.labels(bucket=bucket).inc()
                     self._compiled[key] = exe
@@ -225,9 +236,14 @@ class InferenceModel:
 
     def warmup(self, input_shapes, dtype=np.float32,
                batch_sizes=(1,)) -> None:
-        """Pre-compile executables for the given shapes (offline-conversion
-        step; avoids first-request latency).  Batch sizes are rounded up to
-        the power-of-two buckets predict actually requests."""
+        """Pre-compile executables for the given bucket shapes
+        (offline-conversion step; avoids first-request latency).  Batch
+        sizes are rounded up to the power-of-two buckets predict actually
+        requests.  Goes through the compile plane
+        (``common/compile_cache.py``): each ``.lower().compile()`` is
+        timed into ``zoo_compile_seconds{label=inference_b<bucket>}``,
+        and with ``ZOO_COMPILE_CACHE`` set a restarted server warms from
+        disk instead of XLA."""
         shapes = input_shapes
         if shapes and not isinstance(shapes[0], (list, tuple)):
             shapes = [shapes]

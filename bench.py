@@ -193,6 +193,194 @@ def data_pipeline_bench(workers: int = 4, depth: int = 8,
     return doc
 
 
+# ---------------------------------------------------------------------------
+# --dispatch: fused multi-step dispatch + compile plane bench
+# (ZOO_STEPS_PER_DISPATCH / ZOO_COMPILE_CACHE; docs/performance.md).
+# Two measurements on a deliberately dispatch-bound synthetic model (tiny
+# Dense net, small batch — per-step compute is microseconds, so the
+# Python→device round-trip dominates exactly like the tunneled harness):
+#   1. steps/sec for K ∈ {1, 4, 16}: how much lax.scan fusion amortizes
+#      the per-step host overhead, plus a bitwise trajectory-equality
+#      check (the K>1 contract);
+#   2. cold vs warm time-to-first-step in SUBPROCESSES sharing a
+#      ZOO_COMPILE_CACHE dir (cold populates, warm deserializes), plus a
+#      post-`estimator.warmup()` fit.
+# Emits BENCH_DISPATCH_r07.json so the gain is pinned, not asserted.
+# Forced to the CPU backend: this bench measures HOST dispatch overhead
+# and compile persistence, not device compute.
+# ---------------------------------------------------------------------------
+
+DISPATCH_FEAT = 32
+DISPATCH_CLASSES = 10
+
+
+def _dispatch_model(width: int = 64, depth: int = 1):
+    """The K-sweep uses the tiny default (dispatch-bound: per-step
+    compute ≪ per-step host overhead).  The compile probe uses a DEEP
+    stack (width 256 × 30) instead: there XLA compile is ~4× the
+    trace+lower cost, which is the regime the persistent cache exists
+    for — on a tiny model time-to-first-step is tracing-bound and no
+    disk cache can help it."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    m = Sequential()
+    m.add(Dense(width, activation="relu", input_shape=(DISPATCH_FEAT,)))
+    for _ in range(depth - 1):
+        m.add(Dense(width, activation="relu"))
+    m.add(Dense(DISPATCH_CLASSES, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    return m
+
+
+def _dispatch_data(n: int, seed: int = 5):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, DISPATCH_FEAT)).astype("float32")
+    y = rng.integers(0, DISPATCH_CLASSES, size=(n,)).astype("int32")
+    return x, y
+
+
+def dispatch_bench(ks=(1, 4, 16), n_batches: int = 384,
+                   batch_size: int = 16, quick: bool = False,
+                   compile_probe: bool = True,
+                   out_path: str | None = None) -> dict:
+    """K-sweep steps/sec + cold/warm compile seconds; writes the artifact.
+
+    ``quick``: CI-sized run (fewer batches; also exercised by
+    tests/test_dispatch.py so a fusion regression fails loudly).
+    ``compile_probe=False`` skips the two compile-cache subprocesses
+    (each pays a full jax import) — the quick-tier test does.
+    """
+    import tempfile
+
+    import numpy as np
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.common.engine import ZooConfig
+
+    if quick:
+        n_batches = 128
+    n_batches = max(ks) * (n_batches // max(ks))  # full chunks for every K
+    x, y = _dispatch_data(n_batches * batch_size)
+
+    results, trajectories = {}, {}
+    for k in ks:
+        zoo.init_zoo_context(ZooConfig(seed=11, steps_per_dispatch=k))
+        m = _dispatch_model()
+        # epoch 1 warms (trace + compile); epoch 2 is the timed
+        # steady-state epoch (Keras continuation semantics)
+        m.fit(x, y, batch_size=batch_size, nb_epoch=1)
+        t0 = time.perf_counter()
+        m.fit(x, y, batch_size=batch_size, nb_epoch=1)
+        dt = time.perf_counter() - t0
+        results[k] = {
+            "steps_per_sec": round(n_batches / dt, 1),
+            "dispatches_per_epoch": -(-n_batches // k),
+            "epoch_s": round(dt, 4),
+        }
+        trajectories[k] = [h["loss"] for h in m._estimator.history]
+    base = results[ks[0]]["steps_per_sec"]
+    for k in ks:
+        results[k]["speedup_vs_k1"] = round(
+            results[k]["steps_per_sec"] / base, 3)
+
+    doc = {
+        "metric": "fused_dispatch_train_steps_per_sec",
+        "unit": "steps/sec",
+        "platform": "cpu",
+        "batch_size": batch_size,
+        "steps_per_epoch": n_batches,
+        "sweep": {str(k): results[k] for k in ks},
+        # the K>1 contract: identical loss trajectory, not just similar
+        "loss_trajectory_bitwise_equal": all(
+            trajectories[k] == trajectories[ks[0]] for k in ks),
+    }
+
+    if compile_probe:
+        def probe_child(cache_dir, mode):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("XLA_FLAGS", None)  # one stable cache key across runs
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--dispatch-child", cache_dir, mode],
+                capture_output=True, text=True, timeout=600, env=env)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"dispatch child failed:\n{(r.stderr or '')[-2000:]}")
+            return json.loads(r.stdout.strip().splitlines()[-1])
+
+        with tempfile.TemporaryDirectory() as cache_dir:
+            cold = probe_child(cache_dir, "fit")       # empty cache
+            warm = probe_child(cache_dir, "fit")       # populated cache
+        with tempfile.TemporaryDirectory() as cache_dir:
+            warmed = probe_child(cache_dir, "warmup-fit")
+        doc["compile_plane"] = {
+            "cold_first_fit_s": cold["first_fit_s"],
+            "warm_first_fit_s": warm["first_fit_s"],
+            "warm_over_cold": round(
+                warm["first_fit_s"] / max(cold["first_fit_s"], 1e-9), 3),
+            "post_warmup_fit_s": warmed["first_fit_s"],
+            "warmup_compile_s": warmed.get("warmup_compile_s"),
+            "note": ("cold/warm: two fresh processes sharing one "
+                     "ZOO_COMPILE_CACHE dir; warmup-fit: same-process "
+                     "estimator.warmup() before the first fit"),
+        }
+
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DISPATCH_r07.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _dispatch_child_main(argv):
+    """Subprocess body for the cold/warm probe: time-to-first-step of a
+    one-batch fit with the persistent compile cache at argv's dir."""
+    cache_dir = argv[argv.index("--dispatch-child") + 1]
+    mode = argv[argv.index("--dispatch-child") + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.common.engine import ZooConfig
+
+    zoo.init_zoo_context(ZooConfig(seed=11, compile_cache=cache_dir))
+    x, y = _dispatch_data(16)
+    m = _dispatch_model(width=256, depth=30)
+    out = {}
+    if mode == "warmup-fit":
+        m._estimator = m._make_estimator()
+        t0 = time.perf_counter()
+        secs = m._estimator.warmup({"x": x, "y": y})
+        out["warmup_compile_s"] = round(time.perf_counter() - t0, 4)
+        out["warmup_detail"] = {k: round(v, 4) for k, v in secs.items()}
+    t0 = time.perf_counter()
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    out["first_fit_s"] = round(time.perf_counter() - t0, 4)
+    print(json.dumps(out))
+
+
+def _dispatch_main(argv):
+    # measures host dispatch overhead; the CPU backend is the point, and
+    # it also sidesteps the flaky TPU init entirely
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(dispatch_bench(**kwargs)))
+
+
 def probe_backend(timeout: float, env: dict | None = None) \
         -> tuple[bool, str]:
     """Try `jax.devices()` in a subprocess with a hard timeout.
@@ -431,5 +619,9 @@ def _data_pipeline_main(argv):
 if __name__ == "__main__":
     if "--data-pipeline" in sys.argv:
         _data_pipeline_main(sys.argv[1:])
+    elif "--dispatch-child" in sys.argv:
+        _dispatch_child_main(sys.argv[1:])
+    elif "--dispatch" in sys.argv:
+        _dispatch_main(sys.argv[1:])
     else:
         main()

@@ -48,6 +48,40 @@ class TestInferenceModel:
         m.predict(np.zeros((9, 16), np.float32))  # bucket 16
         assert len(m._compiled) == 2
 
+    def test_warm_bucket_does_not_increment_compile_counter(self):
+        """Pad-to-bucket reuse guard (regression): a second predict at an
+        already-compiled bucket shape must be served by the cached
+        executable — the per-bucket zoo_inference_compiles_total counter
+        stays flat, whatever sub-bucket batch size arrives."""
+        from analytics_zoo_tpu.metrics import (
+            MetricsRegistry,
+            set_registry,
+            snapshot,
+        )
+
+        reg = MetricsRegistry(enabled=True)
+        prev = set_registry(reg)
+        try:
+            net = _small_net()
+            m = InferenceModel().from_keras_net(net)
+
+            def compiles(bucket):
+                return sum(
+                    s["value"] for s in snapshot(reg)["samples"]
+                    if s["name"] == "zoo_inference_compiles_total"
+                    and (s.get("labels") or {}).get("bucket") == bucket)
+
+            m.predict(np.zeros((3, 16), np.float32))   # pads 3 -> bucket 4
+            assert compiles("4") == 1
+            m.predict(np.zeros((4, 16), np.float32))   # exact bucket hit
+            m.predict(np.zeros((2, 16), np.float32))   # pads 2 -> bucket 4
+            assert compiles("4") == 1
+            m.predict(np.zeros((5, 16), np.float32))   # new bucket 8
+            assert compiles("8") == 1
+            assert compiles("4") == 1
+        finally:
+            set_registry(prev)
+
     def test_save_load_roundtrip(self, tmp_path):
         net = _small_net()
         p = str(tmp_path / "model.zoo")

@@ -13,8 +13,18 @@ prints, for the LATEST snapshot:
   snapshot in the file when more than one line is present (file mode
   only — a single live scrape has no baseline).
 
+Metric families worth a `--prefix` of their own: `zoo_train` (fit-loop
+breakdown; under ``ZOO_STEPS_PER_DISPATCH=K`` one histogram observation
+covers a K-step fused dispatch while the steps/records counters keep
+counting real steps), `zoo_serving`, `zoo_inference`,
+`zoo_data_prefetch` (host data plane), and `zoo_compile` (the compile
+plane: `zoo_compile_seconds{label=...}` per AOT compile plus the
+`zoo_compile_cache_hits_total` / `zoo_compile_cache_misses_total` pair
+that splits cold from ``ZOO_COMPILE_CACHE``-warm starts).
+
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
+  python tools/metrics_dump.py METRICS.jsonl --prefix zoo_compile
   python tools/metrics_dump.py METRICS.jsonl --prometheus   # re-render
   python tools/metrics_dump.py --url http://host:9090/varz
   python tools/metrics_dump.py --url host:9090   # /varz implied
