@@ -1,0 +1,69 @@
+"""``analytics_zoo_tpu.analysis`` — two-tier static analysis (zoolint).
+
+The codebase is heavily threaded (prefetch producer pool, pipelined
+serving reader/writer, infeed feeder, metrics HTTP server) and heavily
+jitted (fused ``lax.scan`` dispatch, per-bucket inference compiles).
+Its two dominant failure classes — silent host/device performance
+hazards inside traced code, and data races on shared mutable state —
+are invisible to pytest: a side effect traced into a jit runs once at
+trace time and never again, and a missing lock loses a write only under
+the right interleaving.  This package makes both *compile-time* errors:
+
+**Tier 1 — AST lint ("zoolint")**: a rule engine over Python ASTs
+(:mod:`astlint`) with file:line findings, severities and
+``# zoolint: disable=<rule>`` suppressions.  JAX rules
+(:mod:`rules_jax`): Python side effects inside jit/scan-traced
+functions, PRNG key reuse without ``split``/``fold_in``, host syncs on
+annotated hot paths, non-donated training carries.  Concurrency rules
+(:mod:`rules_concurrency`): writes to ``# guarded-by: <lock>``
+attributes without the lock held, inconsistent lock acquisition order,
+bare ``except:`` that swallows exceptions in daemon threads.  The CLI
+is ``tools/zoolint.py`` (``--format text|json``, nonzero exit on
+findings) and the quick-tier gate
+``tests/test_zoolint.py::test_package_is_clean`` keeps the package at
+zero unsuppressed findings.
+
+**Tier 2 — HLO graph lint + analytic cost extraction** (:mod:`hlo`):
+every AOT compile routed through
+:func:`analytics_zoo_tpu.common.compile_cache.timed_compile` has its
+lowered StableHLO module text inspected WITHOUT executing it — f64 ops,
+host callbacks, unexpected all-gathers and oversized baked-in constants
+become findings; analytic cost features (matmul FLOPs, bytes touched,
+collective count/bytes, fused-dispatch count) land in the
+``zoo_hlo_*`` registry metrics, a per-compile JSON report
+(``ZOO_HLO_REPORT_DIR``) and the crash flight recorder.  These are the
+graph features the ROADMAP's cost-model-driven compile plane
+(TpuGraphs, arXiv:2308.13490) consumes: config quality as prediction
+over the compiled graph, extracted for free at the compile choke point.
+
+See ``docs/static-analysis.md`` for the rule catalogue, suppression
+syntax, the ``# guarded-by:`` annotation convention and the HLO report
+schema.
+"""
+
+from analytics_zoo_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    render_json,
+    render_text,
+)
+from analytics_zoo_tpu.analysis.astlint import (
+    ALL_RULES,
+    LintModule,
+    Rule,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from analytics_zoo_tpu.analysis.hlo import (
+    HloReport,
+    analyze_hlo_text,
+    lint_lowered,
+)
+
+__all__ = [
+    "Finding", "Severity", "render_text", "render_json",
+    "Rule", "LintModule", "ALL_RULES",
+    "lint_source", "lint_file", "lint_paths",
+    "HloReport", "analyze_hlo_text", "lint_lowered",
+]

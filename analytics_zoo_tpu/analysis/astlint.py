@@ -1,0 +1,377 @@
+"""Tier-1 rule engine — Python AST lint with suppressions ("zoolint").
+
+The engine parses each file once into a :class:`LintModule` (AST +
+comment map + import-alias table + the traced-function set) and hands
+it to every registered :class:`Rule`; findings carry ``path:line:col``
+and are marked suppressed when a ``# zoolint: disable=<rule>`` comment
+covers their line.  The rule catalogue lives in :mod:`rules_jax` and
+:mod:`rules_concurrency`; ``docs/static-analysis.md`` documents every
+rule and the annotation conventions.
+
+Suppression syntax (checked per line):
+
+- ``# zoolint: disable=rule1,rule2 -- justification`` at the end of the
+  offending line, or standalone on the line directly ABOVE it (for
+  lines with no room);
+- ``# zoolint: disable-file=rule1,rule2 -- justification`` anywhere in
+  the file suppresses the rule(s) file-wide;
+- ``all`` suppresses every rule.  The `` -- justification`` tail is
+  optional but strongly encouraged — the CI gate keeps the tree at zero
+  unsuppressed findings, so a suppression is a reviewed decision.
+
+Annotations the rules read (conventions, not syntax extensions):
+
+- ``# guarded-by: <lock>`` on an attribute-initialising line declares
+  that ``self.<attr>`` may only be WRITTEN while ``with self.<lock>:``
+  is held (:mod:`rules_concurrency`);
+- ``# zoolint: hot-path`` on (or directly above) a ``def`` marks the
+  function as a device-adjacent hot path where host syncs
+  (``.block_until_ready()``, ``np.asarray``, ``float()`` on arrays) are
+  findings (:mod:`rules_jax`).
+
+Static analysis is approximate by design: the traced-function set is
+built from local evidence (decorators, ``jax.jit(f)`` call sites,
+functions passed to ``lax.scan``/``fori_loop``/..., plus transitive
+local calls), so a function jitted from another module is not seen.
+The rules err toward precision (few false positives) because the CI
+gate makes every finding actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+
+__all__ = ["Rule", "LintModule", "ALL_RULES", "lint_source", "lint_file",
+           "lint_paths", "iter_python_files"]
+
+_SUPPRESS_RE = re.compile(
+    r"zoolint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[\w\-]+(?:\s*,\s*[\w\-]+)*)"
+    r"(?:\s*--\s*(?P<why>.*))?")
+_HOT_PATH_RE = re.compile(r"zoolint:\s*hot-path")
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*(?P<lock>[\w.]+)")
+
+# Names whose call means "this callable is jit/scan traced".  The VALUE
+# is the positions of callable args that become traced (None = arg 0
+# only for jit-likes; control-flow primitives trace several).
+_JIT_NAMES = {
+    "jax.jit", "jit", "jax.pjit", "pjit",
+    "jax.experimental.pjit.pjit", "jax.named_call",
+}
+_TRACING_CALLS = {
+    "jax.lax.scan": (0,), "lax.scan": (0,),
+    "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2), "lax.cond": (1, 2),
+    "jax.lax.switch": (1,), "lax.switch": (1,),
+    "jax.lax.map": (0,), "lax.map": (0,),
+    "jax.lax.associative_scan": (0,), "lax.associative_scan": (0,),
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+class Rule:
+    """Base rule: subclasses set ``name``/``severity``/``description``
+    and implement :meth:`check` yielding :class:`Finding`s (leave
+    ``suppressed`` False — the engine applies suppressions)."""
+
+    name = "abstract"
+    severity = Severity.WARNING
+    description = ""
+
+    def check(self, mod: "LintModule") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: "LintModule", node: ast.AST, message: str,
+                **data) -> Finding:
+        return Finding(rule=self.name, severity=self.severity,
+                       path=mod.path, line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, data=data)
+
+
+@dataclass
+class LintModule:
+    """One parsed file plus everything the rules need to read it."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list[str]
+    #: line -> raw comment text (without the leading ``#``)
+    comments: dict[int, str] = field(default_factory=dict)
+    #: line -> set of rule names disabled on that line
+    suppressions: dict[int, set] = field(default_factory=dict)
+    file_suppressions: set = field(default_factory=set)
+    #: lines carrying a ``# zoolint: hot-path`` annotation
+    hot_path_lines: set = field(default_factory=set)
+    #: line -> lock name from a ``# guarded-by: <lock>`` annotation
+    guarded_by_lines: dict[int, str] = field(default_factory=dict)
+    #: local name -> canonical dotted path (``np`` -> ``numpy``)
+    aliases: dict[str, str] = field(default_factory=dict)
+    #: child node -> parent node, for ancestor walks
+    parents: dict[ast.AST, ast.AST] = field(default_factory=dict)
+    #: FunctionDef / AsyncFunctionDef / Lambda nodes that are jit- or
+    #: scan-traced (directly or via transitive local calls)
+    traced: set = field(default_factory=set)
+
+    # -- name resolution ------------------------------------------------
+    def qualname(self, node: ast.AST) -> str | None:
+        """Dotted name of a Name/Attribute chain with import aliases
+        resolved at the root (``np.random.rand`` -> ``numpy.random.rand``);
+        None for anything not a plain chain."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return a
+        return None
+
+    def functions(self) -> Iterator[ast.AST]:
+        for n in ast.walk(self.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield n
+
+    def is_hot_path(self, fn: ast.AST) -> bool:
+        """Annotated ``# zoolint: hot-path`` on/above the def (above the
+        first decorator when decorated)."""
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        first = min([d.lineno for d in fn.decorator_list] + [fn.lineno])
+        return any(ln in self.hot_path_lines
+                   for ln in range(first - 1, fn.lineno + 1))
+
+    def suppressed_rules_at(self, line: int) -> set:
+        return self.file_suppressions | self.suppressions.get(line, set())
+
+
+def scope_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested
+    function/lambda scopes — their statements belong to their own
+    per-function check, not the enclosing one's."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_comments(mod: LintModule) -> None:
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(mod.source).readline)
+        comments = [(t.start[0], t.start[1], t.string[1:].strip())
+                    for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):
+        return
+    for line, col, text in comments:
+        mod.comments[line] = text
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            if m.group("scope"):
+                mod.file_suppressions |= rules
+            else:
+                mod.suppressions.setdefault(line, set()).update(rules)
+                # a standalone suppression comment covers the next line
+                # (for statements with no room at the end of the line)
+                if mod.lines[line - 1].lstrip().startswith("#"):
+                    mod.suppressions.setdefault(line + 1,
+                                                set()).update(rules)
+        if _HOT_PATH_RE.search(text):
+            mod.hot_path_lines.add(line)
+        m = _GUARDED_BY_RE.search(text)
+        if m:
+            mod.guarded_by_lines[line] = m.group("lock")
+
+
+def _collect_aliases(mod: LintModule) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                mod.aliases[a.asname or a.name] = \
+                    f"{node.module}.{a.name}"
+
+
+def _collect_parents(mod: LintModule) -> None:
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            mod.parents[child] = parent
+
+
+def _callable_arg_nodes(mod: LintModule, call: ast.Call,
+                        positions: tuple) -> list:
+    out = []
+    for i in positions:
+        if i < len(call.args):
+            out.append(call.args[i])
+    return out
+
+
+def _collect_traced(mod: LintModule) -> None:
+    """Seed the traced set from jit decorators / jit(f) call sites /
+    control-flow-primitive callables, then propagate through local
+    calls (``train_step`` calls ``one_step`` => ``one_step`` traced)."""
+    defs_by_name: dict[str, list] = {}
+    for fn in mod.functions():
+        defs_by_name.setdefault(fn.name, []).append(fn)
+
+    def is_jit_expr(node: ast.AST) -> bool:
+        q = mod.qualname(node)
+        if q in _JIT_NAMES:
+            return True
+        # partial(jax.jit, ...) / partial(jit, donate_argnums=...)
+        if isinstance(node, ast.Call) \
+                and mod.qualname(node.func) in _PARTIAL_NAMES \
+                and node.args and mod.qualname(node.args[0]) in _JIT_NAMES:
+            return True
+        return False
+
+    def mark(node: ast.AST):
+        if isinstance(node, ast.Lambda):
+            mod.traced.add(node)
+        elif isinstance(node, ast.Name):
+            for fn in defs_by_name.get(node.id, ()):
+                mod.traced.add(fn)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_expr(d) or
+                   (isinstance(d, ast.Call) and is_jit_expr(d.func))
+                   for d in node.decorator_list):
+                mod.traced.add(node)
+        elif isinstance(node, ast.Call):
+            q = mod.qualname(node.func)
+            if is_jit_expr(node.func) and node.args:
+                mark(node.args[0])
+            elif q in _TRACING_CALLS:
+                for arg in _callable_arg_nodes(mod, node,
+                                               _TRACING_CALLS[q]):
+                    mark(arg)
+
+    # transitive closure over local call edges: anything a traced
+    # function calls by bare name (and that is defined in this module)
+    # runs under the same trace
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(mod.traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name):
+                    for callee in defs_by_name.get(node.func.id, ()):
+                        if callee not in mod.traced:
+                            mod.traced.add(callee)
+                            changed = True
+
+
+def parse_module(source: str, path: str = "<string>") -> LintModule:
+    tree = ast.parse(source)
+    mod = LintModule(path=path, source=source, tree=tree,
+                     lines=source.splitlines())
+    _collect_comments(mod)
+    _collect_aliases(mod)
+    _collect_parents(mod)
+    _collect_traced(mod)
+    return mod
+
+
+def _apply_suppressions(mod: LintModule,
+                        findings: list[Finding]) -> list[Finding]:
+    out = []
+    for f in findings:
+        rules = mod.suppressed_rules_at(f.line)
+        if f.rule in rules or "all" in rules:
+            f = Finding(rule=f.rule, severity=f.severity, path=f.path,
+                        line=f.line, col=f.col, message=f.message,
+                        suppressed=True, data=f.data)
+        out.append(f)
+    return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Run the rule set over one source string; returns ALL findings,
+    suppressed ones flagged (callers filter on ``.suppressed``)."""
+    try:
+        mod = parse_module(source, path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", severity=Severity.ERROR,
+                        path=path, line=e.lineno or 0,
+                        message=f"could not parse: {e.msg}")]
+    findings: list[Finding] = []
+    for rule in (ALL_RULES if rules is None else rules):
+        findings.extend(rule.check(mod))
+    return _apply_suppressions(mod, findings)
+
+
+def lint_file(path: str,
+              rules: Iterable[Rule] | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/dirs into a sorted walk of ``.py`` files, skipping
+    hidden and cache directories."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirnames, files in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Iterable[Rule] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules))
+    return findings
+
+
+# Assembled at the bottom so the rule modules can import the engine.
+from analytics_zoo_tpu.analysis.rules_jax import JAX_RULES  # noqa: E402
+from analytics_zoo_tpu.analysis.rules_concurrency import (  # noqa: E402
+    CONCURRENCY_RULES,
+)
+
+ALL_RULES: tuple = JAX_RULES + CONCURRENCY_RULES

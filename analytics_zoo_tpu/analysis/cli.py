@@ -1,0 +1,75 @@
+"""zoolint CLI — run the Tier-1 AST rules over files/trees.
+
+``python tools/zoolint.py analytics_zoo_tpu/`` is the pre-commit / CI
+entry: exit 0 on a clean tree, 1 when any unsuppressed finding exists
+(2 on usage errors), so it composes with ``&&`` chains and CI steps.
+``--format json`` emits the machine shape (``findings`` + ``summary``);
+``--show-suppressed`` includes suppressed findings in text output for
+auditing the justification trail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from analytics_zoo_tpu.analysis.astlint import ALL_RULES, lint_paths
+from analytics_zoo_tpu.analysis.findings import render_json, render_text
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="zoolint",
+        description="JAX / concurrency AST linter (Tier 1 of "
+                    "analytics_zoo_tpu.analysis)")
+    p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
+                   help="files or directories to lint "
+                        "(default: analytics_zoo_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (default: text)")
+    p.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name:<18} {rule.severity:<7} "
+                  f"{rule.description}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        unknown = wanted - {r.name for r in ALL_RULES}
+        if unknown:
+            print(f"zoolint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in ALL_RULES if r.name in wanted]
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must NOT read as "0 findings, clean": a CI step
+        # pointed at nothing would stay green forever
+        print(f"zoolint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, rules)
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

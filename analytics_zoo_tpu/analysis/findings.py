@@ -1,0 +1,92 @@
+"""Finding model shared by both lint tiers.
+
+A :class:`Finding` is one diagnostic: rule id, severity, location,
+message.  Tier 1 (AST) findings carry ``path:line:col``; Tier 2 (HLO)
+findings carry the compile label in ``path`` and the op's line within
+the lowered module text in ``line``.  Renderers produce the two CLI
+output formats (``--format text|json``); both are stable shapes other
+tools (pre-commit hooks, CI annotations) can parse.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import asdict, dataclass, field
+
+
+class Severity(enum.IntEnum):
+    """Ordered so thresholds compare naturally (INFO < WARNING < ERROR)."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR"
+        return self.name.lower()
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic from either tier."""
+
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    message: str
+    col: int = 0
+    suppressed: bool = False
+    #: free-form extras (e.g. the justification text of the suppression
+    #: comment, or the lock / attribute names of a concurrency finding)
+    data: dict = field(default_factory=dict, compare=False, hash=False)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["severity"] = str(self.severity)
+        if not d["data"]:
+            d.pop("data")
+        return d
+
+
+def render_text(findings: list[Finding], show_suppressed: bool = False) \
+        -> str:
+    """One ``path:line:col: severity [rule] message`` line per finding,
+    plus a summary tail — the human/CI console format."""
+    lines = []
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if show_suppressed else active
+    for f in sorted(shown, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        tag = " (suppressed)" if f.suppressed else ""
+        lines.append(f"{f.location()}: {f.severity} [{f.rule}] "
+                     f"{f.message}{tag}")
+    n_sup = len(findings) - len(active)
+    lines.append(f"zoolint: {len(active)} finding(s), "
+                 f"{n_sup} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    """Machine format: ``{findings: [...], summary: {...}}``."""
+    active = [f for f in findings if not f.suppressed]
+    doc = {
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.col, f.rule))],
+        "summary": {
+            "total": len(active),
+            "suppressed": len(findings) - len(active),
+            "by_severity": {
+                str(sev): sum(1 for f in active if f.severity == sev)
+                for sev in Severity
+                if any(f.severity == sev for f in active)
+            },
+            "by_rule": {
+                rule: sum(1 for f in active if f.rule == rule)
+                for rule in sorted({f.rule for f in active})
+            },
+        },
+    }
+    return json.dumps(doc, indent=2)

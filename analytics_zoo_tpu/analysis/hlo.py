@@ -1,0 +1,425 @@
+"""Tier-2 HLO graph lint + analytic cost extraction.
+
+Every AOT compile in the repo funnels through
+:func:`analytics_zoo_tpu.common.compile_cache.timed_compile`; this
+module hooks that choke point to inspect the lowered StableHLO module
+TEXT without executing it.  Two outputs per compile:
+
+**Findings** (graph smells that are invisible at runtime):
+
+- ``hlo-f64``: a f64-typed op in a TPU-bound program runs on the slow
+  path (or silently upcasts memory traffic 2x) — almost always an
+  accidental Python-float promotion;
+- ``hlo-host-callback``: a ``custom_call`` to a Python callback forces
+  a device->host->device round trip EVERY step;
+- ``hlo-all-gather``: an all-gather in a program that only expected
+  gradient reductions usually means a sharding mismatch is resharding
+  params every dispatch;
+- ``hlo-large-constant``: a non-splat constant over the size threshold
+  was baked into the graph (a closed-over numpy array) — it bloats the
+  executable and the persistent-cache entry, and defeats donation.
+
+**Analytic cost features** (the TpuGraphs direction, arXiv:2308.13490 —
+config quality as prediction over the compiled graph; these are the
+first inputs of the ROADMAP's cost-model-driven compile plane):
+
+- ``matmul_flops``: 2 * prod(out) * prod(contract) summed over
+  ``dot_general``/``dot``/``convolution`` ops — the MXU term, exact
+  for dots (hand-countable, pinned by tests);
+- ``bytes_accessed``: operand + result bytes summed over ops — the
+  HBM-traffic term (approximate: fusion not modelled);
+- ``collective_count`` / ``collective_bytes``: cross-chip traffic;
+- ``fused_dispatch_count``: ``stablehlo.while`` ops (one per
+  ``lax.scan``/``fori_loop`` — the K-step fused dispatch shape).
+
+Loop bodies and outlined ``func.call`` targets count ONCE: these are
+*static graph* features (per-trace), not per-execution totals —
+exactly what a cost model over the compiled graph consumes.
+
+Per compile the features land in the ``zoo_hlo_*`` registry metrics
+(scrapeable at ``/metrics`` and ``/varz``), in one ``hlo_lint`` flight-
+recorder event (a crash dump says what was compiled), and — when
+``ZOO_HLO_REPORT_DIR`` is set — in a JSON report file (schema
+``zoo-hlo-report/1``, documented in ``docs/static-analysis.md``).
+Disable the whole tier with ``ZOO_HLO_LINT=0``; the hook never raises
+into the compile path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+__all__ = ["HloReport", "analyze_hlo_text", "lint_lowered",
+           "maybe_lint_lowered", "DEFAULT_CONSTANT_THRESHOLD"]
+
+#: constants larger than this (bytes) baked into the graph are findings
+DEFAULT_CONSTANT_THRESHOLD = 1 << 20
+
+#: collective kinds a data-parallel train step is EXPECTED to contain
+#: (a sorted tuple, not a set — its repr appears in generated API docs)
+DEFAULT_EXPECTED_COLLECTIVES = (
+    "all_reduce", "collective_permute", "reduce_scatter")
+
+_COLLECTIVE_OPS = frozenset(
+    {"all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+     "collective_permute", "collective_broadcast"})
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3B11FNUZ": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1, "i4": 1,
+    "complex<f32>": 8, "complex<f64>": 16,
+}
+
+_OP_RE = re.compile(r'"?stablehlo\.([a-z0-9_]+)"?')
+_TENSOR_RE = re.compile(r"tensor<([^<>]*(?:<[^<>]*>)?[^<>]*)>")
+_CONTRACT_RE = re.compile(
+    r"contracting_dims\s*=\s*\[([0-9,\s]*)\]\s*x\s*\[([0-9,\s]*)\]")
+_CUSTOM_CALL_RE = re.compile(r"custom_call\s+@([\w$.]+)")
+_KERNEL_OUT_RE = re.compile(r"\]x\[([^\]]*)\]")
+
+
+@dataclass
+class _TensorType:
+    dims: tuple
+    dtype: str
+
+    @property
+    def elements(self) -> int:
+        return int(math.prod(self.dims)) if self.dims else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.elements * _DTYPE_BYTES.get(self.dtype, 4)
+
+
+def _parse_tensor(spec: str) -> _TensorType:
+    """``8x16xf32`` / ``f32`` / ``1x8x!quant...`` -> dims + dtype."""
+    parts = spec.split("x")
+    dims, i = [], 0
+    while i < len(parts) and parts[i].isdigit():
+        dims.append(int(parts[i]))
+        i += 1
+    dtype = "x".join(parts[i:]) or "f32"
+    return _TensorType(tuple(dims), dtype.strip())
+
+
+def _types_in(text: str) -> list[_TensorType]:
+    return [_parse_tensor(m) for m in _TENSOR_RE.findall(text)]
+
+
+@dataclass
+class HloReport:
+    """Analytic features + findings for one lowered module."""
+
+    label: str = "module"
+    op_count: int = 0
+    matmul_flops: int = 0
+    bytes_accessed: int = 0
+    collective_count: int = 0
+    collective_bytes: int = 0
+    fused_dispatch_count: int = 0
+    collectives: dict = field(default_factory=dict)
+    op_histogram: dict = field(default_factory=dict)
+    findings: list = field(default_factory=list)
+
+    def features(self) -> dict:
+        """The flat feature dict exported to metrics / JSON — the cost-
+        model input vector."""
+        return {
+            "op_count": self.op_count,
+            "matmul_flops": self.matmul_flops,
+            "bytes_accessed": self.bytes_accessed,
+            "collective_count": self.collective_count,
+            "collective_bytes": self.collective_bytes,
+            "fused_dispatch_count": self.fused_dispatch_count,
+        }
+
+    def to_doc(self) -> dict:
+        return {
+            "schema": "zoo-hlo-report/1",
+            "label": self.label,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "features": self.features(),
+            "collectives": dict(self.collectives),
+            "op_histogram": dict(self.op_histogram),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def _dot_flops(line: str, operands: list, result: list) -> int:
+    """2 * prod(out) * prod(lhs contracting dims); exact for dots."""
+    if not result:
+        return 0
+    out_elems = result[0].elements
+    m = _CONTRACT_RE.search(line)
+    contract = 1
+    if m and operands:
+        lhs = operands[0]
+        for d in m.group(1).split(","):
+            d = d.strip()
+            if d.isdigit() and int(d) < len(lhs.dims):
+                contract *= lhs.dims[int(d)]
+    elif operands and operands[0].dims:
+        # plain `stablehlo.dot`: contraction over lhs's last dim
+        contract = operands[0].dims[-1]
+    return 2 * out_elems * contract
+
+
+def _conv_flops(line: str, operands: list, result: list) -> int:
+    """2 * prod(out) * (prod(kernel) / out_channels): approximate —
+    grouped/dilated convs are over-counted; exact for the common case."""
+    if len(operands) < 2 or not result:
+        return 0
+    kernel, out = operands[1], result[0]
+    k_elems = kernel.elements
+    out_ch = 1
+    m = _KERNEL_OUT_RE.search(line)
+    if m:
+        spec = [s.strip() for s in m.group(1).split(",")]
+        if "o" in spec and len(kernel.dims) == len(spec):
+            out_ch = kernel.dims[spec.index("o")] or 1
+    return 2 * out.elements * max(k_elems // max(out_ch, 1), 1)
+
+
+def analyze_hlo_text(
+        text: str, label: str = "module",
+        constant_threshold: int = DEFAULT_CONSTANT_THRESHOLD,
+        expected_collectives=DEFAULT_EXPECTED_COLLECTIVES) -> HloReport:
+    """Parse a StableHLO module's text into features + findings.
+
+    Line-based: each op contributes its operand/result tensor types
+    (from the inline `` : (T...) -> T`` signature, the region-closing
+    ``}) : ...`` line, or the single-type elementwise form).  The
+    parser is deliberately tolerant — an unrecognised line simply
+    contributes nothing.
+    """
+    rpt = HloReport(label=label)
+    f64_lines = 0
+    # region ops (all_reduce etc.) put their signature on the closing
+    # `}) : (...) -> ...` line — remember which op is waiting for it
+    pending: list[tuple[str, str]] = []  # (op, original line)
+
+    def account(op: str, line: str, lineno: int):
+        sig = line.rpartition(" : ")[2].strip() if " : " in line else ""
+        operands: list[_TensorType] = []
+        results: list[_TensorType] = []
+        if sig.startswith("("):
+            depth, split_at = 0, len(sig)
+            for i, ch in enumerate(sig):
+                depth += ch == "("
+                depth -= ch == ")"
+                if depth == 0:
+                    split_at = i
+                    break
+            operands = _types_in(sig[:split_at + 1])
+            results = _types_in(sig[split_at + 1:])
+        elif sig:
+            # single-type form: `add %a, %b : tensor<8xf32>` (operands
+            # and result share the type) or the while header's
+            # comma-list of carried types
+            results = _types_in(sig)
+            if op not in ("while", "constant", "return", "iota"):
+                operands = list(results)
+
+        rpt.op_count += 1
+        rpt.op_histogram[op] = rpt.op_histogram.get(op, 0) + 1
+        rpt.bytes_accessed += sum(t.nbytes for t in operands) + \
+            sum(t.nbytes for t in results)
+
+        if op in ("dot_general", "dot"):
+            rpt.matmul_flops += _dot_flops(line, operands, results)
+        elif op == "convolution":
+            rpt.matmul_flops += _conv_flops(line, operands, results)
+        elif op == "while":
+            rpt.fused_dispatch_count += 1
+        elif op in _COLLECTIVE_OPS:
+            rpt.collective_count += 1
+            rpt.collectives[op] = rpt.collectives.get(op, 0) + 1
+            rpt.collective_bytes += sum(t.nbytes for t in results)
+            if op not in expected_collectives:
+                rpt.findings.append(Finding(
+                    rule="hlo-all-gather" if "gather" in op
+                    else "hlo-collective", severity=Severity.WARNING,
+                    path=label, line=lineno,
+                    message=f"unexpected `{op}` in the graph — in a "
+                    "data-parallel step this usually means a sharding "
+                    "mismatch is regathering state every dispatch",
+                    data={"op": op}))
+        elif op == "custom_call":
+            m = _CUSTOM_CALL_RE.search(line)
+            target = m.group(1) if m else "?"
+            if re.search(r"callback|python|py_", target, re.IGNORECASE):
+                rpt.findings.append(Finding(
+                    rule="hlo-host-callback", severity=Severity.WARNING,
+                    path=label, line=lineno,
+                    message=f"host callback `{target}` baked into the "
+                    "graph — every dispatch pays a device->host->device "
+                    "round trip", data={"target": target}))
+        elif op == "constant" and results:
+            splat = re.search(r"dense<[^\[\"]", line) is not None
+            size = results[0].nbytes
+            if not splat and size > constant_threshold:
+                rpt.findings.append(Finding(
+                    rule="hlo-large-constant", severity=Severity.WARNING,
+                    path=label, line=lineno,
+                    message=f"{size} byte constant baked into the graph "
+                    "(a closed-over host array?) — pass it as an "
+                    "argument so it is not re-serialized per executable",
+                    data={"bytes": size}))
+
+        nonlocal f64_lines
+        if any(t.dtype == "f64" for t in operands + results):
+            f64_lines += 1
+            if f64_lines == 1:
+                rpt.findings.append(Finding(
+                    rule="hlo-f64", severity=Severity.WARNING,
+                    path=label, line=lineno,
+                    message="f64 op in the graph (first of several?) — "
+                    "TPUs emulate f64 at a fraction of f32 throughput; "
+                    "an accidental Python-float promotion is the usual "
+                    "cause", data={"op": op}))
+
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if pending and line.startswith("})") and " : " in line:
+            op, op_line = pending.pop()
+            account(op, op_line + " " + line, lineno)
+            continue
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        if op == "return":
+            continue
+        if line.endswith("({") or line.endswith("{") and " : " not in line:
+            # region op, signature comes on the closing line
+            pending.append((op, line))
+            continue
+        account(op, line, lineno)
+
+    if f64_lines > 1:
+        # summarize instead of one finding per op — the first finding
+        # carries the location, this one the magnitude
+        rpt.findings.append(Finding(
+            rule="hlo-f64", severity=Severity.WARNING, path=label, line=0,
+            message=f"{f64_lines} f64-typed ops total in this module",
+            data={"count": f64_lines}))
+    return rpt
+
+
+# ---------------------------------------------------------------------------
+# The timed_compile hook: metrics + flight record + JSON report.
+# ---------------------------------------------------------------------------
+
+_report_seq = 0
+_report_lock = threading.Lock()
+
+
+def _emit_metrics(rpt: HloReport) -> None:
+    from analytics_zoo_tpu.metrics import get_registry
+
+    reg = get_registry()
+    gauges = {
+        "zoo_hlo_flops":
+            ("analytic matmul (MXU) FLOPs of the lowered module",
+             rpt.matmul_flops),
+        "zoo_hlo_bytes_accessed":
+            ("analytic operand+result bytes touched by the lowered "
+             "module (fusion not modelled)", rpt.bytes_accessed),
+        "zoo_hlo_collectives":
+            ("collective ops in the lowered module",
+             rpt.collective_count),
+        "zoo_hlo_collective_bytes":
+            ("bytes moved by collective ops in the lowered module",
+             rpt.collective_bytes),
+        "zoo_hlo_fused_dispatches":
+            ("while loops (lax.scan / fori_loop) in the lowered module",
+             rpt.fused_dispatch_count),
+        "zoo_hlo_ops":
+            ("total StableHLO ops in the lowered module", rpt.op_count),
+        "zoo_hlo_findings":
+            ("HLO lint findings for the lowered module",
+             len(rpt.findings)),
+    }
+    for name, (help_, value) in gauges.items():
+        reg.gauge(name, help_, ("label",)).labels(
+            label=rpt.label).set(value)
+    counter = reg.counter("zoo_hlo_lint_findings_total",
+                          "HLO lint findings by rule", ("rule",))
+    for f in rpt.findings:
+        counter.labels(rule=f.rule).inc()
+
+
+def _write_report(rpt: HloReport, report_dir: str) -> str | None:
+    global _report_seq
+    with _report_lock:
+        _report_seq += 1
+        seq = _report_seq
+    safe = re.sub(r"[^\w.-]", "_", rpt.label)
+    path = os.path.join(report_dir,
+                        f"hlo-{safe}-{os.getpid()}-{seq}.json")
+    try:
+        os.makedirs(report_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rpt.to_doc(), f, indent=2)
+        os.replace(tmp, path)
+    except OSError:
+        return None  # reports are best-effort; never fail the compile
+    return path
+
+
+def lint_lowered(lowered, label: str = "module",
+                 report_dir: str | None = None) -> HloReport:
+    """Analyze a ``jax.jit(f).lower(...)`` result: findings + features
+    into metrics, the flight recorder and (optionally) a JSON report.
+
+    ``report_dir`` defaults to ``ZOO_HLO_REPORT_DIR``; pass a path to
+    force a report, or rely on the env knob.
+    """
+    text = lowered.as_text()
+    rpt = analyze_hlo_text(text, label=label)
+    _emit_metrics(rpt)
+
+    from analytics_zoo_tpu.metrics import get_flight_recorder
+
+    # the crash-dump answer to "what was compiled?": one event per
+    # compile with the feature vector and the lint verdict
+    get_flight_recorder().record(
+        "hlo_lint", label=label, **rpt.features(),
+        findings=[f.rule for f in rpt.findings])
+
+    for f in rpt.findings:
+        logger.warning("hlo-lint[%s]: %s (%s)", label, f.message, f.rule)
+
+    report_dir = report_dir or os.environ.get("ZOO_HLO_REPORT_DIR")
+    if report_dir:
+        _write_report(rpt, report_dir)
+    return rpt
+
+
+def maybe_lint_lowered(lowered, label: str = "module") \
+        -> HloReport | None:
+    """The guarded entry :func:`timed_compile` calls: no-op under
+    ``ZOO_HLO_LINT=0``, and NEVER raises into the compile path."""
+    if os.environ.get("ZOO_HLO_LINT", "1") == "0":
+        return None
+    try:
+        return lint_lowered(lowered, label)
+    except Exception:  # the lint must never take a compile down
+        logger.debug("hlo lint failed for %s", label, exc_info=True)
+        return None

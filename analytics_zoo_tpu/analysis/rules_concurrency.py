@@ -1,0 +1,249 @@
+"""Concurrency pitfall rules (Tier 1).
+
+The threaded planes (prefetch producer pool, pipelined serving
+reader/writer, infeed feeder, metrics HTTP server, heartbeats) share
+mutable state whose locking discipline pytest cannot check — a lost
+write needs the right interleaving; a deadlock needs the wrong one.
+These rules make the discipline declarative and machine-checked:
+
+- ``guarded-by``: an attribute initialised with a ``# guarded-by:
+  <lock>`` comment may only be WRITTEN (assignment, augmented
+  assignment, item write, mutating method call) inside ``with
+  self.<lock>:``.  ``__init__``/``__post_init__`` are exempt (the
+  object is not yet shared), as is the annotated declaration line
+  itself.  Reads are deliberately unchecked — the codebase uses
+  intentional lock-free reads (double-checked creation, monotonic
+  snapshots); checking them would bury the real signal.
+- ``lock-order``: two locks nested in opposite orders in different
+  functions is the classic ABBA deadlock.  Lock-looking context
+  managers (``with self._lock:`` where the name contains "lock") are
+  tracked per module; the pair graph must stay acyclic.
+- ``bare-except``: a bare ``except:`` swallows ``SystemExit`` /
+  ``KeyboardInterrupt``; on a daemon thread it turns a crash into a
+  silent wedge the health model then has to catch at the /healthz
+  level.  Handlers that re-raise are allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from analytics_zoo_tpu.analysis.astlint import LintModule, Rule
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+from analytics_zoo_tpu.analysis.rules_jax import MUTATING_METHODS
+
+__all__ = ["CONCURRENCY_RULES", "GuardedByRule", "LockOrderRule",
+           "BareExceptRule"]
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _root_self_attr(node: ast.AST) -> str | None:
+    """The self-attribute at the root of an expression chain:
+    ``self._q[...]`` / ``self._q.items`` -> ``_q``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        attr = _self_attr(node)
+        if attr is not None:
+            return attr
+        node = node.value
+    return None
+
+
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    severity = Severity.ERROR
+    description = ("write to a `# guarded-by: <lock>` attribute without "
+                   "the lock held")
+
+    def _declared_guards(self, mod: LintModule,
+                         cls: ast.ClassDef) -> dict[str, str]:
+        """{attr: lock} from annotated initialising assignments."""
+        guards: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = mod.guarded_by_lines.get(node.lineno)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    guards[attr] = lock
+        return guards
+
+    @staticmethod
+    def _lock_held(mod: LintModule, node: ast.AST, lock: str) -> bool:
+        for anc in mod.ancestors(node):
+            if not isinstance(anc, (ast.With, ast.AsyncWith)):
+                continue
+            for item in anc.items:
+                q = mod.qualname(item.context_expr)
+                if q in (f"self.{lock}", lock):
+                    return True
+        return False
+
+    @staticmethod
+    def _flatten_targets(t) -> Iterator[ast.AST]:
+        """Expand tuple/list/starred assignment targets to their leaves
+        (``self._a, x = ...`` writes self._a just as surely)."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from GuardedByRule._flatten_targets(e)
+        elif isinstance(t, ast.Starred):
+            yield from GuardedByRule._flatten_targets(t.value)
+        else:
+            yield t
+
+    def _writes(self, method) -> Iterator[tuple]:
+        """(node, attr, how) write events against self attributes."""
+        for node in ast.walk(method):
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                raw = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                targets = [leaf for t in raw
+                           for leaf in self._flatten_targets(t)]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        yield node, attr, "assignment"
+                        continue
+                    if isinstance(t, ast.Subscript):
+                        attr = _root_self_attr(t)
+                        if attr is not None:
+                            yield node, attr, "item assignment"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATING_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    yield node, attr, f".{node.func.attr}() call"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = _root_self_attr(t)
+                    if attr is not None:
+                        yield node, attr, "del"
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guards = self._declared_guards(mod, cls)
+            if not guards:
+                continue
+            declared_lines = {ln for ln in mod.guarded_by_lines}
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)) \
+                        or method.name in _EXEMPT_METHODS:
+                    continue
+                for node, attr, how in self._writes(method):
+                    lock = guards.get(attr)
+                    if lock is None or node.lineno in declared_lines:
+                        continue
+                    if not self._lock_held(mod, node, lock):
+                        yield self.finding(
+                            mod, node,
+                            f"{how} to `self.{attr}` (guarded-by "
+                            f"`{lock}`) in `{cls.name}.{method.name}` "
+                            f"without `with self.{lock}:` held",
+                            attribute=attr, lock=lock,
+                            method=f"{cls.name}.{method.name}")
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    severity = Severity.WARNING
+    description = ("locks acquired in opposite nesting orders in "
+                   "different functions (ABBA deadlock shape)")
+
+    @staticmethod
+    def _lock_id(mod: LintModule, cls_name: str | None,
+                 expr: ast.AST) -> str | None:
+        q = mod.qualname(expr)
+        if q is None:
+            return None
+        base = q.rsplit(".", 1)[-1]
+        if "lock" not in base.lower():
+            return None
+        if q.startswith("self."):
+            return f"{cls_name or '?'}.{q[5:]}"
+        return q
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        # pair (outer, inner) -> (node of inner acquisition, fn name)
+        pairs: dict[tuple, tuple] = {}
+
+        def enclosing_class(fn) -> str | None:
+            for anc in mod.ancestors(fn):
+                if isinstance(anc, ast.ClassDef):
+                    return anc.name
+            return None
+
+        def walk(node, held: tuple, cls_name, fn_name):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self._lock_id(mod, cls_name, item.context_expr)
+                    if lid is not None:
+                        for h in held:
+                            pairs.setdefault((h, lid), (node, fn_name))
+                        held = held + (lid,)
+                for child in node.body:
+                    walk(child, held, cls_name, fn_name)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, cls_name, fn_name)
+
+        for fn in mod.functions():
+            cls_name = enclosing_class(fn)
+            for stmt in fn.body:
+                walk(stmt, (), cls_name, fn.name)
+
+        reported = set()
+        for (a, b), (node, fn_name) in sorted(
+                pairs.items(), key=lambda kv: kv[1][0].lineno):
+            if (b, a) in pairs and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_node, other_fn = pairs[(b, a)]
+                yield self.finding(
+                    mod, node,
+                    f"lock `{b}` acquired under `{a}` in `{fn_name}` "
+                    f"but `{a}` is acquired under `{b}` in "
+                    f"`{other_fn}` (line {other_node.lineno}) — "
+                    "inconsistent order can deadlock",
+                    locks=[a, b], other_line=other_node.lineno)
+
+
+class BareExceptRule(Rule):
+    name = "bare-except"
+    severity = Severity.WARNING
+    description = ("bare `except:` swallows SystemExit/KeyboardInterrupt "
+                   "— on a daemon thread it wedges silently")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                reraises = any(isinstance(n, ast.Raise)
+                               for b in node.body for n in ast.walk(b))
+                if not reraises:
+                    yield self.finding(
+                        mod, node,
+                        "bare `except:` swallows SystemExit and "
+                        "KeyboardInterrupt — a daemon thread dies into "
+                        "a silent wedge; catch `Exception` (or "
+                        "re-raise)")
+
+
+CONCURRENCY_RULES = (GuardedByRule(), LockOrderRule(), BareExceptRule())

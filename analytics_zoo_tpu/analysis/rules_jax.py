@@ -1,0 +1,279 @@
+"""JAX pitfall rules (Tier 1).
+
+Four detectors for the hazards a jitted codebase cannot surface at
+runtime: the symptom of each is silent wrongness or silent slowness,
+never an exception.
+
+- ``jit-side-effect``: a Python side effect (``print``, ``time.time``,
+  ``np.random``, ...) inside a jit-decorated or scan-traced function
+  runs ONCE at trace time and never again — timing reads trace time,
+  prints vanish, np.random freezes one sample into the graph.
+- ``prng-reuse``: the same PRNG key consumed by two sampling calls
+  without an intervening ``split``/``fold_in`` yields identical (not
+  independent) draws.
+- ``host-sync``: ``.block_until_ready()`` / ``np.asarray`` /
+  ``float()``/``int()`` on arrays inside a ``# zoolint: hot-path``
+  annotated function stalls the dispatch pipeline — the async-dispatch
+  win the fit loop / serving cycle / prefetch plane exists to get.
+- ``nondonated-carry``: a jit over a training-carry signature
+  (``opt_state``/``carry``) without ``donate_argnums`` doubles peak
+  memory — the old buffers stay live across the update.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from analytics_zoo_tpu.analysis.astlint import (
+    LintModule,
+    Rule,
+    _JIT_NAMES,
+    _PARTIAL_NAMES,
+    scope_walk,
+)
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+
+__all__ = ["JAX_RULES", "JitSideEffectRule", "PrngReuseRule",
+           "HostSyncRule", "NonDonatedCarryRule"]
+
+# Calls that are host side effects when traced.  Exact qualnames plus
+# the numpy.random.* / random.* families.
+_SIDE_EFFECT_EXACT = {
+    "print": "output goes to the TRACE, not the run — use jax.debug.print",
+    "time.time": "reads TRACE time once, then is a baked-in constant",
+    "time.time_ns": "reads TRACE time once, then is a baked-in constant",
+    "time.perf_counter":
+        "reads TRACE time once, then is a baked-in constant",
+    "time.monotonic": "reads TRACE time once, then is a baked-in constant",
+    "time.sleep": "sleeps at trace time only; no-op in the compiled step",
+    "input": "blocks tracing; never runs in the compiled step",
+    "breakpoint": "fires at trace time only — use jax.debug.breakpoint",
+}
+_SIDE_EFFECT_PREFIXES = {
+    "numpy.random.":
+        "samples ONCE at trace time — the same values replay every "
+        "step; use jax.random with a per-step key",
+    "random.": "samples ONCE at trace time — the same values replay "
+               "every step; use jax.random with a per-step key",
+}
+
+# jax.random attrs that DERIVE keys rather than consume them for
+# sampling — exempt both as calls and as reuse producers.
+_KEY_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data",
+                 "key_data", "clone", "key_impl"}
+
+_CARRY_PARAMS = {"opt_state", "carry"}
+
+# Methods that mutate their receiver in place (list/set/dict/deque API
+# union) — used by the guarded-by rule too.
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popitem", "popleft", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+
+class JitSideEffectRule(Rule):
+    name = "jit-side-effect"
+    severity = Severity.ERROR
+    description = ("Python side effect (print / time.* / np.random / "
+                   "random) inside a jit- or scan-traced function")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        seen: set = set()
+        # descending lineno order: an inner traced def is walked before
+        # its enclosing traced def, so a call is attributed to the
+        # INNERMOST function deterministically (mod.traced is a set —
+        # raw iteration order would flip the attribution run-to-run)
+        for fn in sorted(mod.traced,
+                         key=lambda f: getattr(f, "lineno", 0),
+                         reverse=True):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                q = mod.qualname(node.func)
+                if q is None:
+                    continue
+                why = _SIDE_EFFECT_EXACT.get(q)
+                if why is None:
+                    for prefix, reason in _SIDE_EFFECT_PREFIXES.items():
+                        if q.startswith(prefix):
+                            why = reason
+                            break
+                if why is None:
+                    continue
+                fname = getattr(fn, "name", "<lambda>")
+                yield self.finding(
+                    mod, node,
+                    f"`{q}` inside traced function `{fname}`: {why}",
+                    call=q, function=fname)
+
+
+class PrngReuseRule(Rule):
+    name = "prng-reuse"
+    severity = Severity.WARNING
+    description = ("PRNG key passed to two sampling calls without "
+                   "split/fold_in between them")
+
+    def _events(self, mod: LintModule, fn) -> list:
+        """(line, col, kind, var) events in source order: 'use' = key
+        var consumed by a jax.random sampler, 'def' = var reassigned.
+        Scope-limited: nested defs/lambdas hold their OWN key scopes
+        (they are checked separately), so their events must not bleed
+        into the enclosing function's reuse tracking."""
+        events = []
+        for node in scope_walk(fn):
+            if isinstance(node, ast.Call):
+                q = mod.qualname(node.func)
+                if q and q.startswith("jax.random.") \
+                        and q.rsplit(".", 1)[1] not in _KEY_DERIVERS \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    events.append((node.lineno, node.col_offset, "use",
+                                   node.args[0].id, node))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            events.append((leaf.lineno, leaf.col_offset,
+                                           "def", leaf.id, node))
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name):
+                events.append((node.target.lineno, node.target.col_offset,
+                               "def", node.target.id, node))
+        return sorted(events, key=lambda e: (e[0], e[1]))
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for fn in mod.functions():
+            used: dict[str, int] = {}
+            for line, _col, kind, var, node in self._events(mod, fn):
+                if kind == "def":
+                    used.pop(var, None)
+                elif var in used:
+                    yield self.finding(
+                        mod, node,
+                        f"PRNG key `{var}` reused (first consumed at "
+                        f"line {used[var]}) without split/fold_in — "
+                        "identical draws, not independent ones",
+                        key=var, first_use_line=used[var])
+                else:
+                    used[var] = line
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+    severity = Severity.WARNING
+    description = ("device sync (block_until_ready / np.asarray / "
+                   "float()/int() on arrays) inside a "
+                   "`# zoolint: hot-path` function")
+
+    _SYNC_QUALNAMES = {
+        "jax.block_until_ready", "jax.device_get",
+        "numpy.asarray", "numpy.array",
+    }
+
+    def _in_hot_path(self, mod: LintModule, node: ast.AST) -> bool:
+        fn = mod.enclosing_function(node)
+        while fn is not None:
+            if mod.is_hot_path(fn):
+                return True
+            fn = mod.enclosing_function(fn)
+        return False
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                what = ".block_until_ready()"
+            else:
+                q = mod.qualname(node.func)
+                if q in self._SYNC_QUALNAMES:
+                    what = q
+                elif q in ("float", "int") and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant):
+                    what = f"{q}()"
+            if what is None or not self._in_hot_path(mod, node):
+                continue
+            yield self.finding(
+                mod, node,
+                f"{what} on a hot path forces a host/device sync — "
+                "it stalls async dispatch until the device catches up; "
+                "move it off the hot path or suppress with a "
+                "justification if the sync (or host-only data) is "
+                "intentional",
+                call=what)
+
+
+class NonDonatedCarryRule(Rule):
+    name = "nondonated-carry"
+    severity = Severity.WARNING
+    description = ("jit over a training-carry signature without "
+                   "donate_argnums — old buffers stay live, doubling "
+                   "peak memory")
+
+    @staticmethod
+    def _donates(call: ast.Call) -> bool:
+        return any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in call.keywords)
+
+    def _carry_params(self, fn) -> list[str]:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return []
+        names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+        return [n for n in names if n in _CARRY_PARAMS]
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        defs_by_name: dict[str, list] = {}
+        for fn in mod.functions():
+            defs_by_name.setdefault(fn.name, []).append(fn)
+
+        def jit_no_donate(expr) -> bool:
+            """expr is a bare-jit reference or a jit call with no
+            donation kwargs."""
+            if mod.qualname(expr) in _JIT_NAMES:
+                return True
+            if isinstance(expr, ast.Call):
+                if mod.qualname(expr.func) in _JIT_NAMES:
+                    return not self._donates(expr)
+                if mod.qualname(expr.func) in _PARTIAL_NAMES \
+                        and expr.args \
+                        and mod.qualname(expr.args[0]) in _JIT_NAMES:
+                    return not self._donates(expr)
+            return False
+
+        for fn in mod.functions():
+            carries = self._carry_params(fn)
+            if not carries:
+                continue
+            for dec in fn.decorator_list:
+                if jit_no_donate(dec):
+                    yield self.finding(
+                        mod, fn,
+                        f"`{fn.name}` carries {carries} but its jit "
+                        "does not donate them — pass donate_argnums "
+                        "so the update reuses the old buffers",
+                        function=fn.name, carries=carries)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and mod.qualname(node.func) in _JIT_NAMES \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and not self._donates(node):
+                for fn in defs_by_name.get(node.args[0].id, ()):
+                    carries = self._carry_params(fn)
+                    if carries:
+                        yield self.finding(
+                            mod, node,
+                            f"jit of `{fn.name}` (carries {carries}) "
+                            "without donate_argnums — old buffers stay "
+                            "live, doubling peak memory",
+                            function=fn.name, carries=carries)
+
+
+JAX_RULES = (JitSideEffectRule(), PrngReuseRule(), HostSyncRule(),
+             NonDonatedCarryRule())

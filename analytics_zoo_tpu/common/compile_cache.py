@@ -169,7 +169,22 @@ def timed_compile(lowered, label: str):
     Records ``zoo_compile_seconds{label=}`` and increments the
     hit/miss counter pair; returns the compiled executable.  ``lowered``
     is whatever ``jax.jit(f).lower(*args)`` returned.
+
+    The HLO graph lint (``analytics_zoo_tpu.analysis.hlo``) rides this
+    choke point: the lowered module text is inspected BEFORE the
+    compile — f64 ops / host callbacks / unexpected all-gathers /
+    oversized baked constants become logged findings, and the analytic
+    cost features (matmul FLOPs, bytes, collective count/bytes,
+    fused-dispatch count) land in ``zoo_hlo_*{label=}`` metrics, the
+    flight recorder and the optional ``ZOO_HLO_REPORT_DIR`` JSON
+    report.  Linting before compiling means a crash during XLA
+    compilation still leaves "what was being compiled" in the flight
+    ring.  Disable with ``ZOO_HLO_LINT=0``; lint errors never
+    propagate into the compile.
     """
+    from analytics_zoo_tpu.analysis.hlo import maybe_lint_lowered
+
+    maybe_lint_lowered(lowered, label)
     hist, hits, misses = _metrics(label)
     before = _cache_entries()
     t0 = time.perf_counter()

@@ -122,6 +122,14 @@ class ZooConfig:
       ZOO_FLIGHT_DIR           arm the crash flight recorder's dump
                                (metrics/flight.py; ZOO_FLIGHT=0 disables,
                                ZOO_FLIGHT_EVENTS caps the ring)
+      ZOO_HLO_LINT             "0" disables the HLO graph lint + cost
+                               extraction riding every timed_compile
+                               (analysis/hlo.py; default on — zoo_hlo_*
+                               metrics, flight hlo_lint events)
+      ZOO_HLO_REPORT_DIR       when set, every compile additionally
+                               writes a zoo-hlo-report/1 JSON file with
+                               the analytic features + findings
+                               (docs/static-analysis.md)
     """
 
     app_name: str = "analytics-zoo-tpu"

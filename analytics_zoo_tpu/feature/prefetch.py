@@ -109,6 +109,7 @@ class PrefetchPipeline:
         """The worker pool — ShardedFeatureSet read-ahead rides it too."""
         return self._pool
 
+    # zoolint: hot-path
     def _put(self, item) -> bool:
         """Bounded put that respects close(); False when shut down.
 
@@ -129,6 +130,7 @@ class PrefetchPipeline:
                 health.heartbeat(self._hc)
         return False
 
+    # zoolint: hot-path
     def _produce(self):
         health = get_health()
         health.register(self._hc, stale_after=self._stale_after)
@@ -154,6 +156,7 @@ class PrefetchPipeline:
             health.unregister(self._hc)
             self._put((_END, err))
 
+    # zoolint: hot-path
     def __iter__(self):
         while True:
             t0 = time.perf_counter()

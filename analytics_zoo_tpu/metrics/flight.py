@@ -42,12 +42,12 @@ class FlightRecorder:
         self.enabled = bool(enabled)
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
-        self.dropped = 0
-        self._events: collections.deque = collections.deque(
+        self.dropped = 0  # guarded-by: _lock
+        self._events: collections.deque = collections.deque(  # guarded-by: _lock
             maxlen=self.capacity)
         self._lock = threading.Lock()
         self._installed = False
-        self._dumped_reasons: set[str] = set()
+        self._dumped_reasons: set[str] = set()  # guarded-by: _lock
 
     # -- recording ------------------------------------------------------
     def record(self, kind: str, **fields) -> dict | None:

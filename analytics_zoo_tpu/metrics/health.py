@@ -45,7 +45,7 @@ class HealthRegistry:
         # forced is None (age-driven) or an explicit bool verdict for
         # components that are idle-OK but break-FAIL (actor connections:
         # no traffic is fine, a broken pipe is not)
-        self._components: dict[str, list] = {}
+        self._components: dict[str, list] = {}  # guarded-by: _lock
 
     def register(self, component: str,
                  stale_after: float = DEFAULT_STALE_AFTER):

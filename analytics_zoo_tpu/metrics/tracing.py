@@ -67,8 +67,8 @@ class Tracer:
         self.enabled = bool(enabled)
         self.max_events = int(max_events)
         self.jax_bridge = bool(jax_bridge)
-        self.dropped = 0
-        self._events: collections.deque = collections.deque(
+        self.dropped = 0  # guarded-by: _lock
+        self._events: collections.deque = collections.deque(  # guarded-by: _lock
             maxlen=self.max_events)
         self._lock = threading.Lock()
         # registry counter mirroring `dropped`, resolved lazily on the

@@ -174,6 +174,7 @@ class ClusterServing:
             groups[arr.shape][1].append(arr)
         return groups
 
+    # zoolint: hot-path
     def _predict_groups(self, groups) -> list:
         """Run predict per shape group; return the [(key, mapping)]
         write-back list for ONE batched broker round-trip."""
@@ -185,6 +186,7 @@ class ClusterServing:
                 preds = self.model.predict(np.stack(g_arrs))
             if isinstance(preds, list):  # multi-output: report first head
                 preds = preds[0]
+            # zoolint: disable=host-sync -- predictions must land on host for write-back; the pipelined writer overlaps it
             for uri, out in zip(g_uris, np.asarray(preds)):
                 writes.append((RESULT_PREFIX + uri,
                                self._postprocess(uri, out)))
@@ -208,11 +210,13 @@ class ClusterServing:
         logger.info("serving: batch of %d in %.1f ms", len(uris), dt * 1e3)
         return len(uris)
 
+    # zoolint: hot-path
     def step(self, block_ms: int = 100) -> int:
         """One poll + predict + write-back cycle; returns #records served."""
         ratio = self.db.memory_ratio()
         self.metrics.memory_ratio.set(ratio)
         if ratio >= self.INPUT_THRESHOLD:
+            # zoolint: disable=host-sync -- broker-side host integer, no device involved
             keep = int(self.db.xlen(INPUT_STREAM) * self.CUT_RATIO)
             self.db.xtrim(INPUT_STREAM, keep)
             self.metrics.trims.inc()
@@ -334,6 +338,7 @@ class ClusterServing:
 
     _PIPE_DEPTH = 2  # decoded micro-batches buffered ahead of predict
 
+    # zoolint: hot-path
     def _run_pipelined(self, max_records, idle_timeout, health) -> int:
         """Three-stage pipeline: reader(poll+ack+decode) → predict →
         writer(batched hset_many).  Bounded queues between stages keep
@@ -367,6 +372,7 @@ class ClusterServing:
                         ratio = self.db.memory_ratio()
                         self.metrics.memory_ratio.set(ratio)
                         if ratio >= self.INPUT_THRESHOLD:
+                            # zoolint: disable=host-sync -- broker-side host integer, no device involved
                             keep = int(self.db.xlen(INPUT_STREAM)
                                        * self.CUT_RATIO)
                             self.db.xtrim(INPUT_STREAM, keep)

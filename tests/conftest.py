@@ -40,6 +40,7 @@ QUICK_FILES = {
     "test_prefetch.py",  # host data plane + --data-pipeline bench guard
     "test_dispatch.py",  # fused scan-K dispatch + --dispatch bench guard
     "test_compile_cache.py",  # persistent compile plane
+    "test_zoolint.py",  # static analysis + package-clean CI gate
     "test_telemetry.py",  # ~9s incl. two actor spawns
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier

@@ -1,0 +1,27 @@
+"""zoolint fixture: bare-except — swallowing positive, re-raising
+negative, suppressed negative.  Never imported; linted statically."""
+
+
+def work():
+    pass
+
+
+def swallows():
+    try:
+        work()
+    except:  # POSITIVE: eats SystemExit/KeyboardInterrupt silently
+        pass
+
+
+def reraises():
+    try:
+        work()
+    except:  # no finding: the handler re-raises
+        raise
+
+
+def justified():
+    try:
+        work()
+    except:  # zoolint: disable=bare-except -- last-resort guard while the interpreter shuts down
+        pass

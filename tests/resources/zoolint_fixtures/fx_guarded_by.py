@@ -1,0 +1,38 @@
+"""zoolint fixture: guarded-by — locked negatives, unguarded-write
+positives (plain/item/augmented/mutating-call), suppressed negative.
+Never imported; linted statically."""
+
+import threading
+
+
+class SharedMap:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+        self.unguarded = 0  # no annotation: writes never flagged
+
+    def put_locked(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self.count += 1
+
+    def put_racy(self, k, v):
+        self._items[k] = v  # POSITIVE: item assignment, no lock
+        self.count += 1  # POSITIVE: augmented assignment, no lock
+
+    def evict_racy(self, k):
+        self._items.pop(k, None)  # POSITIVE: mutating call, no lock
+
+    def rebind_racy(self):
+        self._items = {}  # POSITIVE: rebinding loses concurrent writes
+
+    def tuple_racy(self, v):
+        self.count, other = v, 0  # POSITIVE: tuple-unpacking write, no lock
+        return other
+
+    def free_writes(self):
+        self.unguarded += 1  # no finding: not declared guarded
+
+    def reset_justified(self):
+        self.count = 0  # zoolint: disable=guarded-by -- only called before the worker threads start

@@ -1,0 +1,28 @@
+"""zoolint fixture: host-sync — hot-path positives, a suppressed
+negative, and an unannotated (cold) function that never fires.
+Never imported; linted statically."""
+
+import jax
+import numpy as np
+
+
+# zoolint: hot-path
+def hot_loop(batches, step_fn, params):
+    loss = None
+    for batch in batches:
+        params, loss = step_fn(params, batch)
+        val = float(loss)  # POSITIVE
+        arr = np.asarray(loss)  # POSITIVE
+        loss.block_until_ready()  # POSITIVE
+        jax.device_get(loss)  # POSITIVE
+        n = int(arr.sum())  # POSITIVE
+    return params, val, n
+
+
+# zoolint: hot-path
+def hot_justified(loss):
+    return float(loss)  # zoolint: disable=host-sync -- epoch-boundary sync, documented contract
+
+
+def cold_path(loss):
+    return float(loss)  # no finding: not annotated hot-path

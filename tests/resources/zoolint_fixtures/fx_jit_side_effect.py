@@ -1,0 +1,51 @@
+"""zoolint fixture: jit-side-effect — positives + a suppressed negative.
+
+Never imported; linted statically by tests/test_zoolint.py.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced_print(x):
+    print("tracing", x)  # POSITIVE: runs once at trace time
+    return x + 1
+
+
+def scan_body(carry, x):
+    t = time.time()  # POSITIVE: scan-traced via run() below
+    return carry + t, x
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+@jax.jit
+def traced_np_random(x):
+    noise = np.random.rand(3)  # POSITIVE: one sample baked into the graph
+    return x + noise
+
+
+def helper_called_from_traced(x):
+    print("transitively traced")  # POSITIVE: called from traced_caller
+    return x
+
+
+@jax.jit
+def traced_caller(x):
+    return helper_called_from_traced(x)
+
+
+@jax.jit
+def justified(x):
+    print("marker")  # zoolint: disable=jit-side-effect -- deliberate trace-time marker
+    return x
+
+
+def untraced(x):
+    print("plain host function — no finding")
+    return x

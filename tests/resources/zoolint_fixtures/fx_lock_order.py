@@ -1,0 +1,36 @@
+"""zoolint fixture: lock-order — an ABBA pair across two methods plus a
+consistent-order pair that must NOT fire.  Never imported; linted
+statically."""
+
+import threading
+
+
+class AbbaPair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:  # POSITIVE half: A then B ...
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:  # ... while here B then A
+                pass
+
+
+class ConsistentPair:
+    def __init__(self):
+        self._x_lock = threading.Lock()
+        self._y_lock = threading.Lock()
+
+    def one(self):
+        with self._x_lock:
+            with self._y_lock:
+                pass
+
+    def two(self):
+        with self._x_lock, self._y_lock:  # same order: no finding
+            pass

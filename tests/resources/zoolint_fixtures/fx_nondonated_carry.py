@@ -1,0 +1,30 @@
+"""zoolint fixture: nondonated-carry — decorator + call-site positives,
+donated negative, suppressed negative.  Never imported; linted
+statically."""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def step_nodonate(params, opt_state, batch):  # POSITIVE (decorator)
+    return params, opt_state
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def step_donated(params, opt_state, batch):
+    return params, opt_state
+
+
+def step_fn(params, opt_state):
+    return params, opt_state
+
+
+bad = jax.jit(step_fn)  # POSITIVE (call site)
+good = jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+@jax.jit
+def step_justified(params, opt_state, batch):  # zoolint: disable=nondonated-carry -- carries reused across probes on purpose
+    return params, opt_state

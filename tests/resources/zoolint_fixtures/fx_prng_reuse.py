@@ -1,0 +1,31 @@
+"""zoolint fixture: prng-reuse — positive + derived-key negative +
+suppressed negative.  Never imported; linted statically."""
+
+import jax
+
+
+def reused(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))  # POSITIVE: same key, same bits
+    return a + b
+
+
+def derived(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    c = jax.random.normal(jax.random.fold_in(k1, 7), (2,))
+    return a + b + c
+
+
+def reassigned(key):
+    a = jax.random.normal(key, (2,))
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.normal(key, (2,))
+    return a + b
+
+
+def justified(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # zoolint: disable=prng-reuse -- identical draws wanted (antithetic pair)
+    return a + b

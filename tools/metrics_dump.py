@@ -17,14 +17,21 @@ Metric families worth a `--prefix` of their own: `zoo_train` (fit-loop
 breakdown; under ``ZOO_STEPS_PER_DISPATCH=K`` one histogram observation
 covers a K-step fused dispatch while the steps/records counters keep
 counting real steps), `zoo_serving`, `zoo_inference`,
-`zoo_data_prefetch` (host data plane), and `zoo_compile` (the compile
+`zoo_data_prefetch` (host data plane), `zoo_compile` (the compile
 plane: `zoo_compile_seconds{label=...}` per AOT compile plus the
 `zoo_compile_cache_hits_total` / `zoo_compile_cache_misses_total` pair
-that splits cold from ``ZOO_COMPILE_CACHE``-warm starts).
+that splits cold from ``ZOO_COMPILE_CACHE``-warm starts), and
+`zoo_hlo` (the HLO graph lint's analytic cost features per compiled
+program: `zoo_hlo_flops` / `zoo_hlo_bytes_accessed` /
+`zoo_hlo_collectives` / `zoo_hlo_collective_bytes` /
+`zoo_hlo_fused_dispatches` / `zoo_hlo_ops` / `zoo_hlo_findings`, all
+`{label=<compile label>}`, plus `zoo_hlo_lint_findings_total{rule=}`
+— see docs/static-analysis.md).
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
   python tools/metrics_dump.py METRICS.jsonl --prefix zoo_compile
+  python tools/metrics_dump.py --url host:9090 --prefix zoo_hlo
   python tools/metrics_dump.py METRICS.jsonl --prometheus   # re-render
   python tools/metrics_dump.py --url http://host:9090/varz
   python tools/metrics_dump.py --url host:9090   # /varz implied

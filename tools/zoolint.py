@@ -1,0 +1,23 @@
+"""zoolint — JAX/concurrency AST linter over the repo (Tier 1 of
+``analytics_zoo_tpu.analysis``; see docs/static-analysis.md).
+
+Usage:
+  python tools/zoolint.py [paths ...]             # default: analytics_zoo_tpu/
+  python tools/zoolint.py --format json
+  python tools/zoolint.py --list-rules
+  python tools/zoolint.py --rules guarded-by,bare-except tests/
+
+Exit status: 0 clean, 1 when any unsuppressed finding exists (CI /
+pre-commit composable), 2 on usage errors.  The quick-tier gate
+``tests/test_zoolint.py::test_package_is_clean`` runs the same check.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from analytics_zoo_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
