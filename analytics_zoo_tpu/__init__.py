@@ -23,6 +23,19 @@ pyzoo/zoo/__init__.py):
 
 __version__ = "0.1.0"
 
+# The runtime sanitizer must patch threading BEFORE any package module
+# allocates a lock, so this hook runs first.  The env check happens
+# HERE so the disabled path imports nothing — with ZOO_SAN unset, no
+# analysis module loads and threading.Lock keeps its builtin identity
+# (both pinned by tests).
+import os as _os  # noqa: E402
+
+if _os.environ.get("ZOO_SAN") == "1":
+    from analytics_zoo_tpu.analysis.sanitizer import maybe_install \
+        as _zoo_san_maybe_install
+
+    _zoo_san_maybe_install()
+
 from analytics_zoo_tpu.common.engine import (  # noqa: F401
     ZooConfig,
     ZooContext,

@@ -36,6 +36,17 @@ graph features the ROADMAP's cost-model-driven compile plane
 (TpuGraphs, arXiv:2308.13490) consumes: config quality as prediction
 over the compiled graph, extracted for free at the compile choke point.
 
+**Tier 3 — whole-program + runtime sanitizer ("zoosan")**: the static
+half (:mod:`callgraph` + :mod:`rules_interproc`) links every file into
+one symbol table and call graph so lock-order cycles are found ACROSS
+modules and un-annotated lock-guarded attributes become
+``guarded-by-candidate`` findings; the dynamic half (:mod:`sanitizer`,
+``ZOO_SAN=1``) wraps the package's locks at creation time and proves
+the annotations at runtime — lockdep cycle detection with both stacks,
+``# guarded-by`` writes validated against the live lock owner, and
+blocking calls under a held lock flagged.  Zero cost when disabled:
+with ``ZOO_SAN`` unset nothing is patched.
+
 See ``docs/static-analysis.md`` for the rule catalogue, suppression
 syntax, the ``# guarded-by:`` annotation convention and the HLO report
 schema.
@@ -55,15 +66,37 @@ from analytics_zoo_tpu.analysis.astlint import (
     lint_paths,
     lint_source,
 )
-from analytics_zoo_tpu.analysis.hlo import (
-    HloReport,
-    analyze_hlo_text,
-    lint_lowered,
-)
 
 __all__ = [
     "Finding", "Severity", "render_text", "render_json",
     "Rule", "LintModule", "ALL_RULES",
     "lint_source", "lint_file", "lint_paths",
     "HloReport", "analyze_hlo_text", "lint_lowered",
+    "load_program", "lint_program", "build_lock_graph", "find_cycles",
 ]
+
+# The HLO tier and the whole-program pass load lazily (PEP 562): the
+# package __init__ imports this module BEFORE the sanitizer can patch
+# threading, and an eager `hlo` import would allocate its report lock
+# too early for the sanitizer to wrap (it would also drag the parser
+# into every `import analytics_zoo_tpu`).
+_LAZY = {
+    "HloReport": "hlo", "analyze_hlo_text": "hlo", "lint_lowered": "hlo",
+    "load_program": "callgraph",
+    "lint_program": "rules_interproc",
+    "build_lock_graph": "rules_interproc",
+    "find_cycles": "rules_interproc",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(
+            f"analytics_zoo_tpu.analysis.{_LAZY[name]}")
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

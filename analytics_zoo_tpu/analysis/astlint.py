@@ -111,6 +111,8 @@ class LintModule:
     #: line -> set of rule names disabled on that line
     suppressions: dict[int, set] = field(default_factory=dict)
     file_suppressions: set = field(default_factory=set)
+    #: suppression-comment lines missing a `` -- justification`` tail
+    unjustified_suppressions: dict[int, str] = field(default_factory=dict)
     #: lines carrying a ``# zoolint: hot-path`` annotation
     hot_path_lines: set = field(default_factory=set)
     #: line -> lock name from a ``# guarded-by: <lock>`` annotation
@@ -195,6 +197,8 @@ def _collect_comments(mod: LintModule) -> None:
         m = _SUPPRESS_RE.search(text)
         if m:
             rules = {r.strip() for r in m.group("rules").split(",")}
+            if not (m.group("why") or "").strip():
+                mod.unjustified_suppressions[line] = m.group("rules")
             if m.group("scope"):
                 mod.file_suppressions |= rules
             else:
@@ -368,10 +372,34 @@ def lint_paths(paths: Iterable[str],
     return findings
 
 
+class BareSuppressionRule(Rule):
+    """A suppression is a reviewed decision; the `` -- justification``
+    tail is where the review lives.  A bare ``# zoolint: disable=r``
+    silences a detector with no recorded reason — flagged so the
+    justification trail stays complete (CI keeps the tree at zero
+    findings, so every suppression must defend itself)."""
+
+    name = "bare-suppression"
+    severity = Severity.WARNING
+    description = ("`# zoolint: disable=` without a ` -- justification` "
+                   "tail — record why the finding is acceptable")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for line, rules in sorted(mod.unjustified_suppressions.items()):
+            yield Finding(
+                rule=self.name, severity=self.severity, path=mod.path,
+                line=line,
+                message=f"suppression of [{rules}] carries no "
+                "justification — append ` -- <why this is safe>` so "
+                "the next reader (and re-audit) knows the reasoning",
+                data={"rules": rules})
+
+
 # Assembled at the bottom so the rule modules can import the engine.
 from analytics_zoo_tpu.analysis.rules_jax import JAX_RULES  # noqa: E402
 from analytics_zoo_tpu.analysis.rules_concurrency import (  # noqa: E402
     CONCURRENCY_RULES,
 )
 
-ALL_RULES: tuple = JAX_RULES + CONCURRENCY_RULES
+ALL_RULES: tuple = JAX_RULES + CONCURRENCY_RULES \
+    + (BareSuppressionRule(),)

@@ -1,0 +1,359 @@
+"""Whole-program symbol table + call graph (Tier 3 input, "zoosan").
+
+Tier-1 rules see one file at a time, which is exactly the blind spot
+for lock discipline: an ABBA deadlock assembled from a broker lock in
+``serving/`` and a registry lock in ``metrics/`` has no single-file
+witness, and a helper that writes shared state is safe only because its
+*callers* (in another module) hold the lock.  This module parses every
+file of a package into the Tier-1 :class:`LintModule` shape and links
+them:
+
+- **Symbol table** — classes and functions by module, methods by name,
+  every ``threading.Lock``/``RLock``/``Condition`` attribute or
+  module-level lock with a canonical program-wide id
+  (``Broker._cv``, ``analytics_zoo_tpu.common.engine._LOCK``);
+- **Call graph** — call sites resolved through import aliases
+  (``from x import f``), ``self.method()`` dispatch, module-level
+  names, and unique-method-name matching (``x.hset_many()`` resolves
+  when exactly one class in the program defines ``hset_many``);
+- **Lock facts** — per function: the with-statement lock acquisitions
+  (with the locks already held at each), and the calls made while
+  holding locks.  :mod:`rules_interproc` closes these transitively
+  into the whole-package lock graph and the guarded-by inference.
+
+Resolution is deliberately conservative (a call that cannot be
+resolved contributes nothing) — the consumers gate CI, so precision
+beats recall.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from analytics_zoo_tpu.analysis.astlint import (
+    LintModule,
+    iter_python_files,
+    parse_module,
+)
+
+__all__ = ["Program", "FunctionInfo", "LockAttr", "LockAcquisition",
+           "CallSite", "load_program"]
+
+#: constructors whose result is a mutual-exclusion primitive the
+#: analyses track (Semaphore deliberately excluded: it is a counter,
+#: not a critical-section guard, so "held" has no exclusion meaning)
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+}
+
+
+@dataclass(frozen=True)
+class LockAttr:
+    """One lock-typed attribute: ``self.<attr>`` of ``cls`` (or a
+    module-level name when ``cls`` is None)."""
+
+    module: str
+    cls: str | None
+    attr: str
+    factory: str  #: e.g. ``threading.Condition``
+    line: int
+
+    @property
+    def lock_id(self) -> str:
+        # always module-qualified: two same-named classes in different
+        # modules own DIFFERENT locks, and merging them would fabricate
+        # cross-module cycles that no execution can deadlock on
+        if self.cls is not None:
+            return f"{self.module}.{self.cls}.{self.attr}"
+        return f"{self.module}.{self.attr}"
+
+
+@dataclass
+class LockAcquisition:
+    """One ``with <lock>:`` entry inside a function."""
+
+    lock_id: str
+    node: ast.With | ast.AsyncWith
+    held: tuple  #: lock ids already held (innermost last)
+
+
+@dataclass
+class CallSite:
+    """One call inside a function, with resolution candidates."""
+
+    node: ast.Call
+    held: tuple  #: lock ids held at the call
+    callees: tuple  #: resolved (module, qualname) keys, possibly empty
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method with the lock facts the interprocedural
+    rules consume."""
+
+    module: str
+    qualname: str  #: ``Class.method`` or bare function name
+    node: ast.AST
+    mod: LintModule
+    cls: str | None = None
+    acquisitions: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+
+    @property
+    def key(self) -> tuple:
+        return (self.module, self.qualname)
+
+    @property
+    def location(self) -> str:
+        return f"{self.mod.path}:{self.node.lineno}"
+
+
+@dataclass
+class Program:
+    """The linked whole-package view."""
+
+    root: str
+    package: str
+    #: dotted module name -> LintModule
+    modules: dict = field(default_factory=dict)
+    #: (module, qualname) -> FunctionInfo
+    functions: dict = field(default_factory=dict)
+    #: class name -> [(module, ast.ClassDef)]
+    classes: dict = field(default_factory=dict)
+    #: method name -> [FunctionInfo] (across all classes)
+    methods_by_name: dict = field(default_factory=dict)
+    #: (module, cls or None, attr) -> LockAttr
+    lock_attrs: dict = field(default_factory=dict)
+    #: (module, class name) -> {attr -> LockAttr} for that class's locks
+    class_locks: dict = field(default_factory=dict)
+    #: module dotted name -> {name -> LockAttr} for module-level locks
+    module_locks: dict = field(default_factory=dict)
+
+    # -- lookups --------------------------------------------------------
+    def module_of_path(self, path: str) -> LintModule | None:
+        for mod in self.modules.values():
+            if mod.path == path:
+                return mod
+        return None
+
+    def function(self, module: str, qualname: str) -> FunctionInfo | None:
+        return self.functions.get((module, qualname))
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        return iter(self.functions.values())
+
+
+def _module_name(root: str, package: str, path: str) -> str:
+    rel = os.path.relpath(path, root)
+    parts = rel[:-3].split(os.sep)  # strip ".py"
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join([package] + parts) if parts else package
+
+
+def _enclosing_class(mod: LintModule, fn: ast.AST) -> str | None:
+    for anc in mod.ancestors(fn):
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None  # nested function: not a method
+    return None
+
+
+def _collect_locks(prog: Program, name: str, mod: LintModule) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        factory = mod.qualname(value.func)
+        if factory not in LOCK_FACTORIES:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                fn = mod.enclosing_function(node)
+                cls = _enclosing_class(mod, fn) if fn else None
+                if cls is not None:
+                    la = LockAttr(module=name, cls=cls, attr=t.attr,
+                                  factory=factory, line=node.lineno)
+                    prog.class_locks.setdefault(
+                        (name, cls), {})[t.attr] = la
+                    prog.lock_attrs[(name, cls, t.attr)] = la
+            elif isinstance(t, ast.Name):
+                if mod.enclosing_function(node) is not None:
+                    continue  # function-local lock: not shared state
+                cls = _enclosing_class(mod, node)
+                if cls is not None:  # class-body attribute lock
+                    la = LockAttr(module=name, cls=cls, attr=t.id,
+                                  factory=factory, line=node.lineno)
+                    prog.class_locks.setdefault(
+                        (name, cls), {})[t.id] = la
+                    prog.lock_attrs[(name, cls, t.id)] = la
+                    continue
+                la = LockAttr(module=name, cls=None, attr=t.id,
+                              factory=factory, line=node.lineno)
+                prog.module_locks.setdefault(name, {})[t.id] = la
+                prog.lock_attrs[(name, None, t.id)] = la
+
+
+def _lock_id_of_expr(prog: Program, mod: LintModule, name: str,
+                     cls: str | None, expr: ast.AST) -> str | None:
+    """Canonical program-wide lock id for a with-statement context
+    expression, or None when it is not a known lock.
+
+    Resolution order: ``self.<attr>`` against the enclosing class's
+    typed locks (module-and-class-scoped ids, plus a lock-ish-name
+    fallback scoped the same way), a dotted/bare name against
+    module-level locks (through import aliases), then
+    ``<anything>.<attr>`` against a program-unique lock attribute
+    name.  Anything unresolvable yields None: a merely lock-NAMED
+    local variable must not become a program-wide node, or two
+    unrelated locals called ``lock`` in different modules would
+    fabricate a cycle no execution can deadlock on.
+    """
+    q = mod.qualname(expr)
+    if q is None:
+        return None
+    if q.startswith("self."):
+        attr = q[5:]
+        if cls is not None \
+                and attr in prog.class_locks.get((name, cls), {}):
+            return f"{name}.{cls}.{attr}"
+        # untyped attr (e.g. a lock handed in via the constructor):
+        # the name heuristic stays module+class-scoped
+        if cls is not None \
+                and ("lock" in attr.lower() or attr.endswith("_cv")):
+            return f"{name}.{cls}.{attr}"
+        return None
+    # module-level: q is alias-resolved, e.g. pkg.common.engine._LOCK
+    head, _, leaf = q.rpartition(".")
+    if head in prog.module_locks and leaf in prog.module_locks[head]:
+        return f"{head}.{leaf}"
+    if not head and leaf in prog.module_locks.get(name, {}):
+        return f"{name}.{leaf}"
+    # `from sibling import LOCK` outside the package root resolves to a
+    # bare module name — match it against loaded modules by suffix
+    if head:
+        for mod_name, locks in prog.module_locks.items():
+            if leaf in locks and (mod_name == head
+                                  or mod_name.endswith("." + head)):
+                return f"{mod_name}.{leaf}"
+    # unique lock-attribute name anywhere in the program
+    owners = {(m, c) for (m, c, a) in prog.lock_attrs
+              if a == leaf and c is not None}
+    if len(owners) == 1:
+        ((m, c),) = owners
+        return f"{m}.{c}.{leaf}"
+    return None
+
+
+def _resolve_call(prog: Program, mod: LintModule, name: str,
+                  cls: str | None, call: ast.Call) -> tuple:
+    """Candidate (module, qualname) keys for a call node."""
+    func = call.func
+    out: list[tuple] = []
+    if isinstance(func, ast.Name):
+        target = mod.aliases.get(func.id, func.id)
+        if "." in target:  # from x import f
+            m, _, f = target.rpartition(".")
+            if (m, f) in prog.functions:
+                out.append((m, f))
+        if (name, func.id) in prog.functions:
+            out.append((name, func.id))
+    elif isinstance(func, ast.Attribute):
+        recv, attr = func.value, func.attr
+        if isinstance(recv, ast.Name) and recv.id == "self" \
+                and cls is not None:
+            if (name, f"{cls}.{attr}") in prog.functions:
+                out.append((name, f"{cls}.{attr}"))
+                return tuple(out)
+        q = mod.qualname(func)
+        if q is not None and "." in q:
+            m, _, f = q.rpartition(".")
+            if (m, f) in prog.functions:
+                out.append((m, f))
+        if not out:
+            # unique method name across the program's classes
+            owners = prog.methods_by_name.get(attr, ())
+            if len(owners) == 1:
+                out.append(owners[0].key)
+    return tuple(out)
+
+
+def _collect_function_facts(prog: Program, name: str,
+                            mod: LintModule) -> None:
+    for fn in mod.functions():
+        cls = _enclosing_class(mod, fn)
+        qual = f"{cls}.{fn.name}" if cls else fn.name
+        info = FunctionInfo(module=name, qualname=qual, node=fn,
+                            mod=mod, cls=cls)
+        key = info.key
+        if key in prog.functions:
+            continue  # first definition wins (overloads are rare)
+        prog.functions[key] = info
+        if cls is not None:
+            prog.methods_by_name.setdefault(fn.name, []).append(info)
+
+    # second pass: walk bodies with a held-lock stack, recording
+    # acquisitions and call sites (own-scope only — a nested def gets
+    # its own FunctionInfo and its own walk)
+    for info in [f for f in prog.functions.values() if f.module == name]:
+        def walk(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not info.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _lock_id_of_expr(prog, info.mod, name,
+                                           info.cls, item.context_expr)
+                    if lid is not None:
+                        info.acquisitions.append(LockAcquisition(
+                            lock_id=lid, node=node, held=held))
+                        held = held + (lid,)
+                for child in node.body:
+                    walk(child, held)
+                return
+            if isinstance(node, ast.Call):
+                callees = _resolve_call(prog, info.mod, name, info.cls,
+                                        node)
+                info.calls.append(CallSite(node=node, held=held,
+                                           callees=callees))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in ast.iter_child_nodes(info.node):
+            walk(stmt, ())
+
+
+def load_program(root: str, package: str | None = None) -> Program:
+    """Parse every ``.py`` under ``root`` into one linked
+    :class:`Program`.  ``package`` defaults to the directory's name
+    (``analytics_zoo_tpu`` for the repo's own tree)."""
+    root = os.path.abspath(root)
+    package = package or os.path.basename(root.rstrip(os.sep))
+    prog = Program(root=root, package=package)
+
+    for path in iter_python_files([root]):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mod = parse_module(source, path)
+        except (OSError, SyntaxError):
+            continue  # unparseable files are Tier-1 findings already
+        prog.modules[_module_name(root, package, path)] = mod
+
+    # symbol passes: locks first (call/lock resolution reads them),
+    # then the function facts
+    for name, mod in prog.modules.items():
+        _collect_locks(prog, name, mod)
+    for name, mod in prog.modules.items():
+        _collect_function_facts(prog, name, mod)
+    return prog

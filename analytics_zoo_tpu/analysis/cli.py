@@ -5,13 +5,19 @@ entry: exit 0 on a clean tree, 1 when any unsuppressed finding exists
 (2 on usage errors), so it composes with ``&&`` chains and CI steps.
 ``--format json`` emits the machine shape (``findings`` + ``summary``);
 ``--show-suppressed`` includes suppressed findings in text output for
-auditing the justification trail.
+auditing the justification trail.  ``--changed`` lints only the files
+modified vs ``git merge-base HEAD origin/main`` (fallback: the
+working-tree diff) — the fast pre-commit loop ``tools/precommit.sh``
+wires up.  ``--whole-program`` adds the Tier-3 interprocedural pass
+(cross-module lock-order cycles + guarded-by inference) over every
+directory target.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from analytics_zoo_tpu.analysis.astlint import ALL_RULES, lint_paths
@@ -21,7 +27,7 @@ from analytics_zoo_tpu.analysis.findings import render_json, render_text
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="zoolint",
-        description="JAX / concurrency AST linter (Tier 1 of "
+        description="JAX / concurrency AST linter (Tiers 1+3 of "
                     "analytics_zoo_tpu.analysis)")
     p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
                    help="files or directories to lint "
@@ -34,7 +40,55 @@ def build_parser() -> argparse.ArgumentParser:
                    help="include suppressed findings in text output")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only .py files changed vs `git merge-base "
+                        "HEAD origin/main` (fallback: working-tree "
+                        "diff), ignoring positional paths")
+    p.add_argument("--whole-program", action="store_true",
+                   help="also run the interprocedural pass (cross-"
+                        "module lock-order + guarded-by inference) "
+                        "over each directory target")
     return p
+
+
+def _git(*args: str, cwd: str | None = None) -> subprocess.CompletedProcess:
+    return subprocess.run(["git", *args], capture_output=True,
+                          text=True, cwd=cwd)
+
+
+def changed_paths() -> list | None:
+    """``.py`` files changed vs the merge base with origin/main, plus
+    working-tree modifications and untracked files.  None when not in
+    a git checkout (callers turn that into a usage error — silently
+    linting nothing must not read as clean)."""
+    top = _git("rev-parse", "--show-toplevel")
+    if top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    files: set = set()
+    # every git call runs FROM the repo root: both the `*.py` pathspec
+    # and the printed paths are cwd-relative, so invoking from a
+    # subdirectory would otherwise read as "nothing changed" (exit 0)
+    # while lintable changes exist above the cwd
+    base = _git("merge-base", "HEAD", "origin/main", cwd=root)
+    if base.returncode == 0:
+        diff = _git("diff", "--name-only", base.stdout.strip(),
+                    "--", "*.py", cwd=root)
+        files |= set(diff.stdout.split())
+    # fallback AND supplement: uncommitted + untracked work is exactly
+    # what a pre-commit hook needs to see
+    for args in (("diff", "--name-only", "HEAD", "--", "*.py"),
+                 ("ls-files", "--others", "--exclude-standard",
+                  "--", "*.py")):
+        out = _git(*args, cwd=root)
+        if out.returncode == 0:
+            files |= set(out.stdout.split())
+    # fixture corpora are DELIBERATELY dirty (planted positives) — their
+    # own tests lint them with the right expectations
+    files = {f for f in files
+             if not f.startswith("tests/resources/")}
+    resolved = [os.path.join(root, f) for f in sorted(files)]
+    return [p for p in resolved if os.path.exists(p)]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -56,14 +110,43 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         rules = [r for r in ALL_RULES if r.name in wanted]
 
-    missing = [p for p in args.paths if not os.path.exists(p)]
-    if missing:
-        # a typo'd path must NOT read as "0 findings, clean": a CI step
-        # pointed at nothing would stay green forever
-        print(f"zoolint: no such path(s): {missing}", file=sys.stderr)
-        return 2
+    if args.changed:
+        paths = changed_paths()
+        if paths is None:
+            print("zoolint: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print("zoolint: no changed .py files — nothing to lint")
+            return 0
+    else:
+        paths = args.paths
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            # a typo'd path must NOT read as "0 findings, clean": a CI
+            # step pointed at nothing would stay green forever
+            print(f"zoolint: no such path(s): {missing}",
+                  file=sys.stderr)
+            return 2
 
-    findings = lint_paths(args.paths, rules)
+    findings = lint_paths(paths, rules)
+
+    if args.whole_program:
+        from analytics_zoo_tpu.analysis.rules_interproc import (
+            lint_program,
+        )
+
+        roots = [p for p in paths if os.path.isdir(p)]
+        if args.changed and not roots:
+            # changed paths are always files — the fast loop still
+            # gets the cross-module pass, over the installed package
+            pkg = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            if os.path.isdir(pkg):
+                roots = [pkg]
+        for p in roots:
+            findings.extend(lint_program(p))
+
     if args.format == "json":
         print(render_json(findings))
     else:

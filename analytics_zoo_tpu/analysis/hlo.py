@@ -27,8 +27,15 @@ first inputs of the ROADMAP's cost-model-driven compile plane):
   ``dot_general``/``dot``/``convolution`` ops — the MXU term, exact
   for dots (hand-countable, pinned by tests);
 - ``bytes_accessed``: operand + result bytes summed over ops — the
-  HBM-traffic term (approximate: fusion not modelled);
-- ``collective_count`` / ``collective_bytes``: cross-chip traffic;
+  HBM-traffic term (approximate: fusion not modelled; ``gather``/
+  ``scatter`` charge indices + the touched slices, not the whole
+  source tensor);
+- ``collective_count`` / ``collective_bytes``: cross-chip traffic
+  over ``all_reduce``/``all_gather``/``reduce_scatter``/``all_to_all``/
+  ``collective_permute``/``collective_broadcast``; per op the FULL
+  participating tensor counts (max of operand/result bytes), so a
+  2-device reduce-scatter of a per-device ``tensor<4xf32>`` is 16
+  bytes even though each device keeps only half;
 - ``fused_dispatch_count``: ``stablehlo.while`` ops (one per
   ``lax.scan``/``fori_loop`` — the K-step fused dispatch shape).
 
@@ -237,8 +244,21 @@ def analyze_hlo_text(
 
         rpt.op_count += 1
         rpt.op_histogram[op] = rpt.op_histogram.get(op, 0) + 1
-        rpt.bytes_accessed += sum(t.nbytes for t in operands) + \
-            sum(t.nbytes for t in results)
+        if op == "gather" and len(operands) >= 2 and results:
+            # a gather reads the index vector and the GATHERED SLICES
+            # (result-sized), not the whole operand — charging the full
+            # source tensor would make an embedding lookup look like a
+            # full-table scan to the cost model
+            rpt.bytes_accessed += operands[1].nbytes \
+                + 2 * results[0].nbytes
+        elif op == "scatter" and len(operands) >= 3:
+            # symmetric: indices + updates read + the updated positions
+            # written (XLA aliases the untouched region)
+            rpt.bytes_accessed += operands[1].nbytes \
+                + 2 * operands[2].nbytes
+        else:
+            rpt.bytes_accessed += sum(t.nbytes for t in operands) + \
+                sum(t.nbytes for t in results)
 
         if op in ("dot_general", "dot"):
             rpt.matmul_flops += _dot_flops(line, operands, results)
@@ -249,7 +269,13 @@ def analyze_hlo_text(
         elif op in _COLLECTIVE_OPS:
             rpt.collective_count += 1
             rpt.collectives[op] = rpt.collectives.get(op, 0) + 1
-            rpt.collective_bytes += sum(t.nbytes for t in results)
+            # the FULL participating tensor moves over the interconnect:
+            # for all_reduce operand == result, for reduce_scatter the
+            # operand is N× the (scattered) result, for all_gather the
+            # result is N× the operand — max() covers all three shapes
+            rpt.collective_bytes += max(
+                sum(t.nbytes for t in operands),
+                sum(t.nbytes for t in results))
             if op not in expected_collectives:
                 rpt.findings.append(Finding(
                     rule="hlo-all-gather" if "gather" in op
@@ -325,7 +351,7 @@ def analyze_hlo_text(
 # The timed_compile hook: metrics + flight record + JSON report.
 # ---------------------------------------------------------------------------
 
-_report_seq = 0
+_report_seq = 0  # guarded-by: _report_lock
 _report_lock = threading.Lock()
 
 

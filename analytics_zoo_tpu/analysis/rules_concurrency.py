@@ -11,9 +11,12 @@ These rules make the discipline declarative and machine-checked:
   assignment, item write, mutating method call) inside ``with
   self.<lock>:``.  ``__init__``/``__post_init__`` are exempt (the
   object is not yet shared), as is the annotated declaration line
-  itself.  Reads are deliberately unchecked — the codebase uses
-  intentional lock-free reads (double-checked creation, monotonic
-  snapshots); checking them would bury the real signal.
+  itself.  Module GLOBALS work the same way: an annotated module-level
+  assignment (``_CONTEXT = None  # guarded-by: _LOCK``) makes every
+  ``global``-declared write require ``with _LOCK:`` (module-level
+  initialisation is exempt).  Reads are deliberately unchecked — the
+  codebase uses intentional lock-free reads (double-checked creation,
+  monotonic snapshots); checking them would bury the real signal.
 - ``lock-order``: two locks nested in opposite orders in different
   functions is the classic ABBA deadlock.  Lock-looking context
   managers (``with self._lock:`` where the name contains "lock") are
@@ -136,7 +139,58 @@ class GuardedByRule(Rule):
                     if attr is not None:
                         yield node, attr, "del"
 
+    def _module_guards(self, mod: LintModule) -> dict[str, str]:
+        """{global name: lock} from annotated MODULE-LEVEL assignments
+        (statements whose enclosing scope is the module itself)."""
+        guards: dict[str, str] = {}
+        for node in mod.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = mod.guarded_by_lines.get(node.lineno)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    guards[t.id] = lock
+        return guards
+
+    def _check_module_globals(self, mod: LintModule) -> Iterator[Finding]:
+        guards = self._module_guards(mod)
+        if not guards:
+            return
+        for fn in mod.functions():
+            declared = {n for node in ast.walk(fn)
+                        if isinstance(node, ast.Global)
+                        for n in node.names}
+            watched = declared & set(guards)
+            if not watched:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    raw = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    targets = [leaf for t in raw
+                               for leaf in self._flatten_targets(t)]
+                    for t in targets:
+                        if not isinstance(t, ast.Name) \
+                                or t.id not in watched:
+                            continue
+                        lock = guards[t.id]
+                        if not self._lock_held(mod, node, lock):
+                            yield self.finding(
+                                mod, node,
+                                f"assignment to module global "
+                                f"`{t.id}` (guarded-by `{lock}`) in "
+                                f"`{fn.name}` without `with {lock}:` "
+                                f"held",
+                                attribute=t.id, lock=lock,
+                                method=fn.name)
+
     def check(self, mod: LintModule) -> Iterator[Finding]:
+        yield from self._check_module_globals(mod)
         for cls in ast.walk(mod.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue

@@ -1,0 +1,424 @@
+"""Interprocedural concurrency rules (Tier 3 static half, "zoosan").
+
+Consumes the :mod:`callgraph` :class:`~callgraph.Program` and produces
+the two whole-program fact families Tier 1 cannot see:
+
+- **Global lock-order graph** (:func:`build_lock_graph`): an edge
+  ``A -> B`` means lock ``B`` is acquired somewhere while ``A`` is
+  held — *including through calls*: ``f`` holding the registry lock
+  and calling a broker method that takes the broker lock contributes
+  ``MetricsRegistry._lock -> Broker._cv`` even though no single file
+  shows both.  Any cycle is an ABBA deadlock shape and becomes a
+  ``lock-order-global`` finding naming both acquisition sites
+  (:func:`find_cycles` / the ``test_package_lock_graph_acyclic`` CI
+  gate assert acyclicity directly).
+- **Guarded-by inference** (:func:`infer_guarded_by`): for every
+  instance attribute of a lock-holding class, the lockset under which
+  it is written.  An attribute written at least once under a class
+  lock but not declared ``# guarded-by:`` is a ``guarded-by-candidate``
+  finding — either annotate it (and fix/justify the unlocked writes)
+  or suppress with a justification.  A write in a private helper whose
+  every resolved call site holds the lock counts as locked (the
+  interprocedural fact that retires most Tier-1 false suspicions).
+
+Suppressions use the Tier-1 syntax at the reported line
+(``# zoolint: disable=guarded-by-candidate -- why``); the candidate
+findings anchor to the attribute's initialising line precisely so the
+annotation and the suppression live in the same place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from analytics_zoo_tpu.analysis.callgraph import (
+    FunctionInfo,
+    Program,
+    load_program,
+)
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+from analytics_zoo_tpu.analysis.rules_concurrency import (
+    _EXEMPT_METHODS,
+    _self_attr,
+)
+from analytics_zoo_tpu.analysis.rules_jax import MUTATING_METHODS
+
+__all__ = ["build_lock_graph", "find_cycles", "infer_guarded_by",
+           "lint_program", "transitive_acquisitions"]
+
+
+# ---------------------------------------------------------------------------
+# Whole-program lock-order graph.
+# ---------------------------------------------------------------------------
+
+def transitive_acquisitions(prog: Program) -> dict:
+    """(module, qualname) -> frozenset of lock ids the function may
+    acquire, directly or through any resolvable call chain."""
+    direct = {info.key: {a.lock_id for a in info.acquisitions}
+              for info in prog.iter_functions()}
+    callees = {info.key: {c for site in info.calls for c in site.callees}
+               for info in prog.iter_functions()}
+    acq = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, callee_keys in callees.items():
+            mine = acq[key]
+            before = len(mine)
+            for ck in callee_keys:
+                mine |= acq.get(ck, set())
+            changed = changed or len(mine) != before
+    return {k: frozenset(v) for k, v in acq.items()}
+
+
+def build_lock_graph(prog: Program) -> dict:
+    """``{(outer, inner): (FunctionInfo, lineno, via)}`` — one witness
+    site per ordered lock pair; ``via`` is ``"with"`` for a direct
+    nested acquisition or the callee qualname for a call-through edge."""
+    acq = transitive_acquisitions(prog)
+    edges: dict = {}
+    for info in prog.iter_functions():
+        for a in info.acquisitions:
+            for outer in a.held:
+                if outer != a.lock_id:
+                    edges.setdefault((outer, a.lock_id),
+                                     (info, a.node.lineno, "with"))
+        for site in info.calls:
+            if not site.held:
+                continue
+            reachable: set = set()
+            for ck in site.callees:
+                reachable |= acq.get(ck, frozenset())
+            for outer in site.held:
+                for inner in reachable:
+                    if inner != outer:
+                        via = site.callees[0][1] if site.callees else "?"
+                        edges.setdefault((outer, inner),
+                                         (info, site.node.lineno, via))
+    return edges
+
+
+def find_cycles(edges: Iterable) -> list:
+    """Minimal cycles in the ordered-pair graph, as sorted lock-id
+    tuples (deduplicated by the cycle's node set)."""
+    adjacency: dict = {}
+    for (a, b) in edges:
+        adjacency.setdefault(a, set()).add(b)
+
+    cycles: list = []
+    seen: set = set()
+
+    def path_back(start: str, target: str, limit: int = 6):
+        """DFS from start back to target, returning one path or None."""
+        stack = [(start, (start,))]
+        visited = {start}
+        while stack:
+            node, path = stack.pop()
+            if len(path) > limit:
+                continue
+            for nxt in sorted(adjacency.get(node, ())):
+                if nxt == target:
+                    return path + (nxt,)
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + (nxt,)))
+        return None
+
+    for (a, b) in sorted(edges):
+        back = path_back(b, a)
+        if back is None:
+            continue
+        cycle = (a,) + back  # a -> b -> ... -> a
+        key = frozenset(cycle)
+        if key not in seen:
+            seen.add(key)
+            cycles.append(cycle)
+    return cycles
+
+
+def _lock_order_findings(prog: Program) -> list:
+    edges = build_lock_graph(prog)
+    findings = []
+    for cycle in find_cycles(edges):
+        # the witness for the first edge of the cycle anchors the
+        # finding; every edge's site lands in data for the report
+        sites = []
+        for i in range(len(cycle) - 1):
+            pair = (cycle[i], cycle[i + 1])
+            if pair in edges:
+                info, lineno, via = edges[pair]
+                sites.append({"outer": pair[0], "inner": pair[1],
+                              "function": f"{info.module}.{info.qualname}",
+                              "path": info.mod.path, "line": lineno,
+                              "via": via})
+        anchor = sites[0] if sites else {"path": "<program>", "line": 0}
+        order = " -> ".join(cycle)
+        detail = "; ".join(
+            f"`{s['inner']}` under `{s['outer']}` in `{s['function']}` "
+            f"({s['path']}:{s['line']}"
+            + (f", via {s['via']}()" if s.get("via") not in (None, "with")
+               else "") + ")"
+            for s in sites)
+        findings.append(Finding(
+            rule="lock-order-global", severity=Severity.ERROR,
+            path=anchor["path"], line=anchor["line"],
+            message=f"whole-program lock cycle {order}: {detail} — "
+            "inconsistent cross-module order can deadlock",
+            data={"cycle": list(cycle), "sites": sites}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Guarded-by inference.
+# ---------------------------------------------------------------------------
+
+def _write_events(info: FunctionInfo):
+    """(node, attr) self-attribute write events inside one method —
+    assignment / augmented / item write / mutating call / del, own
+    scope only (mirrors the Tier-1 rule's write model)."""
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            raw = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in raw:
+                for leaf in ast.walk(t):
+                    attr = _self_attr(leaf)
+                    if attr is not None:
+                        yield node, attr
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATING_METHODS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                yield node, attr
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                for leaf in ast.walk(t):
+                    attr = _self_attr(leaf)
+                    if attr is not None:
+                        yield node, attr
+
+
+def _held_class_locks(info: FunctionInfo, node: ast.AST,
+                      class_lock_attrs: set) -> set:
+    """Class locks held at ``node`` via enclosing ``with self.<lock>``
+    statements inside this method."""
+    held = set()
+    for anc in info.mod.ancestors(node):
+        if not isinstance(anc, (ast.With, ast.AsyncWith)):
+            continue
+        for item in anc.items:
+            q = info.mod.qualname(item.context_expr)
+            if q and q.startswith("self.") and q[5:] in class_lock_attrs:
+                held.add(q[5:])
+    return held
+
+
+def _callers_always_hold(prog: Program, info: FunctionInfo,
+                         class_lock_attrs: set) -> set:
+    """Locks of ``info``'s class that EVERY resolved call site of
+    ``info`` holds — the interprocedural "helper called with the lock
+    held" fact.  Only private helpers qualify (a public method must
+    lock for itself — today's callers are not a contract), and a
+    method with no resolved callers gets nothing."""
+    name = info.qualname.rpartition(".")[2]
+    if not name.startswith("_") or name.startswith("__"):
+        return set()
+    prefix = f"{info.module}.{info.cls}."
+    held_sets = []
+    for other in prog.iter_functions():
+        for site in other.calls:
+            if info.key not in site.callees:
+                continue
+            held = {lid.rpartition(".")[2] for lid in site.held
+                    if info.cls and lid.startswith(prefix)}
+            held_sets.append(held & class_lock_attrs)
+    if not held_sets:
+        return set()
+    out = set(class_lock_attrs)
+    for h in held_sets:
+        out &= h
+    return out
+
+
+def infer_guarded_by(prog: Program) -> list:
+    """``guarded-by-candidate`` findings: lock-holding classes whose
+    instance attributes are written under a class lock but carry no
+    ``# guarded-by:`` declaration.
+
+    Each finding anchors to the attribute's first write line in
+    ``__init__`` (the annotation site).  ``data`` carries the inferred
+    lock, the locked/unlocked write counts and every unlocked site, so
+    the fix (annotate / fix a race / suppress with a justification) is
+    mechanical.
+    """
+    findings = list(_infer_module_globals(prog))
+    for (module, cls), locks in sorted(prog.class_locks.items()):
+        lock_attrs = set(locks)
+        infos = [f for f in prog.iter_functions()
+                 if f.cls == cls and f.module == module]
+        if not infos:
+            continue
+        mod = infos[0].mod
+        declared = _declared_attrs(mod, cls)
+        init_lines: dict = {}
+        locked_writes: dict = {}
+        unlocked_writes: dict = {}
+        for info in infos:
+            exempt = info.qualname.rpartition(".")[2] in _EXEMPT_METHODS
+            caller_held = set() if exempt else \
+                _callers_always_hold(prog, info, lock_attrs)
+            for node, attr in _write_events(info):
+                if attr in lock_attrs:
+                    continue  # the lock itself
+                if exempt:
+                    init_lines.setdefault(attr, node.lineno)
+                    continue
+                held = _held_class_locks(info, node, lock_attrs) \
+                    | caller_held
+                bucket = locked_writes if held else unlocked_writes
+                bucket.setdefault(attr, []).append(
+                    (info, node.lineno, sorted(held)))
+        for attr in sorted(locked_writes):
+            if attr in declared:
+                continue  # already annotated
+            lock = locked_writes[attr][0][2][0]
+            n_locked = len(locked_writes[attr])
+            unlocked = unlocked_writes.get(attr, [])
+            line = init_lines.get(attr,
+                                  locked_writes[attr][0][1])
+            where = ", ".join(
+                f"{i.qualname} ({i.mod.path}:{ln})"
+                for i, ln, _ in unlocked[:4])
+            tail = (f"; ALSO written {len(unlocked)}x without it "
+                    f"({where}) — fix or justify those sites"
+                    if unlocked else "")
+            findings.append(Finding(
+                rule="guarded-by-candidate", severity=Severity.WARNING,
+                path=mod.path, line=line,
+                message=f"`{cls}.{attr}` is written {n_locked}x under "
+                f"`self.{lock}` but has no `# guarded-by:` annotation "
+                f"— declare it so Tier 1 and the runtime sanitizer "
+                f"can check every write{tail}",
+                data={"cls": cls, "attribute": attr, "lock": lock,
+                      "locked_writes": n_locked,
+                      "unlocked_writes": [
+                          {"method": i.qualname, "path": i.mod.path,
+                           "line": ln} for i, ln, _ in unlocked]}))
+    return findings
+
+
+def _infer_module_globals(prog: Program):
+    """Module-level analogue: a ``global``-declared name written under
+    a module lock wants a ``# guarded-by:`` annotation on its
+    module-level initialiser."""
+    for module, locks in sorted(prog.module_locks.items()):
+        mod = prog.modules[module]
+        init_lines: dict = {}
+        annotated: set = set()
+        for node in mod.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    init_lines.setdefault(t.id, node.lineno)
+                    if node.lineno in mod.guarded_by_lines:
+                        annotated.add(t.id)
+        locked: dict = {}
+        for info in [f for f in prog.iter_functions()
+                     if f.module == module and f.cls is None]:
+            declared = {n for sub in ast.walk(info.node)
+                        if isinstance(sub, ast.Global)
+                        for n in sub.names}
+            if not declared:
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                    continue
+                raw = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in raw:
+                    if not isinstance(t, ast.Name) \
+                            or t.id not in declared \
+                            or t.id in locks:
+                        continue
+                    held = [name for name in locks
+                            if _module_lock_held(mod, node, name)]
+                    if held:
+                        locked.setdefault(t.id, (held[0], node.lineno))
+        for name in sorted(locked):
+            if name in annotated:
+                continue
+            lock, lineno = locked[name]
+            yield Finding(
+                rule="guarded-by-candidate", severity=Severity.WARNING,
+                path=mod.path, line=init_lines.get(name, lineno),
+                message=f"module global `{module}.{name}` is written "
+                f"under `{lock}` but has no `# guarded-by:` annotation "
+                f"on its initialiser — declare it so Tier 1 checks "
+                f"every `global` write",
+                data={"module": module, "attribute": name, "lock": lock})
+
+
+def _module_lock_held(mod, node: ast.AST, lock: str) -> bool:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if mod.qualname(item.context_expr) == lock:
+                    return True
+    return False
+
+
+def _declared_attrs(mod, cls_name: str) -> set:
+    """Attrs of ``cls_name`` carrying a ``# guarded-by:`` annotation."""
+    declared = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)) \
+                        and sub.lineno in mod.guarded_by_lines:
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            declared.add(attr)
+    return declared
+
+
+# ---------------------------------------------------------------------------
+# Entry point.
+# ---------------------------------------------------------------------------
+
+def _apply_program_suppressions(prog: Program,
+                                findings: list) -> list:
+    """Interprocedural findings honor the same per-line suppression
+    comments as Tier 1 (looked up in the module that owns the line)."""
+    by_path = {mod.path: mod for mod in prog.modules.values()}
+    out = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None:
+            rules = mod.suppressed_rules_at(f.line)
+            if f.rule in rules or "all" in rules:
+                f = Finding(rule=f.rule, severity=f.severity,
+                            path=f.path, line=f.line, col=f.col,
+                            message=f.message, suppressed=True,
+                            data=f.data)
+        out.append(f)
+    return out
+
+
+def lint_program(root: str, package: str | None = None,
+                 prog: Program | None = None) -> list:
+    """The whole-program pass: load (or reuse) the :class:`Program`,
+    run cross-module lock-order and guarded-by inference, apply
+    suppressions.  This is what ``tools/zoolint.py --whole-program``
+    and the ``test_package_is_clean`` gate add on top of Tier 1."""
+    prog = prog or load_program(root, package)
+    findings = _lock_order_findings(prog) + infer_guarded_by(prog)
+    return _apply_program_suppressions(prog, findings)

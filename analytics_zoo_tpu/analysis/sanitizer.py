@@ -1,0 +1,691 @@
+"""Runtime lockdep + guarded-by sanitizer ("zoosan" dynamic half).
+
+The static tier (:mod:`rules_interproc`) proves properties of the code
+it can see; this module proves the *annotations* against what actually
+happens: every ``threading.Lock``/``RLock`` the package creates is
+wrapped (when ``ZOO_SAN=1``) and three checkers run on the live
+process:
+
+- **lockdep** — a per-process lock-acquisition-order graph keyed by
+  lock *class* (the ``file:line`` allocation site, the kernel-lockdep
+  trick: every ``Broker._cv`` instance is one node).  Acquiring B
+  while holding A adds the edge A->B; the first edge that closes a
+  cycle produces one structured :class:`Finding` carrying BOTH stacks
+  — the one that took A-then-B and the one now taking B-then-A — so
+  the deadlock is debuggable from a single run that never actually
+  deadlocked.
+- **guarded-by validation** — classes whose source declares
+  ``# guarded-by: <lock>`` (the Tier-1 annotation) get their
+  ``__setattr__`` instrumented: an attribute assignment without the
+  declared lock held by the current thread is a finding.  This is the
+  cross-check that the annotations the static tier trusts are the
+  locking discipline the program actually follows.  (Item writes and
+  mutating calls stay static-tier-only — ``__setattr__`` cannot see
+  them.)
+- **blocking-under-lock** — ``queue.Queue.put/get`` with
+  ``timeout=None``, ``time.sleep`` and ``socket.recv`` while holding
+  any sanitized lock: the shapes that turn one slow peer into a
+  stalled lock convoy.
+
+Cost model: with ``ZOO_SAN`` unset nothing is touched —
+``maybe_install()`` returns before any patch, ``threading.Lock``
+stays ``_thread.allocate_lock`` (identity-checked by the test suite).
+Enabled, only locks ALLOCATED from watched paths (the package tree
+plus :func:`watch_path` additions) are wrapped; foreign locks
+(logging, queue internals, jax) stay raw.
+
+Findings are passive: they land in :func:`findings`, the
+``zoo_san_findings_total{rule=}`` counter and one ``san_finding``
+flight-recorder event each — the quick tier runs under ``ZOO_SAN=1``
+and a finding fails the run only where a test asserts on it (or via
+the conftest strict gate, ``ZOO_SAN_STRICT=1``).
+"""
+
+from __future__ import annotations
+
+import importlib.abc
+import importlib.machinery
+import os
+import sys
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+from analytics_zoo_tpu.analysis.findings import Finding, Severity
+
+__all__ = ["enabled", "installed", "maybe_install", "install",
+           "uninstall", "watch_path", "findings", "drain",
+           "instrument_module", "SanLock", "SanRLock"]
+
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# -- real primitives, captured before any patching --------------------------
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_SLEEP = None  # captured at install (time may be patched by tests)
+
+_STACK_LIMIT = 16
+
+#: frames never charged as a lock's allocation site (stdlib plumbing
+#: between the package line and the actual allocation)
+_SKIP_FILES = frozenset({threading.__file__, __file__})
+
+
+@dataclass
+class _State:
+    """All sanitizer state; a fresh one per install keeps tests clean."""
+
+    watched: list = field(default_factory=list)
+    #: (outer_class, inner_class) -> formatted stack of the acquisition
+    edges: dict = field(default_factory=dict)
+    #: cycle pairs already reported (frozenset of lock classes)
+    reported: set = field(default_factory=set)
+    #: (rule, file, line) sites already reported (one finding per site)
+    reported_sites: set = field(default_factory=set)
+    findings: list = field(default_factory=list)
+    #: path -> LintModule (or None), for static-suppression lookups
+    parsed: dict = field(default_factory=dict)
+    #: instrumented classes -> original __setattr__
+    instrumented: dict = field(default_factory=dict)
+    lock: object = field(default_factory=_REAL_LOCK)
+
+
+_state: _State | None = None
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """True when the env opts in (``ZOO_SAN=1``)."""
+    return os.environ.get("ZOO_SAN", "") == "1"
+
+
+def installed() -> bool:
+    return _state is not None
+
+
+def _held() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    elif stack:
+        # a Lock may legally be released by ANOTHER thread (handoff
+        # pattern); that release cannot reach this thread's list, so
+        # prune entries we no longer own lazily — else the phantom
+        # hold feeds false lockdep edges and blocking findings forever
+        me = threading.get_ident()
+        if any(e._owner != me for e in stack):
+            stack[:] = [e for e in stack if e._owner == me]
+    return stack
+
+
+def _in_san() -> bool:
+    return getattr(_tls, "in_san", False)
+
+
+class _san_section:
+    """Reentrancy guard: finding/metric recording acquires package
+    locks (the registry's own children), which must not re-enter the
+    bookkeeping."""
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "in_san", False)
+        _tls.in_san = True
+
+    def __exit__(self, *exc):
+        _tls.in_san = self.prev
+
+
+def _stack(skip: int = 2) -> str:
+    return "".join(traceback.format_stack(
+        sys._getframe(skip), limit=_STACK_LIMIT))
+
+
+def _caller_site(skip: int = 2) -> tuple:
+    f = sys._getframe(skip)
+    return f.f_code.co_filename, f.f_lineno
+
+
+#: a runtime rule also honors its static sibling's suppressions — the
+#: two halves check ONE contract, so one reviewed justification covers
+#: both (`# zoolint: disable=guarded-by -- why` silences the runtime
+#: validator at that write site too)
+_STATIC_SIBLINGS = {
+    "san-guarded-by": ("guarded-by",),
+    "san-lock-order": ("lock-order", "lock-order-global"),
+    "san-blocking-under-lock": (),
+}
+
+
+def _suppressed_at(st: _State, rule: str, path: str, line: int) -> bool:
+    mod = st.parsed.get(path, _MISSING)
+    if mod is _MISSING:
+        mod = None
+        if os.path.exists(path):
+            try:
+                from analytics_zoo_tpu.analysis.astlint import parse_module
+
+                with open(path, encoding="utf-8") as f:
+                    mod = parse_module(f.read(), path)
+            except (OSError, SyntaxError):
+                mod = None
+        with st.lock:
+            st.parsed[path] = mod
+    if mod is None:
+        return False
+    rules = mod.suppressed_rules_at(line)
+    return bool(rules & ({rule, "all"}
+                         | set(_STATIC_SIBLINGS.get(rule, ()))))
+
+
+_MISSING = object()
+
+
+def _record(rule: str, message: str, path: str, line: int,
+            **data) -> Finding:
+    finding = Finding(rule=rule, severity=Severity.ERROR, path=path,
+                      line=line, message=message, data=data)
+    st = _state
+    if st is None:
+        return finding
+    with _san_section():
+        if _suppressed_at(st, rule, path, line):
+            return finding
+        with st.lock:
+            site = (rule, path, line)
+            if site in st.reported_sites:
+                return finding
+            st.reported_sites.add(site)
+            st.findings.append(finding)
+        try:
+            from analytics_zoo_tpu.metrics import (
+                get_flight_recorder,
+                get_registry,
+            )
+            get_registry().counter(
+                "zoo_san_findings_total",
+                "runtime sanitizer findings by rule",
+                ("rule",)).labels(rule=rule).inc()
+            get_flight_recorder().record(
+                "san_finding", rule=rule, message=message,
+                path=path, line=line)
+        except Exception:
+            pass  # telemetry is best-effort; the finding itself is kept
+    return finding
+
+
+# ---------------------------------------------------------------------------
+# Lock wrappers + lockdep.
+# ---------------------------------------------------------------------------
+
+class _SanBase:
+    """Shared acquire/release bookkeeping over a real primitive."""
+
+    def __init__(self, real, lock_class: str):
+        self._real = real
+        self._lock_class = lock_class
+        self._owner = None  #: thread id of the current holder
+        self._count = 0
+
+    # -- bookkeeping ------------------------------------------------
+    def _note_acquired(self):
+        if _in_san():
+            return
+        me = threading.get_ident()
+        reentrant = self._owner == me and self._count > 0
+        self._owner, self._count = me, self._count + 1
+        held = _held()
+        if not reentrant and _state is not None:
+            for other in held:
+                if other is self \
+                        or other._lock_class == self._lock_class:
+                    continue
+                self._lockdep_edge(other)
+        held.append(self)
+
+    def _note_released(self):
+        if _in_san():
+            return
+        self._count = max(0, self._count - 1)
+        if self._count == 0:
+            self._owner = None
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+
+    def _lockdep_edge(self, outer: "_SanBase"):
+        st = _state
+        if st is None:
+            return
+        edge = (outer._lock_class, self._lock_class)
+        with _san_section():
+            with st.lock:
+                known = edge in st.edges
+                if not known:
+                    st.edges[edge] = _stack(skip=4)
+                cycle = None if known else _path(
+                    st.edges, self._lock_class, outer._lock_class)
+                if cycle is None:
+                    return
+                key = frozenset(cycle)
+                if key in st.reported:
+                    return
+                st.reported.add(key)
+                reverse_stack = st.edges.get(
+                    (cycle[0], cycle[1]), "<unavailable>")
+                this_stack = st.edges[edge]
+        path, line = _caller_site(skip=4)
+        order = " -> ".join((outer._lock_class, self._lock_class)
+                            + tuple(cycle[1:]))
+        _record(
+            "san-lock-order",
+            f"lock cycle closed at runtime: took `{self._lock_class}` "
+            f"while holding `{outer._lock_class}`, but the reverse "
+            f"order was observed earlier ({order}) — ABBA deadlock "
+            "shape; both stacks in data",
+            path, line,
+            cycle=[outer._lock_class, self._lock_class],
+            this_stack=this_stack, reverse_stack=reverse_stack)
+
+    def _held_by_current_thread(self) -> bool:
+        return self._owner == threading.get_ident() and self._count > 0
+
+    # -- delegated lock protocol ------------------------------------
+    def acquire(self, *args, **kwargs):
+        got = self._real.acquire(*args, **kwargs)
+        if got:
+            self._note_acquired()
+        return got
+
+    def release(self):
+        self._note_released()
+        self._real.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def _at_fork_reinit(self):
+        # threading._after_fork reinitializes the locks inside Events/
+        # Conditions of surviving threads — wrapped locks must speak it
+        # or a fork-start child dies in the reinit walk
+        self._real._at_fork_reinit()
+        self._owner, self._count = None, 0
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self._lock_class} " \
+               f"wrapping {self._real!r}>"
+
+
+class SanLock(_SanBase):
+    """``threading.Lock`` wrapper tracked by the sanitizer."""
+
+
+class SanRLock(_SanBase):
+    """``threading.RLock`` wrapper; also speaks the private Condition
+    protocol (``_is_owned`` / ``_release_save`` / ``_acquire_restore``)
+    so ``threading.Condition`` composes transparently."""
+
+    def _is_owned(self):
+        return self._real._is_owned()
+
+    def _release_save(self):
+        # Condition.wait(): the lock is fully released however deep the
+        # recursion — mirror that in the held stack
+        count = self._count
+        while self._count > 0:
+            self._note_released()
+        state = self._real._release_save()
+        return (state, count)
+
+    def _acquire_restore(self, saved):
+        state, count = saved
+        self._real._acquire_restore(state)
+        for _ in range(count):
+            self._note_acquired()
+
+
+def _path(edges, start: str, target: str, limit: int = 8):
+    """A path start -> ... -> target in the edge dict, or None."""
+    adjacency: dict = {}
+    for (a, b) in edges:
+        adjacency.setdefault(a, []).append(b)
+    stack = [(start, (start,))]
+    visited = {start}
+    while stack:
+        node, trail = stack.pop()
+        if len(trail) > limit:
+            continue
+        for nxt in adjacency.get(node, ()):
+            if nxt == target:
+                return trail + (nxt,)
+            if nxt not in visited:
+                visited.add(nxt)
+                stack.append((nxt, trail + (nxt,)))
+    return None
+
+
+def _watched_site() -> str | None:
+    """Allocation site ``file:line`` when the (nearest non-stdlib-
+    threading) caller is in a watched tree, else None (foreign locks
+    stay raw).  Skipping ``threading.py`` frames attributes the RLock
+    a ``threading.Condition()`` creates internally to the package line
+    that built the Condition."""
+    st = _state
+    if st is None:
+        return None
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename in _SKIP_FILES:
+        f = f.f_back
+    if f is None:
+        return None
+    filename = f.f_code.co_filename
+    for prefix in st.watched:
+        if filename.startswith(prefix):
+            rel = os.path.relpath(filename, prefix)
+            return f"{rel}:{f.f_lineno}"
+    return None
+
+
+def _lock_factory():
+    site = _watched_site()
+    real = _REAL_LOCK()
+    return real if site is None else SanLock(real, site)
+
+
+def _rlock_factory():
+    site = _watched_site()
+    real = _REAL_RLOCK()
+    return real if site is None else SanRLock(real, site)
+
+
+# ---------------------------------------------------------------------------
+# Blocking-call detection.
+# ---------------------------------------------------------------------------
+
+def _flag_blocking(what: str, skip: int = 2):
+    held = _held()
+    st = _state
+    if not held or _in_san() or st is None:
+        return
+    path, line = _caller_site(skip)
+    locks = ", ".join(h._lock_class for h in held)
+    _record(
+        "san-blocking-under-lock",
+        f"{what} while holding lock(s) [{locks}] — an unbounded wait "
+        "under a lock turns one slow peer into a convoy; release the "
+        "lock first or use a timeout",
+        path, line, call=what, locks=[h._lock_class for h in held])
+
+
+def _make_sleep(real_sleep):
+    def sleep(seconds):
+        _flag_blocking(f"time.sleep({seconds!r})", skip=3)
+        return real_sleep(seconds)
+    sleep._zoo_san = True
+    return sleep
+
+
+def _make_queue_method(real, name):
+    # put(self, item, block=True, timeout=None) / get(self, block=True,
+    # timeout=None): positional offsets differ by the item argument
+    first = 1 if name == "put" else 0
+
+    def method(self, *args, **kwargs):
+        block = args[first] if len(args) > first \
+            else kwargs.get("block", True)
+        timeout = args[first + 1] if len(args) > first + 1 \
+            else kwargs.get("timeout", None)
+        if block and timeout is None:
+            _flag_blocking(f"queue.Queue.{name}(timeout=None)", skip=3)
+        return real(self, *args, **kwargs)
+    method._zoo_san = True
+    return method
+
+
+def _make_recv(real_recv):
+    def recv(self, *args, **kwargs):
+        if self.gettimeout() is None:
+            _flag_blocking("socket.recv() with no socket timeout",
+                           skip=3)
+        return real_recv(self, *args, **kwargs)
+    recv._zoo_san = True
+    return recv
+
+
+# ---------------------------------------------------------------------------
+# Guarded-by runtime validation.
+# ---------------------------------------------------------------------------
+
+_EXEMPT_FRAMES = {"__init__", "__post_init__", "__new__", "__del__",
+                  "__setstate__"}
+
+
+def _class_guards(module) -> dict:
+    """{class name: {attr: lock attr}} parsed from the module's source
+    — the SAME annotations Tier 1 reads, so the two halves check one
+    contract."""
+    from analytics_zoo_tpu.analysis.astlint import parse_module
+    from analytics_zoo_tpu.analysis.rules_concurrency import GuardedByRule
+
+    import ast
+
+    path = getattr(module, "__file__", None)
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            mod = parse_module(f.read(), path)
+    except (OSError, SyntaxError):
+        return {}
+    rule = GuardedByRule()
+    out = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            guards = rule._declared_guards(mod, node)
+            if guards:
+                out[node.name] = guards
+    return out
+
+
+def _unwrap_lock(obj):
+    """The _SanBase behind a lock attribute (Conditions hold theirs at
+    ``_lock``); None when the attribute is not a sanitized lock."""
+    if isinstance(obj, _SanBase):
+        return obj
+    inner = getattr(obj, "_lock", None)  # threading.Condition
+    if isinstance(inner, _SanBase):
+        return inner
+    return None
+
+
+def _make_guarded_setattr(cls, guards: dict, orig):
+    def __setattr__(self, name, value):
+        if name in guards and _state is not None and not _in_san():
+            lock = _unwrap_lock(getattr(self, guards[name], None))
+            if lock is not None and not lock._held_by_current_thread():
+                caller = sys._getframe(1)
+                if caller.f_code.co_name not in _EXEMPT_FRAMES:
+                    _record(
+                        "san-guarded-by",
+                        f"write to `{cls.__name__}.{name}` (declared "
+                        f"guarded-by `{guards[name]}`) without the "
+                        f"lock held by this thread — the annotation "
+                        "the static tier trusts does not hold at "
+                        "runtime",
+                        caller.f_code.co_filename, caller.f_lineno,
+                        cls=cls.__name__, attribute=name,
+                        lock=guards[name], stack=_stack(skip=2))
+        orig(self, name, value)
+    __setattr__._zoo_san = True
+    return __setattr__
+
+
+def instrument_module(module) -> int:
+    """Instrument every ``# guarded-by``-annotated class defined in
+    ``module``; returns the number of classes wrapped.  Idempotent."""
+    st = _state
+    if st is None:
+        return 0
+    guards_by_class = _class_guards(module)
+    n = 0
+    for name, cls in list(vars(module).items()):
+        if not isinstance(cls, type) \
+                or cls.__module__ != module.__name__ \
+                or cls.__name__ not in guards_by_class \
+                or cls in st.instrumented:
+            continue
+        orig = cls.__setattr__
+        if getattr(orig, "_zoo_san", False):
+            continue
+        cls.__setattr__ = _make_guarded_setattr(
+            cls, guards_by_class[cls.__name__], orig)
+        st.instrumented[cls] = orig
+        n += 1
+    return n
+
+
+class _SanImportHook(importlib.abc.MetaPathFinder,
+                     importlib.abc.Loader):
+    """Instruments watched modules' guarded classes as they import."""
+
+    def __init__(self, prefixes):
+        self.prefixes = tuple(prefixes)
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not any(fullname == p or fullname.startswith(p + ".")
+                   for p in self.prefixes):
+            return None
+        spec = importlib.machinery.PathFinder.find_spec(fullname, path)
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _WrapLoader(spec.loader)
+        return spec
+
+
+class _WrapLoader(importlib.abc.Loader):
+    def __init__(self, inner):
+        self.inner = inner
+
+    def create_module(self, spec):
+        return self.inner.create_module(spec)
+
+    def exec_module(self, module):
+        self.inner.exec_module(module)
+        if installed():
+            instrument_module(module)
+
+    def __getattr__(self, name):  # is_package etc. for importlib
+        return getattr(self.inner, name)
+
+
+# ---------------------------------------------------------------------------
+# Install / uninstall.
+# ---------------------------------------------------------------------------
+
+_patches: list = []  # (obj, attr, original) for uninstall
+_import_hook: _SanImportHook | None = None
+
+
+def watch_path(prefix: str) -> None:
+    """Also wrap locks allocated under ``prefix`` (tests use this for
+    planted fixture modules)."""
+    if _state is not None:
+        p = os.path.abspath(prefix)
+        if p not in _state.watched:
+            _state.watched.append(p)
+
+
+def findings() -> list:
+    """Snapshot of the findings recorded so far."""
+    if _state is None:
+        return []
+    with _state.lock:
+        return list(_state.findings)
+
+
+def drain() -> list:
+    """Return AND clear the recorded findings, re-arming the per-site
+    dedup (test isolation)."""
+    if _state is None:
+        return []
+    with _state.lock:
+        out = list(_state.findings)
+        _state.findings.clear()
+        _state.reported_sites.clear()
+        _state.reported.clear()
+    return out
+
+
+def _patch(obj, attr, replacement):
+    _patches.append((obj, attr, getattr(obj, attr)))
+    setattr(obj, attr, replacement)
+
+
+def install(extra_paths=()) -> None:
+    """Activate the sanitizer (idempotent).  Wraps lock creation for
+    watched paths, hooks the blocking calls, and starts instrumenting
+    guarded classes (already-imported watched modules immediately,
+    later imports via a meta-path hook)."""
+    global _state, _import_hook, _REAL_SLEEP
+    if _state is not None:
+        return
+    import queue
+    import socket
+    import time
+
+    _state = _State(watched=[_PACKAGE_ROOT]
+                    + [os.path.abspath(p) for p in extra_paths])
+    _REAL_SLEEP = time.sleep
+
+    _patch(threading, "Lock", _lock_factory)
+    _patch(threading, "RLock", _rlock_factory)
+    _patch(time, "sleep", _make_sleep(time.sleep))
+    _patch(queue.Queue, "put", _make_queue_method(queue.Queue.put, "put"))
+    _patch(queue.Queue, "get", _make_queue_method(queue.Queue.get, "get"))
+    try:
+        _patch(socket.socket, "recv", _make_recv(socket.socket.recv))
+    except (AttributeError, TypeError):
+        pass  # immutable socket type on this platform: skip the probe
+
+    _import_hook = _SanImportHook(["analytics_zoo_tpu"])
+    sys.meta_path.insert(0, _import_hook)
+    for name, module in list(sys.modules.items()):
+        if name == "analytics_zoo_tpu" \
+                or name.startswith("analytics_zoo_tpu."):
+            instrument_module(module)
+
+
+def uninstall() -> None:
+    """Remove every patch and drop the state (test isolation; NOT run
+    in production — the wrappers are harmless for a process lifetime)."""
+    global _state, _import_hook
+    if _state is None:
+        return
+    for cls, orig in _state.instrumented.items():
+        cls.__setattr__ = orig
+    while _patches:
+        obj, attr, original = _patches.pop()
+        setattr(obj, attr, original)
+    if _import_hook is not None:
+        try:
+            sys.meta_path.remove(_import_hook)
+        except ValueError:
+            pass
+        _import_hook = None
+    _state = None
+
+
+def maybe_install() -> bool:
+    """The zero-cost gate the package ``__init__`` calls: installs iff
+    ``ZOO_SAN=1``; with the env unset NOTHING is touched
+    (``threading.Lock`` keeps its builtin identity)."""
+    if not enabled():
+        return False
+    install()
+    return True

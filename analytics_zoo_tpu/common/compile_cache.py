@@ -38,7 +38,7 @@ import time
 logger = logging.getLogger("analytics_zoo_tpu")
 
 _LOCK = threading.Lock()
-_ENABLED_DIR: str | None = None
+_ENABLED_DIR: str | None = None  # guarded-by: _LOCK
 
 # Histogram bounds shaped for compile times: sub-second CPU toys through
 # multi-minute TPU programs.

@@ -130,6 +130,18 @@ class ZooConfig:
                                writes a zoo-hlo-report/1 JSON file with
                                the analytic features + findings
                                (docs/static-analysis.md)
+      ZOO_SAN                  "1": install the runtime concurrency
+                               sanitizer at package import — wraps the
+                               package's locks (lockdep cycle detection
+                               with both stacks), validates guarded-by
+                               annotations on attribute writes, flags
+                               blocking calls under a held lock
+                               (analysis/sanitizer.py; zoo_san_* metrics
+                               + san_finding flight events).  Unset:
+                               nothing is patched, zero overhead.
+      ZOO_SAN_STRICT           "1": the pytest session fails if
+                               sanitizer findings are left un-drained
+                               at session end (tests/conftest.py)
     """
 
     app_name: str = "analytics-zoo-tpu"
@@ -342,7 +354,7 @@ def _resolve_compute_dtype(spec, platform: str):
 
 
 _LOCK = threading.Lock()
-_CONTEXT: ZooContext | None = None
+_CONTEXT: ZooContext | None = None  # guarded-by: _LOCK
 
 
 def _infer_mesh_shape(

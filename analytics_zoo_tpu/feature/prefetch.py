@@ -80,7 +80,7 @@ class PrefetchPipeline:
                  workers: int = 2, depth: int = 4,
                  metrics: DataPipelineMetrics | None = None,
                  health_component: str = "data_prefetch",
-                 stale_after: float = 60.0):
+                 stale_after: float = 60.0, start: bool = True):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if workers < 1:
@@ -101,7 +101,22 @@ class PrefetchPipeline:
         self._stale_after = stale_after
         self._producer = threading.Thread(
             target=self._produce, daemon=True, name="zoo-prefetch-producer")
-        self._producer.start()
+        if start:
+            self._producer.start()
+
+    def start(self) -> "PrefetchPipeline":
+        """Start the producer (no-op if already running).  Construct
+        with ``start=False`` when source-side state must attach to
+        :attr:`pool` first — e.g. shard read-ahead: starting the
+        producer before ``set_read_ahead(pipe.pool)`` would let the
+        first loads race the attachment and fall back to synchronous
+        loading on the producer thread."""
+        if not self._producer.is_alive():
+            try:
+                self._producer.start()
+            except RuntimeError:
+                pass  # already started and finished: nothing to do
+        return self
 
     # ------------------------------------------------------------------
     @property
@@ -281,11 +296,16 @@ class PrefetchFeatureSet(FeatureSet):
                 return batch
 
         sharded = inner if isinstance(inner, ShardedFeatureSet) else None
+        # start=False: read-ahead must attach to the pool BEFORE the
+        # producer walks the first shards, or the attachment races the
+        # early loads (observed as synchronous producer-thread loads)
         pipe = PrefetchPipeline(
             inner.batches(*args, **kwargs), map_fn=map_fn,
-            workers=self.workers, depth=self.depth, metrics=self._metrics)
+            workers=self.workers, depth=self.depth, metrics=self._metrics,
+            start=False)
         if sharded is not None:
             sharded.set_read_ahead(pipe.pool)
+        pipe.start()
         try:
             yield from pipe
         finally:

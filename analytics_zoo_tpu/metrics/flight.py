@@ -191,7 +191,7 @@ class StragglerDetector:
             raise ValueError(f"straggler factor k={k} must be > 1")
         self.k = float(k)
         self.min_steps = int(min_steps)
-        self._window: collections.deque = collections.deque(
+        self._window: collections.deque = collections.deque(  # guarded-by: _lock
             maxlen=int(window))
         self._lock = threading.Lock()
 
@@ -224,7 +224,7 @@ class StragglerDetector:
 # arms the crash dump; ZOO_FLIGHT_EVENTS overrides the ring capacity.
 # ---------------------------------------------------------------------------
 
-_default: FlightRecorder | None = None
+_default: FlightRecorder | None = None  # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

@@ -140,7 +140,7 @@ def _record_transition(component: str, healthy: bool, age: float):
 # Process-global default, matching get_registry()/get_tracer().
 # ---------------------------------------------------------------------------
 
-_default: HealthRegistry | None = None
+_default: HealthRegistry | None = None  # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

@@ -231,7 +231,7 @@ class MetricsServer:
 # loop (serving, fit) reaches it first.
 # ---------------------------------------------------------------------------
 
-_env_server: MetricsServer | None = None
+_env_server: MetricsServer | None = None  # guarded-by: _env_lock
 _env_lock = threading.Lock()
 
 

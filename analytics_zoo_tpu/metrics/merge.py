@@ -156,7 +156,7 @@ class TelemetryAggregator:
         self._registry = registry
         self._lock = threading.Lock()
         # key -> (source_labels, snapshot, ingest_time)
-        self._sources: dict[tuple, tuple[dict, dict, float]] = {}
+        self._sources: dict[tuple, tuple[dict, dict, float]] = {}  # guarded-by: _lock
 
     def ingest(self, snap: dict, **source_labels) -> tuple:
         if not source_labels:

@@ -465,7 +465,7 @@ class MetricsRegistry:
 # the env tier matching ZooConfig's other knobs (common/engine.py).
 # ---------------------------------------------------------------------------
 
-_default: MetricsRegistry | None = None
+_default: MetricsRegistry | None = None  # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

@@ -199,7 +199,7 @@ def span(name: str, sync=None, args: dict | None = None,
 # ZOO_TRACE_EVENTS overrides the event cap.
 # ---------------------------------------------------------------------------
 
-_default: Tracer | None = None
+_default: Tracer | None = None  # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

@@ -52,7 +52,7 @@ class InferenceModel:
         self._net = None
         self._params = None
         self._state = None
-        self._compiled = {}       # shape-key -> compiled executable
+        self._compiled = {}  # guarded-by: _lock -- shape-key -> executable
         self._lock = threading.Lock()
         self._quantized = False
         self._int8_model = None
@@ -90,7 +90,8 @@ class InferenceModel:
         self._net = net
         self._params = net.params
         self._state = net.state
-        self._compiled = {}
+        with self._lock:
+            self._compiled = {}
         self._quantized = False
         self._int8_model = None
         self._bf16 = False
@@ -106,7 +107,8 @@ class InferenceModel:
         module.eval()
         self._torch = (module, torch)
         self._net = None
-        self._compiled = {}
+        with self._lock:
+            self._compiled = {}
         return self
 
     def optimize(self, precision: str = "int8",
@@ -149,7 +151,8 @@ class InferenceModel:
             )
             self._quantized = False
             self._bf16 = True
-        self._compiled = {}
+        with self._lock:
+            self._compiled = {}
         return self
 
     @staticmethod

@@ -84,8 +84,8 @@ def _new_id() -> str:
 
 class InMemoryBroker(Broker):
     def __init__(self, max_records: int = 1_000_000):
-        self._streams: dict[str, list] = {}
-        self._hashes: dict[str, dict] = {}
+        self._streams: dict[str, list] = {}  # guarded-by: _cv
+        self._hashes: dict[str, dict] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
         self._max_records = max_records
 

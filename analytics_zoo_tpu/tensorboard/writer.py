@@ -28,7 +28,9 @@ class FileWriter:
             int(time.time()), socket.gethostname(), filename_suffix
         )
         self.path = os.path.join(log_dir, fname)
-        self._fh = open(self.path, "ab")
+        # writes AND close serialize on _lock: a concurrent _write either
+        # completes before the close or sees closed-and-drops
+        self._fh = open(self.path, "ab")  # guarded-by: _lock
         self._lock = threading.Lock()
         self._write(encode_event(file_version="brain.Event:2"))
 

@@ -1,0 +1,8 @@
+"""Shared locks for the cross-file ABBA fixtures — the static pass must
+unify `LOCK_A`/`LOCK_B` across the two importing modules, and the
+runtime sanitizer must wrap them when this directory is watched."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()

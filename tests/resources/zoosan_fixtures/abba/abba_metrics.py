@@ -1,0 +1,10 @@
+"""Other half of the planted cross-file ABBA: B then A (the "metrics
+export" side)."""
+
+from abba_locks import LOCK_A, LOCK_B
+
+
+def b_then_a():
+    with LOCK_B:
+        with LOCK_A:  # POSITIVE (with abba_serving.a_then_b)
+            return "ba"

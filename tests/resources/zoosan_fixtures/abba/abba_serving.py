@@ -1,0 +1,12 @@
+"""Half of the planted cross-file ABBA: A then B (the "serving" side).
+No single-file witness exists — `abba_metrics.py` holds the reverse
+order, so only the whole-program pass (or the runtime lockdep) sees the
+cycle."""
+
+from abba_locks import LOCK_A, LOCK_B
+
+
+def a_then_b():
+    with LOCK_A:
+        with LOCK_B:  # POSITIVE (with abba_metrics.b_then_a)
+            return "ab"

@@ -1,0 +1,6 @@
+"""Locks for the SUPPRESSED cross-file ABBA variant."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()

@@ -1,0 +1,10 @@
+"""Suppressed variant of the cross-file ABBA (B-then-A side)."""
+
+from abba_locks import LOCK_A, LOCK_B
+
+
+def b_then_a():
+    with LOCK_B:
+        # zoolint: disable=lock-order-global -- planted fixture: order is owned by the test harness
+        with LOCK_A:
+            return "ba"

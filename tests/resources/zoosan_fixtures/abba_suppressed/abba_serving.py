@@ -1,0 +1,13 @@
+"""Suppressed variant of the cross-file ABBA (A-then-B side): the
+justified suppression at the witness site silences the whole-program
+finding — the fixture pins that interprocedural findings honor the same
+comment syntax as Tier 1."""
+
+from abba_locks import LOCK_A, LOCK_B
+
+
+def a_then_b():
+    with LOCK_A:
+        # zoolint: disable=lock-order-global -- planted fixture: order is owned by the test harness
+        with LOCK_B:
+            return "ab"

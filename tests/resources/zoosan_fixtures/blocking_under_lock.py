@@ -1,0 +1,33 @@
+"""Planted blocking-calls-under-lock: an unbounded queue put and a
+sleep while holding a lock are findings; the bounded get is not; the
+suppressed sleep carries its justification."""
+
+import queue
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+def sleep_under_lock():
+    with LOCK:
+        time.sleep(0.001)  # POSITIVE
+
+
+def unbounded_put_under_lock(q: queue.Queue):
+    with LOCK:
+        q.put("item")  # POSITIVE: block=True, timeout=None
+
+
+def bounded_get_under_lock(q: queue.Queue):
+    with LOCK:
+        try:
+            return q.get(timeout=0.001)  # negative: bounded wait
+        except queue.Empty:
+            return None
+
+
+def suppressed_sleep_under_lock():
+    with LOCK:
+        # zoolint: disable=san-blocking-under-lock -- planted suppressed case: bounded test-only pause
+        time.sleep(0.001)

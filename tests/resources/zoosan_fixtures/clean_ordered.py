@@ -1,0 +1,30 @@
+"""Negative fixture: two locks always taken in the SAME order, guarded
+writes under their lock, bounded waits only — zero findings from both
+the static whole-program pass and the runtime sanitizer."""
+
+import threading
+
+OUTER = threading.Lock()
+INNER = threading.Lock()
+
+
+class OrderedPair:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._value += 1
+
+    def nested_consistent(self):
+        with OUTER:
+            with INNER:
+                with self._lock:
+                    self._value += 1
+
+
+def also_consistent():
+    with OUTER:
+        with INNER:
+            return "ok"

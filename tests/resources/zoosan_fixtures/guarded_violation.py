@@ -1,0 +1,24 @@
+"""Planted guarded-by runtime violation: `_state` declares its lock,
+`bad_write` ignores it.  The runtime sanitizer (after
+`instrument_module`) must flag `bad_write` and stay quiet for
+`good_write` and for the statically-suppressed `lockfree_write` (one
+justification covers both halves)."""
+
+import threading
+
+
+class GuardedBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0  # guarded-by: _lock
+
+    def good_write(self, v):
+        with self._lock:
+            self._state = v
+
+    def bad_write(self, v):  # POSITIVE at runtime (and for Tier 1)
+        self._state = v
+
+    def lockfree_write(self, v):
+        # zoolint: disable=guarded-by -- planted suppressed case: atomic replace, last-writer-wins
+        self._state = v

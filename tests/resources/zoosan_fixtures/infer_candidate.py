@@ -1,0 +1,52 @@
+"""Guarded-by inference fixtures.
+
+`MixedWrites._items` is written once under `_lock` and once without it
+(no annotation) — the inference pass must emit a
+``guarded-by-candidate`` naming the unlocked site.  `HelperLocked`
+writes only inside a private helper whose every call site holds the
+lock — the interprocedural fact makes those writes count as locked, so
+the candidate finding must report NO unlocked writes.  `Annotated` is
+the negative: the declaration already exists."""
+
+import threading
+
+
+class MixedWrites:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def reset(self):  # POSITIVE: unlocked write to a sometimes-locked attr
+        self._items = []
+
+
+class HelperLocked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def bump_twice(self):
+        with self._lock:
+            self._bump_locked()
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._count += 1  # locked via every caller (interproc fact)
+
+
+class Annotated:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0  # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._total += x

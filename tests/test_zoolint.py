@@ -305,12 +305,16 @@ class TestCli:
 
 
 def test_package_is_clean():
-    """`python tools/zoolint.py analytics_zoo_tpu/` must exit 0: every
-    real violation the detectors surface is either fixed or justified
-    with a reviewed suppression comment."""
+    """`python tools/zoolint.py --whole-program analytics_zoo_tpu/`
+    must exit 0: every real violation the per-file detectors AND the
+    interprocedural pass (cross-module lock-order, guarded-by
+    inference) surface is either fixed or justified with a reviewed
+    suppression comment."""
     from analytics_zoo_tpu.analysis import lint_paths, render_text
+    from analytics_zoo_tpu.analysis.rules_interproc import lint_program
 
-    findings = lint_paths([os.path.join(REPO, "analytics_zoo_tpu")])
+    pkg = os.path.join(REPO, "analytics_zoo_tpu")
+    findings = lint_paths([pkg]) + lint_program(pkg)
     active = _active(findings)
     assert not active, "unsuppressed zoolint findings:\n" + \
         render_text(active)

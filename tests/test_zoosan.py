@@ -1,0 +1,685 @@
+"""Tier-3 "zoosan" tests: whole-program static concurrency analysis
+(callgraph + interprocedural lock-order + guarded-by inference) and the
+runtime lockdep sanitizer (``ZOO_SAN=1``).
+
+Static fixtures live in tests/resources/zoosan_fixtures/ — a planted
+cross-file ABBA (two modules, opposite nesting order, no single-file
+witness), its suppressed variant, a guarded-by runtime violation, the
+blocking-under-lock shapes, and a clean (consistently ordered) negative
+— mirroring the zoolint fixture convention of positive + suppressed
+cases.  The runtime tests install/uninstall the sanitizer in-process
+when the session is not already running under ``ZOO_SAN=1``.
+
+CI gates here: ``test_package_lock_graph_acyclic`` (the statically
+extracted whole-package lock graph has no cycles) and
+``test_package_inference_zero_gaps`` (every lock-guarded attribute is
+annotated or justified — 14/14 lock-holding modules covered).  The
+companion gate ``test_zoolint.py::test_package_is_clean`` runs the
+interprocedural pass over the package as part of the quick tier.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "analytics_zoo_tpu")
+FIXTURES = os.path.join(REPO, "tests", "resources", "zoosan_fixtures")
+
+
+def _load_module(relpath, name=None):
+    """Import one fixture file (its directory goes on sys.path so flat
+    sibling imports like ``from abba_locks import ...`` resolve)."""
+    path = os.path.join(FIXTURES, relpath)
+    name = name or os.path.splitext(os.path.basename(path))[0]
+    sys.path.insert(0, os.path.dirname(path))
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# Callgraph: the linked whole-package view.
+# ---------------------------------------------------------------------------
+
+
+class TestCallGraph:
+    @pytest.fixture(scope="class")
+    def prog(self):
+        from analytics_zoo_tpu.analysis.callgraph import load_program
+
+        return load_program(PKG)
+
+    def test_loads_the_whole_package(self, prog):
+        assert len(prog.modules) > 100
+        assert len(prog.functions) > 1000
+
+    def test_typed_locks_are_discovered(self, prog):
+        broker = ("analytics_zoo_tpu.serving.broker", "InMemoryBroker")
+        assert "_cv" in prog.class_locks[broker]
+        assert prog.class_locks[broker]["_cv"].factory \
+            == "threading.Condition"
+        assert prog.class_locks[broker]["_cv"].lock_id \
+            == "analytics_zoo_tpu.serving.broker.InMemoryBroker._cv"
+        assert "_lock" in prog.class_locks[
+            ("analytics_zoo_tpu.metrics.registry", "MetricsRegistry")]
+        assert "_LOCK" in prog.module_locks[
+            "analytics_zoo_tpu.common.engine"]
+
+    def test_cross_module_call_edge_reaches_foreign_lock(self, prog):
+        """InferenceModel._get_compiled compiles under its own lock and
+        calls into compile_cache — the lock graph must contain that
+        cross-module edge (no single file shows both locks)."""
+        from analytics_zoo_tpu.analysis.rules_interproc import (
+            build_lock_graph,
+        )
+
+        edges = build_lock_graph(prog)
+        assert ("analytics_zoo_tpu.pipeline.inference.inference_model"
+                ".InferenceModel._lock",
+                "analytics_zoo_tpu.common.compile_cache._LOCK") in edges
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural lock order (static half).
+# ---------------------------------------------------------------------------
+
+
+class TestInterprocLockOrder:
+    def test_cross_file_abba_detected(self):
+        from analytics_zoo_tpu.analysis.callgraph import load_program
+        from analytics_zoo_tpu.analysis.rules_interproc import (
+            build_lock_graph,
+            find_cycles,
+            lint_program,
+        )
+
+        root = os.path.join(FIXTURES, "abba")
+        prog = load_program(root)
+        cycles = find_cycles(build_lock_graph(prog))
+        assert cycles, "planted cross-file ABBA not found"
+        (cycle,) = cycles
+        assert {lid.rsplit(".", 1)[1] for lid in set(cycle)} \
+            == {"LOCK_A", "LOCK_B"}
+
+        findings = lint_program(root)
+        active = _active(findings)
+        assert [f.rule for f in active] == ["lock-order-global"]
+        (f,) = active
+        # both witness sites, one per module, land in the finding
+        paths = {s["path"] for s in f.data["sites"]}
+        assert any("abba_serving" in p for p in paths)
+        assert any("abba_metrics" in p for p in paths)
+
+    def test_suppressed_variant_is_quiet(self):
+        from analytics_zoo_tpu.analysis.rules_interproc import lint_program
+
+        findings = lint_program(os.path.join(FIXTURES, "abba_suppressed"))
+        assert not _active(findings)
+        assert any(f.rule == "lock-order-global" and f.suppressed
+                   for f in findings)
+
+    def test_lock_named_locals_do_not_merge(self, tmp_path):
+        """A local variable merely NAMED `lock` must not become a
+        program-wide node: two unrelated locals in different modules
+        nested oppositely around a shared lock are not a cycle."""
+        from analytics_zoo_tpu.analysis.callgraph import load_program
+        from analytics_zoo_tpu.analysis.rules_interproc import (
+            build_lock_graph,
+            find_cycles,
+        )
+
+        (tmp_path / "shared.py").write_text(
+            "import threading\nL = threading.Lock()\n")
+        (tmp_path / "a.py").write_text(
+            "from shared import L\n"
+            "def fa():\n"
+            "    lock = object()\n"
+            "    with lock:\n"
+            "        with L:\n"
+            "            pass\n")
+        (tmp_path / "b.py").write_text(
+            "from shared import L\n"
+            "def fb():\n"
+            "    lock = object()\n"
+            "    with L:\n"
+            "        with lock:\n"
+            "            pass\n")
+        prog = load_program(str(tmp_path), package="p")
+        assert find_cycles(build_lock_graph(prog)) == []
+
+    def test_same_named_classes_do_not_share_lock_ids(self, tmp_path):
+        """Two classes both named Worker in different modules own
+        DIFFERENT `_lock`s — opposite nesting vs a shared module lock
+        must not read as a cycle."""
+        from analytics_zoo_tpu.analysis.callgraph import load_program
+        from analytics_zoo_tpu.analysis.rules_interproc import (
+            build_lock_graph,
+            find_cycles,
+        )
+
+        (tmp_path / "shared.py").write_text(
+            "import threading\nL = threading.Lock()\n")
+        common = ("import threading\nfrom shared import L\n"
+                  "class Worker:\n"
+                  "    def __init__(self):\n"
+                  "        self._lock = threading.Lock()\n")
+        (tmp_path / "a.py").write_text(
+            common + "    def go(self):\n"
+                     "        with self._lock:\n"
+                     "            with L:\n"
+                     "                pass\n")
+        (tmp_path / "b.py").write_text(
+            common + "    def go(self):\n"
+                     "        with L:\n"
+                     "            with self._lock:\n"
+                     "                pass\n")
+        prog = load_program(str(tmp_path), package="p")
+        edges = build_lock_graph(prog)
+        assert find_cycles(edges) == []
+        assert ("p.a.Worker._lock", "p.shared.L") in edges
+        assert ("p.shared.L", "p.b.Worker._lock") in edges
+
+    def test_clean_fixture_is_quiet(self):
+        """The consistently ordered negative contributes nothing, even
+        when linted alongside the planted positives."""
+        from analytics_zoo_tpu.analysis.rules_interproc import lint_program
+
+        findings = lint_program(FIXTURES, package="zoosan_fixtures")
+        clean = [f for f in _active(findings)
+                 if f.path.endswith("clean_ordered.py")]
+        assert clean == []
+
+
+# ---------------------------------------------------------------------------
+# Guarded-by inference (static half).
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedByInference:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        from analytics_zoo_tpu.analysis.rules_interproc import lint_program
+
+        return lint_program(FIXTURES, package="zoosan_fixtures")
+
+    def _candidates(self, findings, cls):
+        return [f for f in _active(findings)
+                if f.rule == "guarded-by-candidate"
+                and f.data.get("cls") == cls]
+
+    def test_mixed_writes_become_a_candidate(self, findings):
+        (f,) = self._candidates(findings, "MixedWrites")
+        assert f.data["attribute"] == "_items"
+        assert f.data["lock"] == "_lock"
+        unlocked = f.data["unlocked_writes"]
+        assert len(unlocked) == 1
+        assert unlocked[0]["method"] == "MixedWrites.reset"
+
+    def test_private_helper_counts_as_locked(self, findings):
+        """Every call site of `_bump_locked` holds the lock — the
+        interprocedural fact retires the false unlocked-write."""
+        (f,) = self._candidates(findings, "HelperLocked")
+        assert f.data["attribute"] == "_count"
+        assert f.data["unlocked_writes"] == []
+
+    def test_annotated_class_is_not_a_candidate(self, findings):
+        assert self._candidates(findings, "Annotated") == []
+
+
+# ---------------------------------------------------------------------------
+# Package-level CI gates.
+# ---------------------------------------------------------------------------
+
+
+def test_package_lock_graph_acyclic():
+    """The statically extracted whole-package lock graph must stay
+    acyclic — this is the deadlock-freedom gate for every lock the 14
+    lock-holding modules take, including cross-module chains."""
+    from analytics_zoo_tpu.analysis.callgraph import load_program
+    from analytics_zoo_tpu.analysis.rules_interproc import (
+        build_lock_graph,
+        find_cycles,
+    )
+
+    edges = build_lock_graph(load_program(PKG))
+    assert edges, "lock graph unexpectedly empty (extraction broke?)"
+    cycles = find_cycles(edges)
+    assert cycles == [], f"whole-package lock cycle(s): {cycles}"
+
+
+def test_package_inference_zero_gaps():
+    """Acceptance: the guarded-by inference reports zero remaining
+    `guarded-by-candidate` gaps over the package — every lock-guarded
+    attribute is annotated (or carries a justified suppression)."""
+    from analytics_zoo_tpu.analysis.rules_interproc import lint_program
+
+    gaps = [f for f in _active(lint_program(PKG))
+            if f.rule == "guarded-by-candidate"]
+    assert gaps == [], "\n".join(
+        f"{f.path}:{f.line} {f.message}" for f in gaps)
+
+
+def test_every_lock_holding_module_is_annotated():
+    """14/14: each module that creates a lock carries at least one
+    `# guarded-by:` annotation or a justified zoolint suppression."""
+    from analytics_zoo_tpu.analysis.astlint import (
+        iter_python_files,
+        parse_module,
+    )
+
+    lockish = ("threading.Lock(", "threading.RLock(",
+               "threading.Condition(")
+    missing, holders = [], []
+    for path in iter_python_files([PKG]):
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        if not any(tok in source for tok in lockish):
+            continue
+        holders.append(path)
+        mod = parse_module(source, path)
+        covered = bool(mod.guarded_by_lines) or bool(
+            mod.file_suppressions) or bool(mod.suppressions)
+        if not covered:
+            missing.append(path)
+    assert len(holders) >= 14, holders
+    assert missing == [], f"lock-holding modules without guarded-by " \
+                          f"annotations or suppressions: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# Runtime sanitizer.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def san():
+    """The sanitizer, installed (reusing the session-wide install when
+    the tier runs under ZOO_SAN=1), watching the fixture tree, with
+    findings drained on both sides of the test."""
+    from analytics_zoo_tpu.analysis import sanitizer
+
+    was_installed = sanitizer.installed()
+    if not was_installed:
+        sanitizer.install()
+    sanitizer.watch_path(FIXTURES)
+    sanitizer.drain()
+    yield sanitizer
+    sanitizer.drain()
+    if not was_installed:
+        sanitizer.uninstall()
+
+
+class TestRuntimeLockdep:
+    def test_planted_abba_is_caught_with_both_stacks(self, san):
+        a = _load_module("abba/abba_serving.py")
+        b = _load_module("abba/abba_metrics.py")
+        assert a.a_then_b() == "ab"
+        assert b.b_then_a() == "ba"
+        found = [f for f in san.drain() if f.rule == "san-lock-order"]
+        assert len(found) == 1
+        (f,) = found
+        locks = {c.rsplit(":", 1)[0] for c in f.data["cycle"]}
+        assert locks == {os.path.join("abba", "abba_locks.py")}
+        # the structured finding carries BOTH acquisition stacks
+        assert "abba_metrics" in f.data["this_stack"] \
+            or "abba_serving" in f.data["this_stack"]
+        assert f.data["reverse_stack"].strip()
+        sys.modules.pop("abba_locks", None)
+
+    def test_cross_thread_release_does_not_leak_held(self, san):
+        """A Lock acquired on thread A and released on thread B (the
+        legal handoff pattern) must not leave a phantom hold on A that
+        flags every later sleep/acquire."""
+        import threading
+        import time
+
+        mod = _load_module("blocking_under_lock.py")
+        lock = mod.LOCK  # a sanitized lock from the watched fixture
+        assert lock.acquire()
+        t = threading.Thread(target=lock.release)
+        t.start()
+        t.join()
+        time.sleep(0.001)  # would be flagged if the hold leaked
+        mod.bounded_get_under_lock(__import__("queue").Queue())
+        assert [f.rule for f in san.drain()] == []
+
+    def test_consistent_order_is_quiet(self, san):
+        clean = _load_module("clean_ordered.py")
+        pair = clean.OrderedPair()
+        pair.bump()
+        pair.nested_consistent()
+        clean.also_consistent()
+        assert [f.rule for f in san.drain()] == []
+
+
+class TestRuntimeGuardedBy:
+    def test_violation_caught_good_and_suppressed_quiet(self, san):
+        mod = _load_module("guarded_violation.py")
+        assert san.instrument_module(mod) == 1
+        box = mod.GuardedBox()  # __init__ writes are exempt
+        box.good_write(1)
+        box.lockfree_write(2)  # statically suppressed => runtime quiet
+        assert san.findings() == []
+        box.bad_write(3)
+        found = san.drain()
+        assert [f.rule for f in found] == ["san-guarded-by"]
+        (f,) = found
+        assert f.data["attribute"] == "_state"
+        assert f.data["lock"] == "_lock"
+        assert "bad_write" in f.data["stack"]
+
+    def test_package_annotation_validated_when_session_sanitized(self):
+        """Under a ZOO_SAN=1 session the real broker's Condition is
+        wrapped at import — writing its guarded dict without the lock
+        must be flagged (the static annotation, proven at runtime)."""
+        from analytics_zoo_tpu.analysis import sanitizer
+
+        if not (os.environ.get("ZOO_SAN") == "1"
+                and sanitizer.installed()):
+            pytest.skip("needs a session-wide ZOO_SAN=1 install")
+        import analytics_zoo_tpu.serving.broker as broker_mod
+
+        sanitizer.instrument_module(broker_mod)
+        broker = broker_mod.InMemoryBroker()
+        assert type(broker._cv._lock).__name__ == "SanRLock"
+        sanitizer.drain()
+        broker._streams = {}  # naked write to a guarded attribute
+        found = [f for f in sanitizer.drain()
+                 if f.rule == "san-guarded-by"]
+        assert found and found[0].data["attribute"] == "_streams"
+
+
+class TestRuntimeBlocking:
+    def test_sleep_and_unbounded_put_flagged_bounded_get_not(self, san):
+        import queue
+
+        mod = _load_module("blocking_under_lock.py")
+        q = queue.Queue()
+        mod.sleep_under_lock()
+        mod.unbounded_put_under_lock(q)
+        mod.bounded_get_under_lock(q)
+        mod.suppressed_sleep_under_lock()
+        found = san.drain()
+        calls = sorted(f.data["call"] for f in found)
+        assert calls == ["queue.Queue.put(timeout=None)",
+                         "time.sleep(0.001)"]
+        assert all(f.rule == "san-blocking-under-lock" for f in found)
+
+    def test_held_locks_are_named(self, san):
+        mod = _load_module("blocking_under_lock.py")
+        mod.sleep_under_lock()
+        (f,) = san.drain()
+        assert any("blocking_under_lock.py" in lk
+                   for lk in f.data["locks"])
+
+
+class TestZeroCostDisabled:
+    def test_threading_lock_identity_when_env_unset(self):
+        """Acceptance: with ZOO_SAN unset, importing the package
+        patches NOTHING — threading.Lock stays the builtin."""
+        env = {k: v for k, v in os.environ.items() if k != "ZOO_SAN"}
+        code = (
+            "import sys, threading, _thread\n"
+            "import analytics_zoo_tpu\n"
+            "assert 'analytics_zoo_tpu.analysis.sanitizer' not in "
+            "sys.modules  # disabled path imports NO analysis module\n"
+            "from analytics_zoo_tpu.analysis import sanitizer\n"
+            "assert threading.Lock is _thread.allocate_lock\n"
+            "assert threading.RLock is not None\n"
+            "assert not sanitizer.installed()\n"
+            "import time, queue\n"
+            "assert not getattr(time.sleep, '_zoo_san', False)\n"
+            "assert not getattr(queue.Queue.put, '_zoo_san', False)\n"
+            "print('untouched')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "untouched" in out.stdout
+
+    def test_enabled_subprocess_wraps_package_locks(self):
+        """The flip side: ZOO_SAN=1 wraps the package's module-level
+        locks at import time."""
+        env = dict(os.environ, ZOO_SAN="1")
+        code = (
+            "import analytics_zoo_tpu\n"
+            "from analytics_zoo_tpu.common import engine\n"
+            "from analytics_zoo_tpu.analysis import sanitizer\n"
+            "assert sanitizer.installed()\n"
+            "assert type(engine._LOCK).__name__ == 'SanLock', "
+            "type(engine._LOCK)\n"
+            "print('wrapped')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "wrapped" in out.stdout
+
+
+class TestTelemetryIntegration:
+    def test_findings_hit_metrics_and_flight(self, san):
+        from analytics_zoo_tpu.metrics import (
+            get_flight_recorder,
+            get_registry,
+        )
+
+        mod = _load_module("blocking_under_lock.py")
+        mod.sleep_under_lock()
+        assert san.findings()
+        reg = get_registry()
+        total = 0.0
+        for fam in reg.collect():
+            if fam.name == "zoo_san_findings_total":
+                for labels, child in fam.samples():
+                    if labels.get("rule") == "san-blocking-under-lock":
+                        total += child.get()
+        assert total >= 1
+        events = get_flight_recorder().events("san_finding")
+        assert any(e["rule"] == "san-blocking-under-lock"
+                   for e in events)
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --changed, --whole-program, bare-suppression.
+# ---------------------------------------------------------------------------
+
+
+class TestCliSatellites:
+    def test_changed_lints_only_modified_files(self, tmp_path,
+                                               monkeypatch, capsys):
+        from analytics_zoo_tpu.analysis.cli import main
+
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        monkeypatch.chdir(repo)
+        git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+        subprocess.run(["git", "init", "-q"], check=True)
+        (repo / "clean.py").write_text("x = 1\n")
+        (repo / "dirty.py").write_text("x = 1\n")
+        subprocess.run([*git, "add", "."], check=True)
+        subprocess.run([*git, "commit", "-qm", "seed"], check=True)
+        # no origin/main: falls back to the working-tree diff
+        (repo / "dirty.py").write_text(
+            "try:\n    x = 1\nexcept:\n    pass\n")
+        (repo / "fresh.py").write_text("import time\n")  # untracked
+        rc = main(["--changed"])
+        out = capsys.readouterr().out
+        assert rc == 1  # the bare except in dirty.py
+        assert "dirty.py" in out
+        assert "clean.py" not in out
+        # cwd-independence: from a subdirectory the same changes must
+        # still be found (a subdir invocation reading as clean would
+        # green-light a broken pre-commit)
+        sub = repo / "sub"
+        sub.mkdir()
+        monkeypatch.chdir(sub)
+        rc = main(["--changed"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "dirty.py" in out
+
+    def test_changed_clean_tree_exits_zero(self, tmp_path, monkeypatch,
+                                           capsys):
+        from analytics_zoo_tpu.analysis.cli import main
+
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        monkeypatch.chdir(repo)
+        subprocess.run(["git", "init", "-q"], check=True)
+        rc = main(["--changed"])
+        assert rc == 0
+        assert "nothing to lint" in capsys.readouterr().out
+
+    def test_whole_program_flag_finds_cross_file_abba(self, capsys):
+        from analytics_zoo_tpu.analysis.cli import main
+
+        rc = main(["--whole-program", os.path.join(FIXTURES, "abba")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "lock-order-global" in out
+
+    def test_precommit_script_exists_and_is_executable(self):
+        path = os.path.join(REPO, "tools", "precommit.sh")
+        assert os.path.exists(path)
+        assert os.access(path, os.X_OK)
+        with open(path) as f:
+            body = f.read()
+        assert "--changed" in body and "ZOO_SAN=1" in body
+
+    def test_bare_suppression_is_a_warning(self):
+        from analytics_zoo_tpu.analysis import lint_source
+
+        src = ("try:\n"
+               "    x = 1\n"
+               "except:  # zoolint: disable=bare-except\n"
+               "    pass\n")
+        findings = lint_source(src)
+        assert [f.rule for f in _active(findings)] == ["bare-suppression"]
+
+    def test_justified_suppression_is_quiet(self):
+        from analytics_zoo_tpu.analysis import lint_source
+
+        src = ("try:\n"
+               "    x = 1\n"
+               "except:  # zoolint: disable=bare-except -- probe must\n"
+               "    pass\n")
+        assert _active(lint_source(src)) == []
+
+    def test_every_package_suppression_is_justified(self):
+        """Satellite burn-down: the surviving suppressions all carry a
+        `--` justification (bare ones are warnings the clean gate would
+        catch; this pins it directly)."""
+        from analytics_zoo_tpu.analysis.astlint import (
+            iter_python_files,
+            parse_module,
+        )
+
+        bare = []
+        for path in iter_python_files([PKG]):
+            with open(path, encoding="utf-8") as fh:
+                mod = parse_module(fh.read(), path)
+            for line in mod.unjustified_suppressions:
+                bare.append(f"{path}:{line}")
+        assert bare == [], f"unjustified suppressions: {bare}"
+
+
+# ---------------------------------------------------------------------------
+# HLO satellite: collective + gather/scatter byte accounting.
+# ---------------------------------------------------------------------------
+
+
+class TestHloCollectiveBytes:
+    def _two_device_mesh(self):
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:2]), ("d",))
+
+    def test_reduce_scatter_bytes_hand_count(self):
+        """2-device reduce-scatter of a per-device tensor<4xf32>: the
+        FULL 16-byte shard participates even though each device keeps
+        8 bytes — hand count pinned."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from analytics_zoo_tpu.analysis import analyze_hlo_text
+
+        mesh = self._two_device_mesh()
+        fn = shard_map(
+            lambda x: jax.lax.psum_scatter(
+                x, "d", scatter_dimension=0, tiled=True),
+            mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        text = jax.jit(fn).lower(jnp.ones((8,), jnp.float32)).as_text()
+        rpt = analyze_hlo_text(text, label="rs")
+        assert rpt.collectives == {"reduce_scatter": 1}
+        assert rpt.collective_count == 1
+        # per-device operand: 8/2 = 4 f32 = 16 bytes (result is 2xf32,
+        # 8 bytes — the old result-only accounting undercounted 2x)
+        assert rpt.collective_bytes == 16
+
+    def test_all_to_all_and_permute_counted(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from analytics_zoo_tpu.analysis import analyze_hlo_text
+
+        mesh = self._two_device_mesh()
+        a2a = shard_map(
+            lambda x: jax.lax.all_to_all(
+                x, "d", split_axis=1, concat_axis=0, tiled=True),
+            mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        rpt = analyze_hlo_text(
+            jax.jit(a2a).lower(jnp.ones((4, 4), jnp.float32)).as_text(),
+            label="a2a")
+        assert rpt.collectives == {"all_to_all": 1}
+        assert rpt.collective_bytes == 32  # per-device 2x4 f32
+
+        perm = shard_map(
+            lambda x: jax.lax.ppermute(x, "d", perm=[(0, 1), (1, 0)]),
+            mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        rpt = analyze_hlo_text(
+            jax.jit(perm).lower(jnp.ones((4,), jnp.float32)).as_text(),
+            label="perm")
+        assert rpt.collectives == {"collective_permute": 1}
+        assert rpt.collective_bytes == 8  # per-device 2xf32
+
+    def test_gather_charges_slices_not_the_table(self):
+        """An embedding-style x[i] gather reads indices + slices (result
+        sized), not the whole table: 4x1 i32 indices (16B) + 2x the
+        4x8 f32 result (256B) = 272 — NOT the 512-byte table."""
+        from analytics_zoo_tpu.analysis import analyze_hlo_text
+
+        line = ('%6 = "stablehlo.gather"(%arg0, %5) <{slice_sizes = '
+                'array<i64: 1, 8>}> : (tensor<16x8xf32>, '
+                'tensor<4x1xi32>) -> tensor<4x8xf32>')
+        rpt = analyze_hlo_text(line, label="g")
+        assert rpt.op_histogram.get("gather") == 1
+        assert rpt.bytes_accessed == 16 + 2 * 128
+
+    def test_scatter_charges_updates_not_the_table(self):
+        from analytics_zoo_tpu.analysis import analyze_hlo_text
+
+        text = ('%7 = "stablehlo.scatter"(%arg0, %5, %6) <{}> ({\n'
+                '^bb0(%a: tensor<f32>, %b: tensor<f32>):\n'
+                '  stablehlo.return %b : tensor<f32>\n'
+                '}) : (tensor<16x8xf32>, tensor<4x1xi32>, '
+                'tensor<4x8xf32>) -> tensor<16x8xf32>')
+        rpt = analyze_hlo_text(text, label="s")
+        assert rpt.op_histogram.get("scatter") == 1
+        # indices (16B) + updates read+written (2*128B); the untouched
+        # 16x8 table is aliased, not traffic
+        assert rpt.bytes_accessed == 16 + 2 * 128

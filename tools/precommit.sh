@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Fast pre-commit loop: lint the files you touched, then run the
+# sanitizer fixture tests so a planted-deadlock-shaped change is caught
+# before CI.  Wire up with:
+#   ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+#
+# Full-tree equivalents (the CI gates):
+#   python tools/zoolint.py --whole-program analytics_zoo_tpu/
+#   ZOO_SAN=1 python -m pytest tests/ -q -m quick
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+
+echo "== zoolint --changed =="
+python tools/zoolint.py --changed
+
+echo "== zoosan quick fixtures (ZOO_SAN=1) =="
+ZOO_SAN=1 python -m pytest tests/test_zoosan.py -q -p no:cacheprovider
+
+echo "precommit: OK"

@@ -1,15 +1,21 @@
-"""zoolint — JAX/concurrency AST linter over the repo (Tier 1 of
+"""zoolint — JAX/concurrency AST linter over the repo (Tiers 1+3 of
 ``analytics_zoo_tpu.analysis``; see docs/static-analysis.md).
 
 Usage:
   python tools/zoolint.py [paths ...]             # default: analytics_zoo_tpu/
+  python tools/zoolint.py --whole-program         # + cross-module lock-order
+                                                  #   and guarded-by inference
+  python tools/zoolint.py --changed               # only files modified vs
+                                                  #   merge-base w/ origin/main
   python tools/zoolint.py --format json
   python tools/zoolint.py --list-rules
   python tools/zoolint.py --rules guarded-by,bare-except tests/
 
 Exit status: 0 clean, 1 when any unsuppressed finding exists (CI /
 pre-commit composable), 2 on usage errors.  The quick-tier gate
-``tests/test_zoolint.py::test_package_is_clean`` runs the same check.
+``tests/test_zoolint.py::test_package_is_clean`` runs the
+``--whole-program`` check; ``tools/precommit.sh`` wires ``--changed``
+plus the zoosan fixture tests into a fast pre-commit loop.
 """
 
 import os
