@@ -69,6 +69,26 @@ PIPE_AXIS = "pipe"
 ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, PIPE_AXIS)
 
 
+def _parse_bytes(raw, name: str) -> int:
+    """Byte-count knob parser: plain int, or a K/M/G (binary) suffix —
+    ``"512M"`` reads as 512 MiB.  Errors name the knob."""
+    s = str(raw).strip()
+    mult = 1
+    if s and s[-1].upper() in "KMG":
+        mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}[s[-1].upper()]
+        s = s[:-1]
+    try:
+        val = int(float(s) * mult)
+    except (ValueError, OverflowError):  # 'inf' overflows int(), not
+        #                                  float() — same bad-knob error
+        raise ValueError(
+            f"{name} must be a byte count (integer, optionally with a "
+            f"K/M/G suffix), got {raw!r}") from None
+    if val < 1:
+        raise ValueError(f"{name} must be >= 1 byte, got {raw!r}")
+    return val
+
+
 @dataclasses.dataclass
 class ZooConfig:
     """Typed engine configuration — the reference's three-tier conf system
@@ -142,6 +162,33 @@ class ZooConfig:
       ZOO_SAN_STRICT           "1": the pytest session fails if
                                sanitizer findings are left un-drained
                                at session end (tests/conftest.py)
+      ZOO_AUTOTUNE             "1": closed-loop autotuning
+                               (feature/autotune.py) — a controller
+                               thread resizes the prefetch worker pool,
+                               queue depth and shard read-ahead online
+                               from the zoo_data_prefetch_* telemetry
+                               (consumer-wait p50 → 0 under the RAM
+                               budget) and hill-climbs
+                               steps_per_dispatch over {1,2,4,8,16}
+                               from measured per-dispatch time.  Loss
+                               trajectory stays bit-identical; every
+                               decision lands in zoo_autotune_*
+                               metrics, the flight ring, and /varz.
+                               Unset: zero new threads, zero overhead.
+      ZOO_AUTOTUNE_RAM_BUDGET  host-RAM budget (bytes; K/M/G suffixes
+                               accepted, e.g. "512M") for the prefetch
+                               window the autotuner may grow into
+                               (default 2G)
+      ZOO_AUTOTUNE_INTERVAL    controller tick seconds (default 0.25)
+      ZOO_AUTOTUNE_MAX_WORKERS cap on the autotuned worker pool
+                               (default min(8, 4 x cpu count) — prefetch
+                               workers scale GIL-releasing IO/decode,
+                               so cores only floor the cap)
+
+    ``ZOO_PREFETCH_WORKERS`` / ``ZOO_PREFETCH_DEPTH`` /
+    ``ZOO_STEPS_PER_DISPATCH`` are validated EAGERLY here: a
+    non-integer or out-of-range value fails at context init with an
+    error naming the env var, never from deep inside the pipeline.
     """
 
     app_name: str = "analytics-zoo-tpu"
@@ -173,6 +220,13 @@ class ZooConfig:
     # GSPMD sharding constraints — 1/n optimizer memory and update compute
     # per chip; parameters stay replicated.  Env: ZOO_SHARD_OPTIMIZER=1.
     shard_optimizer: bool | None = None
+    # Closed-loop autotuning (feature/autotune.py): resize the prefetch
+    # plane online and hill-climb steps_per_dispatch from telemetry.
+    # Env: ZOO_AUTOTUNE=1 plus the budget knobs below.
+    autotune: bool | None = None
+    autotune_ram_budget: int | None = None
+    autotune_interval: float | None = None
+    autotune_max_workers: int | None = None
 
     def __post_init__(self):
         env = os.environ
@@ -184,24 +238,76 @@ class ZooConfig:
                 return cast(env[env_key])
             return default
 
+        def resolve_int(value, env_key, default, minimum):
+            """Eager-validated integer knob: a bad value fails HERE with
+            an error naming its source (env var or field), not from
+            deep inside the pipeline/estimator it configures."""
+            if value is not None:
+                src, raw = "ZooConfig " + env_key[4:].lower(), value
+            elif env_key in env:
+                src, raw = env_key, env[env_key]
+            else:
+                return default
+            try:
+                out = int(str(raw))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{src} must be an integer >= {minimum}, "
+                    f"got {raw!r}") from None
+            if out < minimum:
+                raise ValueError(
+                    f"{src} must be >= {minimum}, got {out}")
+            return out
+
         self.failure_retry_times = resolve(
             self.failure_retry_times, "ZOO_FAILURE_RETRY_TIMES", 5)
         self.profile_steps = resolve(
             self.profile_steps, "ZOO_PROFILE_STEPS", 5)
         self.infeed_depth = resolve(
             self.infeed_depth, "ZOO_INFEED_DEPTH", 2)
-        self.prefetch_workers = resolve(
-            self.prefetch_workers, "ZOO_PREFETCH_WORKERS", 0)
-        self.prefetch_depth = resolve(
-            self.prefetch_depth, "ZOO_PREFETCH_DEPTH", 4)
-        self.steps_per_dispatch = resolve(
-            self.steps_per_dispatch, "ZOO_STEPS_PER_DISPATCH", 1)
-        if self.steps_per_dispatch < 1:
-            raise ValueError(
-                f"steps_per_dispatch must be >= 1, "
-                f"got {self.steps_per_dispatch}")
+        # 0 = prefetch off (the documented default); depth/K floor at 1
+        self.prefetch_workers = resolve_int(
+            self.prefetch_workers, "ZOO_PREFETCH_WORKERS", 0, minimum=0)
+        self.prefetch_depth = resolve_int(
+            self.prefetch_depth, "ZOO_PREFETCH_DEPTH", 4, minimum=1)
+        self.steps_per_dispatch = resolve_int(
+            self.steps_per_dispatch, "ZOO_STEPS_PER_DISPATCH", 1,
+            minimum=1)
         self.shard_optimizer = bool(resolve(
             self.shard_optimizer, "ZOO_SHARD_OPTIMIZER", False))
+        def parse_bool(raw):
+            s = str(raw).strip().lower()
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("", "0", "false", "no", "off"):
+                return False
+            # 'false'-alikes must never silently ENABLE a controller
+            # thread; anything unrecognized fails loudly naming the var
+            raise ValueError(
+                f"ZOO_AUTOTUNE must be a boolean "
+                f"(1/0/true/false/yes/no/on/off), got {raw!r}")
+
+        self.autotune = bool(resolve(
+            self.autotune, "ZOO_AUTOTUNE", False, cast=parse_bool))
+        if self.autotune_ram_budget is None:
+            raw = env.get("ZOO_AUTOTUNE_RAM_BUDGET")
+            if raw:
+                self.autotune_ram_budget = _parse_bytes(
+                    raw, "ZOO_AUTOTUNE_RAM_BUDGET")
+        elif self.autotune_ram_budget < 1:
+            raise ValueError(
+                f"ZooConfig autotune_ram_budget must be >= 1 byte, "
+                f"got {self.autotune_ram_budget}")
+        self.autotune_interval = resolve(
+            self.autotune_interval, "ZOO_AUTOTUNE_INTERVAL", 0.25,
+            cast=float)
+        if self.autotune_interval <= 0:
+            raise ValueError(
+                f"ZOO_AUTOTUNE_INTERVAL must be > 0, "
+                f"got {self.autotune_interval}")
+        self.autotune_max_workers = resolve_int(
+            self.autotune_max_workers, "ZOO_AUTOTUNE_MAX_WORKERS", None,
+            minimum=1)
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
         if self.compile_cache is None:

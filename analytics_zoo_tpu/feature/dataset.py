@@ -207,6 +207,18 @@ def _batch_from_arrays(xs, ys, ws, idx, pad_to=None, process_shard=None):
     return batch
 
 
+def _host_nbytes(d) -> int:
+    """Host bytes of a dict of arrays / array lists (0 for other shapes)
+    — the ONE accounting used for both batch and shard sizes feeding the
+    autotune RAM-budget estimate (feature/autotune.py)."""
+    if not isinstance(d, dict):
+        return 0
+    return int(sum(
+        getattr(a, "nbytes", 0)
+        for v in d.values()
+        for a in (v if isinstance(v, (list, tuple)) else (v,))))
+
+
 def _slice_batch_rows(batch, process_shard):
     """Row-slice an already-materialized global batch (scalars untouched)."""
     if process_shard is None:
@@ -315,6 +327,13 @@ class ShardedFeatureSet(FeatureSet):
         # instead of stalling the feeder cold on every slice advance
         self._ra_pool = None
         self._ra_futures: dict[str, Any] = {}
+        # how many not-yet-resident shards may load ahead (autotune's
+        # read-ahead knob; plain int store — written by the controller
+        # thread, read by the producer, no torn state possible)
+        self._ra_ahead = 1
+        # host bytes of the last loaded shard (autotune RAM estimate:
+        # each read-ahead slot transiently holds ~one shard)
+        self._last_shard_nbytes = 0
 
     @staticmethod
     def _default_loader(path: str) -> dict:
@@ -365,16 +384,39 @@ class ShardedFeatureSet(FeatureSet):
                                for p in self.paths]
         return self._sizes
 
-    def set_read_ahead(self, pool) -> None:
+    def set_read_ahead(self, pool, ahead: int | None = None) -> None:
         """Enable (an executor) / disable (None) shard read-ahead.
 
-        With a pool set, one not-yet-resident shard may be loading in the
-        background — transiently budget+1 slices of memory.  Managed by
+        With a pool set, up to ``ahead`` (default 1) not-yet-resident
+        shards may be loading in the background — transiently
+        budget+ahead slices of memory.  Managed by
         :class:`~analytics_zoo_tpu.feature.prefetch.PrefetchFeatureSet`
-        around each iteration; usable standalone with any executor."""
+        around each iteration; usable standalone with any executor.
+        Disabling (``pool=None``) also resets the read-ahead count to
+        the default 1, so a count tuned by one run's autotune controller
+        never silently leaks into a later non-autotuned run's memory
+        footprint — pass ``ahead=`` to pin a custom count."""
         self._ra_pool = pool
+        if ahead is not None:
+            self.set_read_ahead_count(ahead)
         if pool is None:
             self._ra_futures = {}
+            if ahead is None:
+                self._ra_ahead = 1
+
+    def set_read_ahead_count(self, ahead: int) -> None:
+        """How many shards ahead of the cursor may load concurrently
+        (the autotune read-ahead knob — each extra slot trades ~one
+        shard of host RAM for one fewer cold slice advance)."""
+        if ahead < 1:
+            raise ValueError(f"read-ahead count must be >= 1, got {ahead}")
+        self._ra_ahead = int(ahead)
+
+    @property
+    def last_shard_nbytes(self) -> int:
+        """Host bytes of the most recently loaded shard (0 before any
+        load) — the autotune RAM-budget estimator's per-slot cost."""
+        return self._last_shard_nbytes
 
     def _read_ahead(self, path):
         if self._ra_pool is None or path in self._cache \
@@ -392,8 +434,10 @@ class ShardedFeatureSet(FeatureSet):
             while len(self._cache) >= max(budget, 1):
                 self._cache.pop(next(iter(self._cache)))
             fut = self._ra_futures.pop(path, None)
-            self._cache[path] = (fut.result() if fut is not None
-                                 else self.loader(path))
+            data = fut.result() if fut is not None else self.loader(path)
+            self._cache[path] = data
+            if isinstance(data, dict):
+                self._last_shard_nbytes = _host_nbytes(data)
         return self._cache[path]
 
     @property
@@ -441,12 +485,14 @@ class ShardedFeatureSet(FeatureSet):
                 leftover = rem if rem else None
                 continue
             data = self._load(self.paths[si])
-            if j + 1 < len(shard_order):
-                # overlap the NEXT slice's load with this slice's
-                # consumption (no-op without a read-ahead pool); every
-                # shard after a loaded one is itself loaded, so the
-                # speculation can never be wasted work
-                self._read_ahead(self.paths[shard_order[j + 1]])
+            # overlap the next slice loads with this slice's consumption
+            # (no-op without a read-ahead pool); every shard after a
+            # loaded one is itself loaded, so the speculation can never
+            # be wasted work.  _ra_ahead (autotune's read-ahead knob)
+            # bounds how many load ahead concurrently.
+            for jn in range(j + 1, min(j + 1 + self._ra_ahead,
+                                       len(shard_order))):
+                self._read_ahead(self.paths[shard_order[jn]])
             xs = _as_list(data["x"])
             ys = _as_list(data.get("y"))
             ws = _as_list(data.get("w"))

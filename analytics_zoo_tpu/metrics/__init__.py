@@ -65,6 +65,7 @@ from analytics_zoo_tpu.metrics.registry import (
     set_registry,
 )
 from analytics_zoo_tpu.metrics.runtime import (
+    AutotuneMetrics,
     DataPipelineMetrics,
     ServingMetrics,
     StepMetrics,
@@ -85,7 +86,7 @@ __all__ = [
     "write_jsonl", "TensorBoardExporter",
     "sanitize_metric_name", "sanitize_label_name",
     "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
-    "record_device_memory",
+    "AutotuneMetrics", "record_device_memory",
     "MetricsServer", "maybe_start_from_env",
     "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
     "HealthRegistry", "get_health", "set_health",

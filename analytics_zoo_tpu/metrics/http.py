@@ -171,6 +171,14 @@ class MetricsServer:
         flight = self._flt()
         doc["flight"] = {"events": len(flight.events()),
                          "dropped": flight.dropped}
+        # Autotune decision log (feature/autotune.py): consult
+        # sys.modules only — a process that never turned the controller
+        # on must not import the feature package from a scrape.
+        import sys
+
+        auto = sys.modules.get("analytics_zoo_tpu.feature.autotune")
+        if auto is not None:
+            doc["autotune"] = auto.varz_doc()
         if self.aggregator is not None:
             agg = self.aggregator.merged(include_driver=False)
             doc["aggregate"] = {"sources": agg["sources"],

@@ -98,6 +98,12 @@ class NullMetric:
     def percentile(self, q: float) -> float:
         return 0.0
 
+    def snapshot_state(self):
+        return None
+
+    def delta_since(self, prev) -> dict:
+        return {}
+
 
 NULL = NullMetric()
 
@@ -342,7 +348,9 @@ class _HistogramChild:
     def summary(self) -> dict:
         """{count, sum, mean, p50, p95, p99} — the exporter/report
         shape, all derived from ONE consistent snapshot."""
-        snap = self._snapshot()
+        return self._summary_of(self._snapshot())
+
+    def _summary_of(self, snap) -> dict:
         _, total_sum, c, _ = snap
         return {
             "count": c,
@@ -352,6 +360,44 @@ class _HistogramChild:
             "p95": self._percentile_from(snap, 0.95),
             "p99": self._percentile_from(snap, 0.99),
         }
+
+    def snapshot_state(self) -> tuple:
+        """Opaque cumulative state for :meth:`delta_since` — take one
+        before a window, hand it back after to summarize only what
+        landed in between."""
+        return self._snapshot()
+
+    def delta_since(self, prev: tuple | None) -> dict:
+        """Summary of the observations since ``prev`` (a value from
+        :meth:`snapshot_state`; ``None`` means since child creation).
+
+        Histograms are cumulative, which is the right export shape but
+        the WRONG controller input: a decision loop (feature/autotune.py)
+        must react to *recent* behavior, not a lifetime blur where the
+        first hour of a run outvotes the last minute.  Bucket counts and
+        sums are monotone, so the window is an exact bucket-wise
+        subtraction; p50/p95/p99/mean are then computed on the window's
+        own distribution.  An empty window returns ``count == 0`` and
+        zeros.  ``prev`` from a child with different bucket bounds
+        raises; a ``prev`` AHEAD of the current state (the child was
+        replaced/reset under the caller) degrades to the full current
+        summary instead of reporting negative counts.
+        """
+        cur = self._snapshot()
+        if prev is None:
+            return self._summary_of(cur)
+        p_counts, p_sum, p_count, p_inf = prev
+        c_counts, c_sum, c_count, c_inf = cur
+        if len(p_counts) != len(c_counts):
+            raise ValueError(
+                f"snapshot has {len(p_counts)} buckets but histogram has "
+                f"{len(c_counts)} — delta_since needs a snapshot of THIS "
+                "child")
+        d_counts = [c - p for c, p in zip(c_counts, p_counts)]
+        if any(d < 0 for d in d_counts) or c_count < p_count:
+            return self._summary_of(cur)  # reset under us: full window
+        return self._summary_of(
+            (d_counts, c_sum - p_sum, c_count - p_count, c_inf - p_inf))
 
 
 class Histogram(_Family):
@@ -383,6 +429,12 @@ class Histogram(_Family):
 
     def summary(self) -> dict:
         return self._default().summary()
+
+    def snapshot_state(self) -> tuple:
+        return self._default().snapshot_state()
+
+    def delta_since(self, prev: tuple | None) -> dict:
+        return self._default().delta_since(prev)
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

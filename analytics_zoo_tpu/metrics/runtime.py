@@ -30,7 +30,7 @@ from analytics_zoo_tpu.metrics.registry import (
 )
 
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
-           "record_device_memory"]
+           "AutotuneMetrics", "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -171,6 +171,50 @@ class DataPipelineMetrics:
         self.errors = reg.counter(
             "zoo_data_prefetch_errors_total",
             "exceptions propagated through the prefetch pipeline")
+        self.batch_bytes = reg.gauge(
+            "zoo_data_prefetch_batch_bytes",
+            "host bytes of the last delivered batch (the autotune "
+            "RAM-budget estimator input: resident ≈ bytes x depth)")
+
+
+class AutotuneMetrics:
+    """Closed-loop autotuner telemetry (``zoo_autotune_*``,
+    feature/autotune.py).
+
+    Gauges mirror the controller's CURRENT knob values so a scrape shows
+    what the pipeline is running with right now; the decision counter
+    (labeled by knob and reason) is the tuning activity rate — a counter
+    that keeps climbing long after warmup means the policy is
+    oscillating, not converging.  The full structured decision log
+    (time, knob, old→new, reason) is bounded in the controller and
+    served at ``/varz`` under ``autotune``."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.workers = reg.gauge(
+            "zoo_autotune_workers",
+            "current autotuned prefetch worker-pool size")
+        self.depth = reg.gauge(
+            "zoo_autotune_depth",
+            "current autotuned prefetch queue depth")
+        self.read_ahead = reg.gauge(
+            "zoo_autotune_read_ahead",
+            "current autotuned shard read-ahead count")
+        self.k = reg.gauge(
+            "zoo_autotune_k",
+            "current autotuned steps_per_dispatch (fused scan-K)")
+        self.ram_budget = reg.gauge(
+            "zoo_autotune_ram_budget_bytes",
+            "configured host-RAM budget for the prefetch window")
+        self.ram_estimate = reg.gauge(
+            "zoo_autotune_ram_estimate_bytes",
+            "estimated resident bytes of the prefetch window "
+            "(batch bytes x (depth + workers) + read-ahead shards)")
+        self.decisions = reg.counter(
+            "zoo_autotune_decisions_total",
+            "autotune knob changes, by knob and reason",
+            labelnames=("knob", "reason"))
 
 
 def record_device_memory(registry: MetricsRegistry | None = None) -> int:

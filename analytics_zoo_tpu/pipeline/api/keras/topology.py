@@ -151,9 +151,16 @@ class KerasNet(_ContainerBase):
         return est
 
     def fit(self, x, y=None, batch_size=32, nb_epoch=10,
-            validation_data=None, distributed=True, sample_weight=None):
+            validation_data=None, distributed=True, sample_weight=None,
+            autotune=None):
         """Train (reference ``fit`` Topology.scala:418-431 →
-        InternalDistriOptimizer.train Topology.scala:1076-1259)."""
+        InternalDistriOptimizer.train Topology.scala:1076-1259).
+
+        ``autotune=True`` (or ``ZOO_AUTOTUNE=1``) turns on the
+        closed-loop tuner: prefetch workers/depth/read-ahead and the
+        fused-dispatch K are tuned online from telemetry, with a
+        bit-identical loss trajectory (see docs/data-pipeline.md
+        "Autotuning")."""
         from analytics_zoo_tpu.feature.dataset import FeatureSet
 
         train_set = FeatureSet.of(x, y, sample_weight=sample_weight)
@@ -163,7 +170,7 @@ class KerasNet(_ContainerBase):
             self._estimator = self._make_estimator()
         self._estimator.train(
             train_set, batch_size=batch_size, nb_epoch=nb_epoch,
-            validation_set=val_set,
+            validation_set=val_set, autotune=autotune,
         )
         self._sync_nested()
         return self

@@ -141,6 +141,36 @@ def _chunk_batches(batch_iter, k: int):
         yield ("single", b)
 
 
+def _chunk_batches_dynamic(batch_iter, k_fn):
+    """Dynamic-K chunker for the autotune plane (feature/autotune.py):
+    the target K is re-read from ``k_fn()`` at every CHUNK boundary, so
+    the controller's hill-climb takes effect within one dispatch of a
+    decision while any in-flight chunk keeps the size it started with.
+
+    The batch SEQUENCE is untouched — only the grouping changes — and
+    per-inner-step RNG folds on the global step index, so the loss
+    trajectory is bit-identical for every K schedule this can emit
+    (the same contract :func:`_chunk_batches` rides).  K=1 chunks are
+    emitted as ``("single", b)`` so they dispatch the plain (non-scan)
+    program, exactly like the static K=1 path; a leftover tail degrades
+    to singles like the static chunker.
+    """
+    chunk = []
+    k = max(1, int(k_fn()))
+    for b in batch_iter:
+        if k <= 1:
+            yield ("single", b)
+            k = max(1, int(k_fn()))
+            continue
+        chunk.append(b)
+        if len(chunk) == k:
+            yield ("scan", chunk)
+            chunk = []
+            k = max(1, int(k_fn()))
+    for b in chunk:
+        yield ("single", b)
+
+
 class _DeviceFeeder:
     """Double-buffered host→device infeed.
 
@@ -622,7 +652,18 @@ class Estimator:
               checkpoint_trigger: ZooTrigger | None = None,
               validation_set: FeatureSet | None = None,
               validation_trigger: ZooTrigger | None = None,
-              seed: int | None = None):
+              seed: int | None = None,
+              autotune=None):
+        """``autotune``: ``True`` (or ``ZOO_AUTOTUNE=1`` via the config
+        tier, which ``None`` defers to) turns on the closed-loop tuner
+        (feature/autotune.py): the train set is wrapped in the prefetch
+        plane (starting from the configured knobs, or worst-case
+        workers=1/depth=1 when prefetch is off) and a controller thread
+        resizes it online while ``steps_per_dispatch`` hill-climbs at
+        dispatch boundaries — loss trajectory bit-identical throughout.
+        Pass an :class:`~analytics_zoo_tpu.feature.autotune.
+        AutotuneController` instance to share/tune one across fits;
+        ``False`` forces it off regardless of the env."""
         ctx = self.ctx
         dp = ctx.data_parallel_size
         if batch_size % dp != 0:
@@ -645,20 +686,55 @@ class Estimator:
         if validation_set is not None and validation_trigger is None:
             validation_trigger = EveryEpoch()
         seed = ctx.seed if seed is None else seed
-        if ctx.config.prefetch_workers:
+        # Closed-loop autotuning (ZOO_AUTOTUNE / autotune=True): resolve
+        # the controller BEFORE the prefetch wrap so the pipeline starts
+        # at (and is resized from) the controller's state.  autotune
+        # unset/off ⇒ controller is None and every path below is the
+        # static-knob code, no new threads (the disabled-mode contract).
+        controller, own_controller, attached_set = None, False, None
+        auto = autotune if autotune is not None else ctx.config.autotune
+        if auto:
+            from analytics_zoo_tpu.feature.autotune import (
+                AutotuneController,
+            )
+            if isinstance(auto, AutotuneController):
+                controller = auto
+            else:
+                controller = AutotuneController.from_config(ctx.config)
+                own_controller = True
+        if ctx.config.prefetch_workers or controller is not None:
             # Parallel host data plane (ZOO_PREFETCH_WORKERS): shard
             # loading, host transforms and batch assembly move onto pool
             # threads with ordered delivery, composing with the
             # double-buffered device infeed below — the feeder consumes
             # the prefetched stream instead of the serial generator, and
             # the stream itself is byte-identical (resume included).
+            # Under autotune with prefetch off, start from the worst
+            # case (workers=1, depth=1) and let the controller grow it —
+            # but only when the set HAS host work to hide
+            # (worth_prefetching); a resident no-transform array set
+            # would pay queue handoffs for nothing, and an explicit
+            # ZOO_PREFETCH_WORKERS always wins over that heuristic.
             from analytics_zoo_tpu.feature.prefetch import (
                 PrefetchFeatureSet,
+                worth_prefetching,
             )
-            if not isinstance(train_set, PrefetchFeatureSet):
-                train_set = train_set.prefetch(
-                    depth=ctx.config.prefetch_depth,
-                    workers=ctx.config.prefetch_workers)
+            if isinstance(train_set, PrefetchFeatureSet):
+                if controller is not None \
+                        and train_set._controller is None:
+                    # attach for THIS fit only — detached in the finally
+                    # below, so a later train(autotune=False) on the same
+                    # FeatureSet cannot resurrect this fit's controller
+                    train_set._controller = controller
+                    attached_set = train_set
+            elif ctx.config.prefetch_workers or \
+                    worth_prefetching(train_set):
+                train_set = PrefetchFeatureSet(
+                    train_set,
+                    depth=(ctx.config.prefetch_depth
+                           if ctx.config.prefetch_workers else 1),
+                    workers=ctx.config.prefetch_workers or 1,
+                    controller=controller)
 
         params, state = self.model.build_params()
         # Keras continuation semantics: a second fit() on the same estimator
@@ -705,11 +781,44 @@ class Estimator:
         # ZooConfig env tier: ZOO_FAILURE_RETRY_TIMES (reference
         # ``bigdl.failure.retryTimes`` sysprop, Topology.scala:1172)
         retry_times = self.ctx.config.failure_retry_times
+        try:
+            params, opt_state, state = self._train_with_retries(
+                params, opt_state, state, step_fn, fused_fn, k, dev_tf,
+                controller, train_set, batch_size, seed, start_epoch,
+                start_batch, end_trigger, checkpoint_trigger,
+                validation_set, validation_trigger, retry_times, repl)
+        finally:
+            if attached_set is not None:
+                # undo the fit-scoped attachment on the CALLER's set
+                attached_set._controller = None
+            if own_controller:
+                # the controller thread dies with this fit; a caller-
+                # provided controller keeps running (shared across fits)
+                controller.stop()
+
+        self.model.params = params
+        self.model.state = state
+        self._opt_state = opt_state
+        if self._ckpt is not None:
+            # Flush the in-flight async save before returning: the process
+            # may exit right after fit(), and a NEW estimator on the same
+            # dir must see the final snapshot (not a half-written .tmp).
+            # Also surfaces any deferred write error.
+            self._ckpt._wait()
+        return self
+
+    def _train_with_retries(self, params, opt_state, state, step_fn,
+                            fused_fn, k, dev_tf, controller, train_set,
+                            batch_size, seed, start_epoch, start_batch,
+                            end_trigger, checkpoint_trigger,
+                            validation_set, validation_trigger,
+                            retry_times, repl):
         retries = 0
         while True:
             try:
                 params, opt_state, state = self._train_loop(
                     params, opt_state, state, step_fn, fused_fn, k,
+                    dev_tf, controller,
                     train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger,
                     validation_set, validation_trigger,
@@ -750,21 +859,11 @@ class Estimator:
                 self.global_step = int(resumed["global_step"])
                 start_epoch = int(resumed["epoch"])
                 start_batch = int(resumed["next_batch"])
-
-        self.model.params = params
-        self.model.state = state
-        self._opt_state = opt_state
-        if self._ckpt is not None:
-            # Flush the in-flight async save before returning: the process
-            # may exit right after fit(), and a NEW estimator on the same
-            # dir must see the final snapshot (not a half-written .tmp).
-            # Also surfaces any deferred write error.
-            self._ckpt._wait()
-        return self
+        return params, opt_state, state
 
     # zoolint: hot-path
     def _train_loop(self, params, opt_state, state, step_fn, fused_fn,
-                    steps_per_dispatch, train_set,
+                    steps_per_dispatch, dev_tf, controller, train_set,
                     batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger, validation_set,
                     validation_trigger):
@@ -816,7 +915,8 @@ class Estimator:
             # unregisters the component when it exits (on_exit), so the
             # main thread never races a late beat.
             health.register("infeed", stale_after=60.0)
-            if k > 1:
+            chunked = k > 1 or controller is not None
+            if chunked:
                 # Fused dispatch: the feeder consumes the CHUNKED stream.
                 # Full chunks are stacked into a [K, batch, ...]
                 # super-batch ON THE FEEDER THREAD (host work overlapping
@@ -832,8 +932,14 @@ class Estimator:
                         return ("scan", _stack(stacked), len(payload))
                     return ("single", _single(payload), 1)
 
-                feed_src, shard_fn = _chunk_batches(batch_iter, k), \
-                    shard_item
+                # Autotune: chunk sizes follow the controller's K
+                # hill-climb, re-read at every chunk boundary; the batch
+                # sequence (and so the trajectory) is unchanged.
+                feed_src = (_chunk_batches_dynamic(
+                    batch_iter, controller.current_k)
+                    if controller is not None
+                    else _chunk_batches(batch_iter, k))
+                shard_fn = shard_item
             else:
                 feed_src, shard_fn = batch_iter, ctx.shard_batch
             feeder = _DeviceFeeder(
@@ -865,13 +971,19 @@ class Estimator:
                     step_arr = np.asarray(self.global_step, np.int32)
                     with time_it("zoo.step_dispatch"), \
                             span("zoo.train.step_dispatch"):
-                        if k > 1:
+                        if chunked:
                             kind, payload, nk = sharded
                             if kind == "scan":
                                 # ONE dispatch advances nk inner steps;
-                                # losses come back as a [nk] device array
+                                # losses come back as a [nk] device
+                                # array.  Under autotune nk follows the
+                                # hill-climb, so the fused program is
+                                # looked up per-chunk (a dict hit after
+                                # each K's first compile).
+                                fn = fused_fn if controller is None \
+                                    else self._train_step_for(dev_tf, nk)
                                 params, opt_state, state, losses = \
-                                    fused_fn(
+                                    fn(
                                         params, opt_state, state,
                                         seed_arr, step_arr, payload)
                                 loss_dev = losses[nk - 1]
@@ -927,6 +1039,11 @@ class Estimator:
                     step_metrics.record_step(
                         t_data - t_iter0, t_disp - t_data,
                         step_s, batch_size * nk, steps=nk)
+                    if controller is not None:
+                        # one measured dispatch feeds the K hill-climb
+                        # (full loop-iteration wall time — the quantity
+                        # fusion amortizes)
+                        controller.observe_dispatch(nk, step_s)
                     health.heartbeat("train_loop")
                     # flight recorder: one structured record per step
                     # (bounded ring — a postmortem shows the FINAL

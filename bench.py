@@ -194,6 +194,193 @@ def data_pipeline_bench(workers: int = 4, depth: int = 8,
 
 
 # ---------------------------------------------------------------------------
+# --autotune: closed-loop autotuning bench (feature/autotune.py).  Both
+# synthetics start from the WORST-CASE defaults (workers=1, depth=1, K=1)
+# and must converge to >= 0.9x the best hand-tuned throughput from
+# BENCH_DATA_r06 (workers=4, depth=8) / BENCH_DISPATCH_r07 (K=16), with
+# the stream byte-identical under resizing and the loss trajectory
+# bit-identical to the fixed-K run.  Emits BENCH_AUTOTUNE_r08.json.
+# ---------------------------------------------------------------------------
+
+def autotune_data_plane_bench(quick: bool = False) -> dict:
+    """Sleep-bound host-pipeline synthetic (the BENCH_DATA_r06 shape):
+    serial vs untuned-default (1,1) vs hand-tuned (4,8) vs the
+    controller starting at (1,1).  Returns the data_plane section."""
+    import numpy as np
+
+    from analytics_zoo_tpu.feature.autotune import AutotuneController
+    from analytics_zoo_tpu.feature.common import FnPreprocessing
+    from analytics_zoo_tpu.feature.dataset import ShardedFeatureSet
+    from analytics_zoo_tpu.feature.prefetch import PrefetchFeatureSet
+
+    if quick:
+        cfg = dict(n_shards=4, shard_records=32, batch_size=8,
+                   load_sleep_ms=15.0, transform_sleep_ms=1.0)
+        epochs, interval = 5, 0.04
+    else:
+        cfg = dict(n_shards=6, shard_records=64, batch_size=16,
+                   load_sleep_ms=40.0, transform_sleep_ms=2.0)
+        epochs, interval = 6, 0.1
+    seed = 7
+    t_sleep = cfg["transform_sleep_ms"] / 1e3
+    base = ShardedFeatureSet(
+        [f"synth://shard-{i}" for i in range(cfg["n_shards"])],
+        n_slices=cfg["n_shards"],
+        loader=_sleepy_loader(cfg["load_sleep_ms"] / 1e3,
+                              cfg["shard_records"]),
+        sizer=lambda p: cfg["shard_records"])
+
+    def slow_identity(record):
+        time.sleep(t_sleep)
+        return record
+
+    fs = base.transform(FnPreprocessing(slow_identity))
+
+    def drain(feature_set, epoch):
+        t0 = time.perf_counter()
+        out = list(feature_set.batches(cfg["batch_size"], shuffle=True,
+                                       seed=seed, epoch=epoch))
+        return out, time.perf_counter() - t0
+
+    def bps(n, s):
+        return round(n / max(s, 1e-9), 2)
+
+    serial = [drain(fs, e)[0] for e in range(epochs)]
+    n_batches = len(serial[0])
+    _, untuned_s = drain(PrefetchFeatureSet(fs, depth=1, workers=1), 0)
+    _, hand_s = drain(PrefetchFeatureSet(fs, depth=8, workers=4), 0)
+
+    ctrl = AutotuneController(interval=interval, min_window=4)
+    pre = PrefetchFeatureSet(fs, depth=1, workers=1, controller=ctrl)
+    epoch_bps, deterministic = [], True
+    for e in range(epochs):
+        got, dt = drain(pre, e)
+        epoch_bps.append(bps(len(got), dt))
+        deterministic = deterministic and len(got) == len(serial[e]) \
+            and all(set(a) == set(b)
+                    and all(np.array_equal(a[k], b[k]) for k in a)
+                    for a, b in zip(serial[e], got))
+    ctrl.stop()
+    final_bps = epoch_bps[-1]
+    cur = ctrl.current()
+    return {
+        "synthetic": cfg,
+        "epochs": epochs,
+        "batches_per_epoch": n_batches,
+        "untuned_default_batches_per_sec": bps(n_batches, untuned_s),
+        "hand_tuned_batches_per_sec": bps(n_batches, hand_s),
+        "autotuned_epoch_batches_per_sec": epoch_bps,
+        "autotuned_final_batches_per_sec": final_bps,
+        "vs_hand_tuned": round(final_bps * hand_s / n_batches, 3),
+        "vs_untuned_default": round(final_bps * untuned_s / n_batches, 3),
+        "deterministic_under_resizing": bool(deterministic),
+        "converged": {k: cur[k] for k in
+                      ("workers", "depth", "read_ahead")},
+        "hand_tuned_config": {"workers": 4, "depth": 8},
+        "decisions": [
+            {k: d[k] for k in ("knob", "old", "new", "reason")}
+            for d in ctrl.decision_log()],
+    }
+
+
+def autotune_dispatch_bench(quick: bool = False) -> dict:
+    """Dispatch-bound synthetic (the BENCH_DISPATCH_r07 shape): fixed
+    K=1 (untuned default) and K=16 (hand-tuned) vs the controller's
+    hill-climb starting at K=1.  Returns the dispatch section."""
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.common.engine import ZooConfig
+    from analytics_zoo_tpu.feature.autotune import AutotuneController
+
+    n_batches = 192 if quick else 384
+    batch_size = 16
+    warm_epochs = 2  # the climb's ladder (~250-350 steps) lives here
+    x, y = _dispatch_data(n_batches * batch_size)
+
+    def fixed(k):
+        zoo.init_zoo_context(ZooConfig(seed=11, steps_per_dispatch=k))
+        m = _dispatch_model()
+        # warm epochs match the autotuned leg so the trajectory
+        # comparison covers the same step count
+        m.fit(x, y, batch_size=batch_size, nb_epoch=warm_epochs)
+        t0 = time.perf_counter()
+        m.fit(x, y, batch_size=batch_size, nb_epoch=1)
+        dt = time.perf_counter() - t0
+        return (round(n_batches / dt, 1),
+                [h["loss"] for h in m._estimator.history])
+
+    k1_sps, k1_losses = fixed(1)
+    k16_sps, _ = fixed(16)
+
+    zoo.init_zoo_context(ZooConfig(seed=11))
+    ctrl = AutotuneController()
+    m = _dispatch_model()
+    # warm epochs host the hill-climb (each K's first dispatch pays its
+    # compile); the final epoch is the timed steady state at settled K
+    m.fit(x, y, batch_size=batch_size, nb_epoch=warm_epochs,
+          autotune=ctrl)
+    t0 = time.perf_counter()
+    m.fit(x, y, batch_size=batch_size, nb_epoch=1, autotune=ctrl)
+    dt = time.perf_counter() - t0
+    ctrl.stop()
+    auto_losses = [h["loss"] for h in m._estimator.history]
+    auto_sps = round(n_batches / dt, 1)
+    cur = ctrl.current()
+    return {
+        "steps_per_epoch": n_batches,
+        "batch_size": batch_size,
+        "untuned_default_steps_per_sec": k1_sps,
+        "hand_tuned_k16_steps_per_sec": k16_sps,
+        "autotuned_steady_steps_per_sec": auto_sps,
+        "vs_hand_tuned": round(auto_sps / max(k16_sps, 1e-9), 3),
+        "vs_untuned_default": round(auto_sps / max(k1_sps, 1e-9), 3),
+        "converged_k": cur["k"],
+        "k_settled": cur["k_settled"],
+        "k_cost_per_step_s": cur["k_cost_per_step_s"],
+        "dispatches_to_converge": cur["k_settle_dispatch"],
+        "loss_trajectory_bitwise_equal_to_k1": auto_losses == k1_losses,
+        "decisions": [
+            {k: d[k] for k in ("knob", "old", "new", "reason")}
+            for d in ctrl.decision_log()],
+    }
+
+
+def autotune_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    """Both autotune synthetics; writes BENCH_AUTOTUNE_r08.json."""
+    doc = {
+        "metric": "autotune_convergence_vs_hand_tuned",
+        "unit": "throughput ratio",
+        "platform": "cpu",
+        "quick": bool(quick),
+        "data_plane": autotune_data_plane_bench(quick=quick),
+        "dispatch": autotune_dispatch_bench(quick=quick),
+    }
+    doc["value"] = min(doc["data_plane"]["vs_hand_tuned"],
+                       doc["dispatch"]["vs_hand_tuned"])
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_AUTOTUNE_r08.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _autotune_main(argv):
+    # host/dispatch overhead bench: the CPU backend is the point
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(autotune_bench(**kwargs)))
+
+
+# ---------------------------------------------------------------------------
 # --dispatch: fused multi-step dispatch + compile plane bench
 # (ZOO_STEPS_PER_DISPATCH / ZOO_COMPILE_CACHE; docs/performance.md).
 # Two measurements on a deliberately dispatch-bound synthetic model (tiny
@@ -619,6 +806,8 @@ def _data_pipeline_main(argv):
 if __name__ == "__main__":
     if "--data-pipeline" in sys.argv:
         _data_pipeline_main(sys.argv[1:])
+    elif "--autotune" in sys.argv:
+        _autotune_main(sys.argv[1:])
     elif "--dispatch-child" in sys.argv:
         _dispatch_child_main(sys.argv[1:])
     elif "--dispatch" in sys.argv:

@@ -53,6 +53,7 @@ QUICK_FILES = {
     "test_layer_serialization.py", "test_metrics.py",
     "test_prefetch.py",  # host data plane + --data-pipeline bench guard
     "test_dispatch.py",  # fused scan-K dispatch + --dispatch bench guard
+    "test_autotune.py",  # closed-loop autotune + --autotune bench guard
     "test_compile_cache.py",  # persistent compile plane
     "test_zoolint.py",  # static analysis + package-clean CI gate
     "test_zoosan.py",  # whole-program pass + runtime sanitizer

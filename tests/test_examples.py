@@ -319,10 +319,28 @@ def test_ray_parameter_server_notebook_runs():
 
 
 def test_pytorch_predict_example():
-    from examples.pytorch.predict import run
+    # Fresh interpreter on purpose: the torch-in-pure_callback SPMD
+    # program is sensitive to prior in-process thread/scheduler state on
+    # small CPU hosts — observed as a WEDGED 8-participant all-reduce
+    # rendezvous (one partition's host callback never returns) when run
+    # after the actor-runtime notebooks in the same process, a latent
+    # jax-0.4-CPU callback+collective deadlock this repo cannot fix.
+    # Isolation also keeps ITS callback state away from later tests.
+    import subprocess
 
-    err, agree = run(n=32)
-    assert err < 1e-4 and agree == 1.0
+    code = (
+        "import os, sys; sys.path.insert(0, os.getcwd());"
+        "from examples.pytorch.predict import run;"
+        "err, agree = run(n=32);"
+        "assert err < 1e-4 and agree == 1.0, (err, agree);"
+        "print('PYTORCH_PREDICT_OK')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "PYTORCH_PREDICT_OK" in r.stdout
 
 
 def test_tfnet_predict_example():
@@ -336,10 +354,25 @@ def test_tfnet_predict_example():
 
 
 def test_gan_eval_example_restores_checkpoint():
-    from examples.tfpark.gan_eval import run
+    # Fresh interpreter for the same reason as test_pytorch_predict_example:
+    # run in-process after ~40 earlier example tests this wedges inside an
+    # 8-device collective rendezvous on small CPU hosts (latent jax-0.4
+    # CPU deadlock); in a clean process it runs (and asserts) normally.
+    import subprocess
 
-    mean, spread = run(train_steps=400)
-    assert mean > 1.2, mean   # generator moved toward the real mean (3.0)
+    code = (
+        "import os, sys; sys.path.insert(0, os.getcwd());"
+        "from examples.tfpark.gan_eval import run;"
+        "mean, spread = run(train_steps=400);"
+        "assert mean > 1.2, mean;"
+        "print('GAN_EVAL_OK')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1500:])
+    assert "GAN_EVAL_OK" in r.stdout
 
 
 def test_tfpark_keras_dataset_example():

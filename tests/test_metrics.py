@@ -959,3 +959,78 @@ class TestTracerDropCounter:
                        and s["value"] == 3 for s in doc["samples"])
         finally:
             srv.stop()
+
+
+@metrics_mark
+class TestHistogramDeltaSince:
+    """Histogram.snapshot_state/delta_since — the rolling-window reader
+    controllers use (feature/autotune.py) instead of lifetime blurs."""
+
+    def _hist(self):
+        from analytics_zoo_tpu.metrics import MetricsRegistry
+
+        return MetricsRegistry().histogram(
+            "h", "", buckets=(0.001, 0.01, 0.1, 1.0))
+
+    def test_window_sees_only_recent_observations(self):
+        h = self._hist()
+        for _ in range(50):
+            h.observe(0.0005)  # old regime: sub-ms
+        base = h.snapshot_state()
+        for _ in range(10):
+            h.observe(0.5)  # new regime: half a second
+        d = h.delta_since(base)
+        assert d["count"] == 10
+        assert d["p50"] > 0.1  # the window reflects the NEW regime...
+        assert h.summary()["p50"] < 0.01  # ...while lifetime still blurs
+        assert abs(d["sum"] - 5.0) < 1e-9
+        assert abs(d["mean"] - 0.5) < 1e-9
+
+    def test_empty_window(self):
+        h = self._hist()
+        h.observe(0.05)
+        base = h.snapshot_state()
+        d = h.delta_since(base)
+        assert d == {"count": 0, "sum": 0.0, "mean": 0.0,
+                     "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+    def test_none_baseline_is_lifetime(self):
+        h = self._hist()
+        h.observe(0.05)
+        assert h.delta_since(None) == h.summary()
+
+    def test_partial_window_spanning_merged_buckets(self):
+        h = self._hist()
+        h.observe(0.0005)
+        base = h.snapshot_state()
+        # the window spans three different buckets + the +Inf tail
+        for v in (0.005, 0.005, 0.05, 0.5, 2.0):
+            h.observe(v)
+        d = h.delta_since(base)
+        assert d["count"] == 5
+        assert 0.001 < d["p50"] <= 0.1
+        assert d["p99"] >= 1.0  # the +Inf-tail observation is visible
+
+    def test_mismatched_bucket_layout_raises(self):
+        from analytics_zoo_tpu.metrics import MetricsRegistry
+
+        h = self._hist()
+        other = MetricsRegistry().histogram("h2", "", buckets=(0.1,))
+        other.observe(0.05)
+        with pytest.raises(ValueError, match="buckets"):
+            h.delta_since(other.snapshot_state())
+
+    def test_reset_baseline_degrades_to_full_summary(self):
+        h = self._hist()
+        h.observe(0.05)
+        h.observe(0.05)
+        ahead = (list(h.snapshot_state()[0]), 99.0, 99, 0.0)
+        ahead[0][0] += 100  # a baseline AHEAD of the child (reset case)
+        d = h.delta_since(tuple(ahead))
+        assert d == h.summary()
+
+    def test_null_metric_parity(self):
+        from analytics_zoo_tpu.metrics import NULL
+
+        assert NULL.snapshot_state() is None
+        assert NULL.delta_since(None) == {}

@@ -26,7 +26,12 @@ program: `zoo_hlo_flops` / `zoo_hlo_bytes_accessed` /
 `zoo_hlo_collectives` / `zoo_hlo_collective_bytes` /
 `zoo_hlo_fused_dispatches` / `zoo_hlo_ops` / `zoo_hlo_findings`, all
 `{label=<compile label>}`, plus `zoo_hlo_lint_findings_total{rule=}`
-— see docs/static-analysis.md).
+— see docs/static-analysis.md), and `zoo_autotune` (the closed-loop
+controller's current worker/depth/read-ahead/K gauges, RAM
+budget/estimate pair, and `zoo_autotune_decisions_total{knob,reason}`).
+When the scraped ``/varz`` carries the controller's structured decision
+log (``autotune`` section), it is additionally rendered as a table —
+time, knob, old → new, reason — above the metric rows.
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
@@ -101,6 +106,35 @@ def _scale(name, value):
     return value, ""
 
 
+def render_autotune(doc, prefix="", out=None):
+    """Decision table for the ``autotune`` section a live ``/varz``
+    carries when a closed-loop controller ran (feature/autotune.py):
+    one row per knob change (time, knob, old→new, reason), plus each
+    controller's current config.  Skipped when the snapshot has no
+    autotune section or ``--prefix`` filters it out."""
+    import datetime
+
+    auto = doc.get("autotune")
+    if not auto or (prefix and not "zoo_autotune".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for ctl in auto.get("controllers", []):
+        cur = ctl.get("current", {})
+        emit("\nautotune: workers={workers} depth={depth} "
+             "read_ahead={read_ahead} K={k} (settled={k_settled})".format(
+                 **{k: cur.get(k) for k in
+                    ("workers", "depth", "read_ahead", "k", "k_settled")}))
+    decisions = auto.get("decisions", [])
+    if decisions:
+        emit(f"\n{'time':<14}{'knob':<12}{'change':<14}reason")
+        for d in decisions:
+            t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            emit(f"{t:<14}{d['knob']:<12}"
+                 f"{str(d['old']) + ' -> ' + str(d['new']):<14}"
+                 f"{d['reason']}")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("path", nargs="?", help="JSONL metrics file")
@@ -156,6 +190,7 @@ def main():
 
     src = a.url if a.url else a.path
     print(f"# {src}: {len(docs)} snapshot(s), window {dt:.1f}s")
+    render_autotune(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
