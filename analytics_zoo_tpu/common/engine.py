@@ -184,6 +184,29 @@ class ZooConfig:
                                (default min(8, 4 x cpu count) — prefetch
                                workers scale GIL-releasing IO/decode,
                                so cores only floor the cap)
+      ZOO_SERVING_BATCH_BUDGET_MS
+                               continuous-batching latency budget (ms)
+                               for claim-mode (fleet) serving: a PARTIAL
+                               shape bucket waits at most this long for
+                               co-batchable arrivals before predict — a
+                               lone request is served within the budget,
+                               a trickle coalesces into one padded
+                               predict.  0 flushes every claim batch
+                               immediately.  Default 25.
+      ZOO_SLO_P99_MS           the serving fleet's p99 latency SLO (ms,
+                               default 500): the autoscaler scales up
+                               when its estimated tail sojourn (predict
+                               p99 + backlog/service-rate) sustainedly
+                               exceeds this, down on sustained slack
+                               (serving/scaler.py)
+      ZOO_FLEET_MIN_REPLICAS   autoscaler floor (default 1)
+      ZOO_FLEET_MAX_REPLICAS   autoscaler ceiling (default 4)
+      ZOO_FLEET_INTERVAL       scaler window/tick seconds (default 1.0)
+      ZOO_FLEET_LEASE_MS       work-claim lease (ms, default 10000): a
+                               replica silent this long forfeits its
+                               claimed-but-unserved records to the
+                               surviving replicas (exactly-once via
+                               lease expiry; serving/broker.py)
 
     ``ZOO_PREFETCH_WORKERS`` / ``ZOO_PREFETCH_DEPTH`` /
     ``ZOO_STEPS_PER_DISPATCH`` are validated EAGERLY here: a
@@ -227,6 +250,16 @@ class ZooConfig:
     autotune_ram_budget: int | None = None
     autotune_interval: float | None = None
     autotune_max_workers: int | None = None
+    # Serving fleet (serving/fleet.py): continuous-batching budget, p99
+    # SLO target, and autoscaler bounds.  Env: ZOO_SERVING_BATCH_BUDGET_MS,
+    # ZOO_SLO_P99_MS, ZOO_FLEET_MIN/MAX_REPLICAS, ZOO_FLEET_INTERVAL,
+    # ZOO_FLEET_LEASE_MS.
+    serving_batch_budget_ms: float | None = None
+    slo_p99_ms: float | None = None
+    fleet_min_replicas: int | None = None
+    fleet_max_replicas: int | None = None
+    fleet_interval: float | None = None
+    fleet_lease_ms: int | None = None
 
     def __post_init__(self):
         env = os.environ
@@ -308,6 +341,50 @@ class ZooConfig:
         self.autotune_max_workers = resolve_int(
             self.autotune_max_workers, "ZOO_AUTOTUNE_MAX_WORKERS", None,
             minimum=1)
+
+        def resolve_float(value, env_key, default, minimum):
+            """Eager-validated float knob — same contract as
+            resolve_int: fails here naming the env var or field."""
+            if value is not None:
+                src, raw = "ZooConfig " + env_key[4:].lower(), value
+            elif env_key in env:
+                src, raw = env_key, env[env_key]
+            else:
+                return default
+            try:
+                out = float(str(raw))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{src} must be a number >= {minimum}, "
+                    f"got {raw!r}") from None
+            if out < minimum:
+                raise ValueError(
+                    f"{src} must be >= {minimum}, got {out}")
+            return out
+
+        # Serving-fleet tier: budgets/SLO validated eagerly so a bad
+        # knob fails at context init, not from inside a serving replica
+        self.serving_batch_budget_ms = resolve_float(
+            self.serving_batch_budget_ms, "ZOO_SERVING_BATCH_BUDGET_MS",
+            25.0, minimum=0.0)
+        self.slo_p99_ms = resolve_float(
+            self.slo_p99_ms, "ZOO_SLO_P99_MS", 500.0, minimum=1.0)
+        self.fleet_min_replicas = resolve_int(
+            self.fleet_min_replicas, "ZOO_FLEET_MIN_REPLICAS", 1,
+            minimum=1)
+        self.fleet_max_replicas = resolve_int(
+            self.fleet_max_replicas, "ZOO_FLEET_MAX_REPLICAS", 4,
+            minimum=1)
+        if self.fleet_max_replicas < self.fleet_min_replicas:
+            raise ValueError(
+                f"ZOO_FLEET_MAX_REPLICAS ({self.fleet_max_replicas}) must "
+                f"be >= ZOO_FLEET_MIN_REPLICAS "
+                f"({self.fleet_min_replicas})")
+        self.fleet_interval = resolve_float(
+            self.fleet_interval, "ZOO_FLEET_INTERVAL", 1.0, minimum=0.01)
+        self.fleet_lease_ms = resolve_int(
+            self.fleet_lease_ms, "ZOO_FLEET_LEASE_MS", 10_000,
+            minimum=100)
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
         if self.compile_cache is None:

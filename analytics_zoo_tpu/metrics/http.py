@@ -179,6 +179,11 @@ class MetricsServer:
         auto = sys.modules.get("analytics_zoo_tpu.feature.autotune")
         if auto is not None:
             doc["autotune"] = auto.varz_doc()
+        # Fleet panel (serving/fleet.py): replica/scaler state + scale
+        # decision log — same sys.modules-only contract.
+        fleet = sys.modules.get("analytics_zoo_tpu.serving.fleet")
+        if fleet is not None:
+            doc["fleet"] = fleet.varz_doc()
         if self.aggregator is not None:
             agg = self.aggregator.merged(include_driver=False)
             doc["aggregate"] = {"sources": agg["sources"],

@@ -30,7 +30,7 @@ from analytics_zoo_tpu.metrics.registry import (
 )
 
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
-           "AutotuneMetrics", "record_device_memory"]
+           "AutotuneMetrics", "FleetMetrics", "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -215,6 +215,55 @@ class AutotuneMetrics:
             "zoo_autotune_decisions_total",
             "autotune knob changes, by knob and reason",
             labelnames=("knob", "reason"))
+
+
+class FleetMetrics:
+    """Serving-fleet control plane telemetry (``zoo_fleet_*``,
+    serving/fleet.py + the claim-mode server loop).
+
+    The replica-count pair (live vs target) is the autoscaler's visible
+    state; the decision counter (labeled action/reason) is its activity
+    rate — like ``zoo_autotune_decisions_total``, a counter still
+    climbing long after a load change means the policy is oscillating.
+    ``lease_takeovers`` is the fleet's fault-tolerance signal: nonzero
+    means a replica died mid-batch and a survivor reclaimed its
+    records (exactly-once via lease expiry).  ``est_p99_seconds`` is
+    the scaler's own SLO estimate (predict p99 + Little's-law queue
+    delay) so a scrape shows WHAT the scale decision saw."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.replicas = reg.gauge(
+            "zoo_fleet_replicas", "live serving replicas")
+        self.replicas_target = reg.gauge(
+            "zoo_fleet_replicas_target",
+            "autoscaler's current target replica count")
+        self.decisions = reg.counter(
+            "zoo_fleet_decisions_total",
+            "autoscaler scale decisions, by action and reason",
+            labelnames=("action", "reason"))
+        self.lease_takeovers = reg.counter(
+            "zoo_fleet_lease_takeovers_total",
+            "expired-lease records reclaimed from dead replicas")
+        self.replica_deaths = reg.counter(
+            "zoo_fleet_replica_deaths_total",
+            "replicas found dead by the controller's supervision pass")
+        self.est_p99 = reg.gauge(
+            "zoo_fleet_est_p99_seconds",
+            "scaler's estimated request p99 over the last window "
+            "(predict p99 + queue_depth / service_rate)")
+        self.queue_depth = reg.gauge(
+            "zoo_fleet_unclaimed_backlog",
+            "unclaimed input-stream backlog at the last scaler tick "
+            "(claimed in-flight work excluded)")
+        self.slo_violations = reg.counter(
+            "zoo_fleet_slo_violation_windows_total",
+            "scaler windows whose estimated p99 violated the SLO")
+        self.batch_flushes = reg.counter(
+            "zoo_fleet_batch_flushes_total",
+            "continuous-batching bucket flushes, by reason "
+            "(full / budget / drain)", labelnames=("reason",))
 
 
 def record_device_memory(registry: MetricsRegistry | None = None) -> int:

@@ -13,11 +13,25 @@ same stream/hash data model in all three.
 """
 
 from .broker import FileBroker, InMemoryBroker, RedisBroker, connect_broker
-from .client import InputQueue, OutputQueue
+from .client import InputQueue, OutputQueue, ServingTimeout
 from .server import ClusterServing, ClusterServingHelper
 
 __all__ = [
     "InMemoryBroker", "FileBroker", "RedisBroker", "connect_broker",
-    "InputQueue", "OutputQueue",
+    "InputQueue", "OutputQueue", "ServingTimeout",
     "ClusterServing", "ClusterServingHelper",
+    "FleetController", "SloScaler",
 ]
+
+
+def __getattr__(name):
+    # fleet/scaler lazy-load (PEP 562): the fleet control plane pulls in
+    # ZooConfig (jax) — a client-only process importing the package for
+    # InputQueue/OutputQueue must not pay that
+    if name == "FleetController":
+        from .fleet import FleetController
+        return FleetController
+    if name == "SloScaler":
+        from .scaler import SloScaler
+        return SloScaler
+    raise AttributeError(name)

@@ -21,7 +21,17 @@ import uuid
 
 
 class Broker:
-    """Minimal stream + hash API (subset of Redis streams)."""
+    """Minimal stream + hash API (subset of Redis streams).
+
+    The ``claim``/``extend``/``release`` trio is the fleet's
+    exactly-once work-claiming protocol (serving/fleet.py): a replica
+    CLAIMS records under a lease instead of reading by cursor, so N
+    replicas against one stream never double-serve; a replica that dies
+    mid-batch simply stops extending, and after ``lease_ms`` its
+    claimed-but-unserved records become claimable again (the lease-expiry
+    takeover a survivor performs).  ``release(done=True)`` is the
+    claimed-record ack; ``done=False`` requeues immediately (clean
+    shutdown path — no other replica waits out the lease)."""
 
     def xadd(self, stream: str, fields: dict) -> str:
         raise NotImplementedError
@@ -31,6 +41,46 @@ class Broker:
         """Return up to ``count`` records ``(id, fields)`` with id >
         last_id; optionally block up to ``block_ms``."""
         raise NotImplementedError
+
+    def claim(self, stream: str, owner: str, count: int, lease_ms: int,
+              block_ms: int = 0) -> list:
+        """Atomically claim up to ``count`` unclaimed (or lease-expired)
+        records for ``owner``; returns ``[(id, fields)]``.  Claimed
+        records stay in the stream but are invisible to other claimers
+        until the lease expires or they are released.  ``block_ms`` > 0
+        waits for claimable records (new arrivals OR an expiring
+        lease)."""
+        raise NotImplementedError
+
+    def extend(self, stream: str, owner: str, ids, lease_ms: int) -> None:
+        """Renew ``owner``'s lease on ``ids`` (the mid-batch keepalive —
+        a first predict may pay a multi-second XLA compile).  Ids no
+        longer owned (expired + taken over, or already released) are
+        silently skipped."""
+        raise NotImplementedError
+
+    def release(self, stream: str, owner: str, ids,
+                done: bool = False) -> None:
+        """End ``owner``'s claim on ``ids``.  ``done=True`` acks: the
+        records leave the stream (served, or judged unservable).
+        ``done=False`` requeues them for immediate re-claim.  Ids not
+        currently owned by ``owner`` are silently skipped — a lease that
+        expired mid-flight may already belong to a survivor."""
+        raise NotImplementedError
+
+    def unclaimed(self, stream: str) -> int:
+        """Backlog a new claimer could serve right now: records with no
+        live lease.  The fleet autoscaler reads THIS, not ``xlen`` —
+        in-flight claimed work is capacity already being used, not
+        demand.  Brokers without claim support report ``xlen``."""
+        return self.xlen(stream)
+
+    def pop_takeovers(self, owner: str) -> int:
+        """Number of lease-EXPIRY takeovers ``owner``'s claims performed
+        since the last call (claims of records a dead replica left
+        behind).  Read-and-reset; brokers without claim support return
+        0."""
+        return 0
 
     def xlen(self, stream: str) -> int:
         raise NotImplementedError
@@ -83,9 +133,20 @@ def _new_id() -> str:
 
 
 class InMemoryBroker(Broker):
+    """All stream/hash/claim state lives under ONE Condition, so every
+    blocking read (``xread``/``claim`` with ``block_ms`` > 0) is a
+    ``Condition.wait`` woken by ``xadd``/``release`` — an idle fleet
+    replica burns no CPU polling (and a claim waiter additionally wakes
+    itself at the nearest lease expiry, the dead-replica takeover
+    path)."""
+
     def __init__(self, max_records: int = 1_000_000):
         self._streams: dict[str, list] = {}  # guarded-by: _cv
         self._hashes: dict[str, dict] = {}  # guarded-by: _cv
+        # stream -> {rid: (owner, monotonic deadline)} live leases
+        self._claims: dict[str, dict] = {}  # guarded-by: _cv
+        # owner -> lease-expiry takeovers performed (pop_takeovers)
+        self._takeovers: dict[str, int] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
         self._max_records = max_records
 
@@ -117,7 +178,9 @@ class InMemoryBroker(Broker):
         with self._cv:
             s = self._streams.get(stream, [])
             if len(s) > maxlen:
+                dropped = s[:len(s) - maxlen]
                 del s[:len(s) - maxlen]
+                self._prune_claims_locked(stream, (r[0] for r in dropped))
 
     def ack(self, stream, upto_id):
         with self._cv:
@@ -125,7 +188,88 @@ class InMemoryBroker(Broker):
             i = 0
             while i < len(s) and s[i][0] <= upto_id:
                 i += 1
+            acked = s[:i]
             del s[:i]
+            self._prune_claims_locked(stream, (r[0] for r in acked))
+
+    def _prune_claims_locked(self, stream, rids):
+        """Drop leases for records that left the stream (ack/xtrim)."""
+        claims = self._claims.get(stream)
+        if claims:
+            for rid in rids:
+                claims.pop(rid, None)
+
+    # -- exactly-once work claiming (fleet protocol) -------------------
+
+    def claim(self, stream, owner, count, lease_ms, block_ms=0):
+        deadline = time.monotonic() + block_ms / 1000.0
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                claims = self._claims.setdefault(stream, {})
+                out = []
+                for rid, fields in self._streams.get(stream, []):
+                    cur = claims.get(rid)
+                    if cur is not None and cur[1] > now:
+                        continue  # live lease held by someone
+                    if cur is not None and cur[0] != owner:
+                        # expired lease of a (presumed dead) replica
+                        self._takeovers[owner] = \
+                            self._takeovers.get(owner, 0) + 1
+                    claims[rid] = (owner, now + lease_ms / 1000.0)
+                    out.append((rid, dict(fields)))
+                    if len(out) >= count:
+                        break
+                if out or block_ms <= 0:
+                    return out
+                remaining = deadline - now
+                if remaining <= 0:
+                    return []
+                # also wake at the nearest lease expiry: a dead owner's
+                # records become claimable without any notify
+                expiries = [d for _, d in claims.values() if d > now]
+                if expiries:
+                    remaining = min(remaining, min(expiries) - now)
+                self._cv.wait(max(remaining, 0.0))
+
+    def extend(self, stream, owner, ids, lease_ms):
+        with self._cv:
+            now = time.monotonic()
+            claims = self._claims.get(stream, {})
+            for rid in ids:
+                cur = claims.get(rid)
+                if cur is not None and cur[0] == owner and cur[1] > now:
+                    claims[rid] = (owner, now + lease_ms / 1000.0)
+
+    def release(self, stream, owner, ids, done=False):
+        ids = set(ids)
+        with self._cv:
+            claims = self._claims.get(stream, {})
+            now = time.monotonic()
+            owned = {rid for rid in ids
+                     if (c := claims.get(rid)) is not None
+                     and c[0] == owner and (done or c[1] > now)}
+            # done=True also covers an expired-but-not-yet-taken-over
+            # lease: the work WAS completed, the record must go
+            for rid in owned:
+                claims.pop(rid, None)
+            if done and owned:
+                s = self._streams.get(stream, [])
+                s[:] = [r for r in s if r[0] not in owned]
+            if owned and not done:
+                self._cv.notify_all()  # requeued: wake claim waiters
+
+    def unclaimed(self, stream):
+        with self._cv:
+            now = time.monotonic()
+            claims = self._claims.get(stream, {})
+            return sum(
+                1 for rid, _ in self._streams.get(stream, [])
+                if (c := claims.get(rid)) is None or c[1] <= now)
+
+    def pop_takeovers(self, owner):
+        with self._cv:
+            return self._takeovers.pop(owner, 0)
 
     def hset(self, key, mapping):
         with self._cv:
@@ -168,6 +312,9 @@ class FileBroker(Broker):
     def __init__(self, root: str, max_bytes: int = 1 << 30):
         self.root = root
         self.max_bytes = int(max_bytes)
+        # lease-expiry takeovers THIS instance performed, by owner
+        # (one broker instance per replica process — no lock needed)
+        self._takeovers: dict[str, int] = {}
         os.makedirs(os.path.join(root, "hash"), exist_ok=True)
 
     def _sdir(self, stream):
@@ -214,24 +361,246 @@ class FileBroker(Broker):
     def xlen(self, stream):
         return len(self._ids(stream))
 
-    def xtrim(self, stream, maxlen):
-        ids = self._ids(stream)
+    def _remove_record(self, stream, rid):
         d = self._sdir(stream)
-        for rid in ids[:max(0, len(ids) - maxlen)]:
+        for p in (os.path.join(d, rid + ".json"),
+                  self._cpath(stream, rid)):  # no orphan claim dotfiles
             try:
-                os.remove(os.path.join(d, rid + ".json"))
+                os.remove(p)
             except OSError:
                 pass
 
+    def xtrim(self, stream, maxlen):
+        ids = self._ids(stream)
+        for rid in ids[:max(0, len(ids) - maxlen)]:
+            self._remove_record(stream, rid)
+
     def ack(self, stream, upto_id):
-        d = self._sdir(stream)
         for rid in self._ids(stream):
             if rid > upto_id:
                 break
+            self._remove_record(stream, rid)
+
+    # -- exactly-once work claiming (fleet protocol) -------------------
+    #
+    # A claim is a dotfile next to the record (".c-<rid>.json" — hidden
+    # from _ids) holding {"owner", "exp" (wall-clock lease deadline)}.
+    # Claim files are born ATOMICALLY WITH FULL CONTENT via os.link from
+    # a private temp file — link(2) fails with EEXIST when the path is
+    # taken, which is the cross-process compare-and-claim: exactly one
+    # replica wins a fresh record, and no reader ever sees a half-written
+    # claim.  Lease-expiry takeover renames the expired claim to a
+    # private tombstone first (again: exactly one renamer of that path
+    # wins), verifies the tombstone is the expired claim it read, then
+    # links its own claim in.  Two survivors reclaiming the same dead
+    # replica's record therefore resolve atomically; only a >2-way
+    # reclaim storm interleaved within the same few microseconds can
+    # degrade to at-least-once (results are idempotent hset writes, and
+    # the Redis transport gets true single-server atomicity).
+
+    def _cpath(self, stream, rid):
+        return os.path.join(self._sdir(stream), ".c-" + rid + ".json")
+
+    @staticmethod
+    def _read_claim(cpath):
+        """(owner, exp) of a claim file, or None when absent/unreadable
+        (unreadable cannot happen via the link protocol — treated as
+        absent so a manually-corrupted claim does not wedge a record)."""
+        try:
+            with open(cpath) as f:
+                doc = json.load(f)
+            return str(doc.get("owner", "")), float(doc.get("exp", 0.0))
+        except (OSError, ValueError):
+            return None
+
+    def _link_claim(self, cpath, owner, lease_ms) -> bool:
+        """Atomically create ``cpath`` with a fresh lease; False when the
+        path is already claimed."""
+        tmp = cpath + ".tmp-" + uuid.uuid4().hex[:8]
+        with open(tmp, "w") as f:
+            json.dump({"owner": owner,
+                       "exp": time.time() + lease_ms / 1000.0}, f)
+        try:
+            os.link(tmp, cpath)
+            return True
+        except OSError:
+            return False
+        finally:
+            os.remove(tmp)
+
+    def _try_claim(self, stream, rid, owner, lease_ms):
+        """Claim one record; returns (rid, fields) or None (lost a race /
+        live lease / record vanished).  Second element of the return is
+        via self._claim_takeovers bookkeeping."""
+        cpath = self._cpath(stream, rid)
+        cur = self._read_claim(cpath)
+        if cur is None:
+            if not self._link_claim(cpath, owner, lease_ms):
+                return None
+        elif cur[1] <= time.time():
+            # expired lease: tombstone-rename is the atomic takeover
+            tomb = cpath + ".to-" + uuid.uuid4().hex[:8]
             try:
-                os.remove(os.path.join(d, rid + ".json"))
+                os.rename(cpath, tomb)
+            except OSError:
+                return None  # another claimer already took it
+            grabbed = self._read_claim(tomb)
+            if grabbed is not None and grabbed != cur:
+                # raced past a fresh re-claim: restore it (atomic —
+                # link fails if yet another claim landed meanwhile)
+                try:
+                    os.link(tomb, cpath)
+                except OSError:
+                    pass
+                os.remove(tomb)
+                return None
+            ok = self._link_claim(cpath, owner, lease_ms)
+            try:
+                os.remove(tomb)
             except OSError:
                 pass
+            if not ok:
+                return None
+            if cur[0] != owner:
+                self._takeovers[owner] = self._takeovers.get(owner, 0) + 1
+        else:
+            return None  # live lease
+        # claimed — but the record may have been trimmed/acked meanwhile
+        try:
+            with open(os.path.join(self._sdir(stream),
+                                   rid + ".json")) as f:
+                return rid, json.load(f)
+        except (OSError, json.JSONDecodeError):
+            try:
+                os.remove(cpath)
+            except OSError:
+                pass
+            return None
+
+    def claim(self, stream, owner, count, lease_ms, block_ms=0):
+        deadline = time.monotonic() + block_ms / 1000.0
+        while True:
+            out = []
+            for rid in self._ids(stream):
+                got = self._try_claim(stream, rid, owner, lease_ms)
+                if got is None:
+                    continue
+                out.append(got)
+                if len(out) >= count:
+                    break
+            if out or time.monotonic() >= deadline:
+                return out
+            time.sleep(0.01)  # cross-process spool: poll is the only wake
+
+    def _take_own_claim(self, cpath, owner):
+        """Atomically rename ``owner``'s claim off ``cpath``; returns the
+        tombstone path, or None when the path is gone or holds someone
+        else's claim (which is restored untouched).  rename(2) is the
+        exclusivity: a takeover that raced past our last read cannot be
+        clobbered, because only one renamer of the path wins."""
+        tomb = cpath + ".ex-" + uuid.uuid4().hex[:8]
+        try:
+            os.rename(cpath, tomb)
+        except OSError:
+            return None  # a takeover owns the path right now
+        grabbed = self._read_claim(tomb)
+        if grabbed is None or grabbed[0] != owner:
+            # raced: a survivor's fresh claim was at the path — restore
+            # it (link fails only if yet another claim landed meanwhile)
+            try:
+                os.link(tomb, cpath)
+            except OSError:
+                pass
+            try:
+                os.remove(tomb)
+            except OSError:
+                pass
+            return None
+        return tomb
+
+    def extend(self, stream, owner, ids, lease_ms):
+        for rid in ids:
+            cpath = self._cpath(stream, rid)
+            cur = self._read_claim(cpath)
+            now = time.time()
+            # Renew via atomic rename-REPLACE (the path is never absent,
+            # so a concurrent claimer can never read 'unclaimed' off a
+            # live lease), but only while a 50ms stall guard remains
+            # before expiry: a takeover is only legal AFTER expiry, so
+            # the replace can only clobber a survivor's claim if this
+            # process stalls longer than the guard between this check
+            # and the rename — the same pause class the lease protocol
+            # already concedes to at-least-once (results are idempotent
+            # hset writes).  A lease inside the guard is left to ride
+            # out (the keepalive beats at lease/3, far from the guard).
+            if cur is None or cur[0] != owner or cur[1] - now <= 0.05:
+                continue  # no longer (safely) ours — let the lease ride
+            tmp = cpath + ".tmp-" + uuid.uuid4().hex[:8]
+            with open(tmp, "w") as f:
+                json.dump({"owner": owner,
+                           "exp": now + lease_ms / 1000.0}, f)
+            os.rename(tmp, cpath)
+
+    def release(self, stream, owner, ids, done=False):
+        d = self._sdir(stream)
+        for rid in ids:
+            cpath = self._cpath(stream, rid)
+            cur = self._read_claim(cpath)
+            if cur is None or cur[0] != owner:
+                continue
+            if done:
+                # record first, claim second: a crash in between leaves
+                # an orphan claim on a gone record, which _try_claim
+                # already cleans up — never the reverse (an unclaimed
+                # but served record would be re-served).  A takeover
+                # racing the claim removal is harmless here: the record
+                # is gone, so the survivor's claim is an orphan either
+                # way.
+                try:
+                    os.remove(os.path.join(d, rid + ".json"))
+                except OSError:
+                    pass
+                try:
+                    os.remove(cpath)
+                except OSError:
+                    pass
+            else:
+                # requeue: take the path atomically first — deleting
+                # blind could remove a survivor's just-taken-over claim
+                # and hand the record to a THIRD replica mid-serve
+                tomb = self._take_own_claim(cpath, owner)
+                if tomb is not None:
+                    try:
+                        os.remove(tomb)
+                    except OSError:
+                        pass
+
+    def unclaimed(self, stream):
+        # ONE listdir, then read only the claim dotfiles actually
+        # present (≈ replicas × batch_size) — NOT one failed open per
+        # backlog record; a deep backlog is exactly when the autoscaler
+        # polls this and must not slow down
+        now = time.time()
+        try:
+            names = os.listdir(self._sdir(stream))
+        except OSError:
+            return 0
+        recs = {n[:-5] for n in names
+                if n.endswith(".json") and not n.startswith(".")}
+        live = 0
+        for n in names:
+            if not (n.startswith(".c-") and n.endswith(".json")):
+                continue  # tombstones/tmps never end in .json
+            if n[3:-5] not in recs:
+                continue  # orphan claim on a trimmed/acked record
+            cur = self._read_claim(
+                os.path.join(self._sdir(stream), n))
+            if cur is not None and cur[1] > now:
+                live += 1
+        return len(recs) - live
+
+    def pop_takeovers(self, owner):
+        return self._takeovers.pop(owner, 0)
 
     _RATIO_TTL = 0.5  # seconds between spool re-scans
 
@@ -319,6 +688,10 @@ class RedisBroker(Broker):
                 "RedisBroker requires the 'redis' package; use "
                 "FileBroker/InMemoryBroker or install redis-py") from e
         self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self._takeovers: dict[str, int] = {}
+        # last lease claim() was called with: unclaimed() needs it to
+        # tell expired (claimable) PEL entries from live in-flight ones
+        self._last_lease_ms: int | None = None
 
     def xadd(self, stream, fields):  # pragma: no cover - needs server
         return self._r.xadd(stream, fields)
@@ -341,6 +714,94 @@ class RedisBroker(Broker):
         ms, _, seq = upto_id.partition("-")
         succ = f"{ms}-{int(seq or 0) + 1}"
         self._r.xtrim(stream, minid=succ, approximate=False)
+
+    # -- exactly-once work claiming: the Redis-native mapping is stream
+    # consumer groups — XREADGROUP hands each entry to ONE consumer,
+    # XAUTOCLAIM reassigns entries idle past the lease (the dead-replica
+    # takeover), XACK+XDEL is release(done=True).
+    _GROUP = "zoo-fleet"
+
+    def _ensure_group(self, stream):  # pragma: no cover - needs server
+        try:
+            self._r.xgroup_create(stream, self._GROUP, id="0",
+                                  mkstream=True)
+        except Exception:
+            pass  # BUSYGROUP: already exists
+
+    def claim(self, stream, owner, count, lease_ms,
+              block_ms=0):  # pragma: no cover - needs server
+        self._ensure_group(stream)
+        self._last_lease_ms = int(lease_ms)
+        out = []
+        # 1) reclaim entries whose consumer went idle past the lease
+        try:
+            res = self._r.xautoclaim(stream, self._GROUP, owner,
+                                     min_idle_time=int(lease_ms),
+                                     count=count)
+            reclaimed = res[1] if isinstance(res, (list, tuple)) else []
+        except Exception:
+            reclaimed = []
+        for rid, fields in reclaimed:
+            out.append((rid, fields))
+            self._takeovers[owner] = self._takeovers.get(owner, 0) + 1
+        # 2) then fresh, never-delivered entries; never block when the
+        # reclaim already produced records — a takeover drain must not
+        # pay block_ms per cycle on top of the lease it waited out
+        need = count - len(out)
+        if need > 0:
+            res = self._r.xreadgroup(self._GROUP, owner, {stream: ">"},
+                                     count=need,
+                                     block=(block_ms or None)
+                                     if not out else None)
+            for _, recs in res or []:
+                out.extend(recs)
+        return out
+
+    def extend(self, stream, owner, ids,
+               lease_ms):  # pragma: no cover - needs server
+        # XCLAIM justid resets the idle clock without changing ownership
+        if ids:
+            try:
+                self._r.xclaim(stream, self._GROUP, owner, min_idle_time=0,
+                               message_ids=list(ids), justid=True)
+            except Exception:
+                pass
+
+    def release(self, stream, owner, ids,
+                done=False):  # pragma: no cover - needs server
+        ids = list(ids)
+        if not ids:
+            return
+        if done:
+            self._r.xack(stream, self._GROUP, *ids)
+            self._r.xdel(stream, *ids)
+        # done=False: leave the entries in the group's PEL — XAUTOCLAIM
+        # hands them to a survivor once the lease idles out.  (XACK here
+        # would be WRONG: acked entries never re-deliver to the group.)
+        # Requeue latency is therefore one lease on this transport.
+
+    def unclaimed(self, stream):  # pragma: no cover - needs server
+        try:
+            info = self._r.xpending(stream, self._GROUP)
+            pending = int(info.get("pending", 0)) if isinstance(info, dict) \
+                else 0
+            if pending and self._last_lease_ms:
+                # PEL entries idle past the lease are a dead replica's
+                # forfeited work — claimable demand the autoscaler must
+                # see, NOT in-flight capacity; don't subtract them
+                try:
+                    expired = len(self._r.xpending_range(
+                        stream, self._GROUP, min="-", max="+",
+                        count=pending, idle=self._last_lease_ms))
+                    pending -= min(pending, expired)
+                except Exception:
+                    pass  # older server/client without IDLE filtering
+        except Exception:
+            pending = 0
+        return max(0, self.xlen(stream) - pending)
+
+    def pop_takeovers(self, owner):  # pragma: no cover - needs server
+        return self._takeovers.pop(owner, 0)
 
     def hset(self, key, mapping):  # pragma: no cover
         self._r.hset(key, mapping=mapping)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import base64
 import io
+import time
 
 import numpy as np
 
@@ -17,6 +18,23 @@ from .broker import connect_broker
 
 INPUT_STREAM = "image_stream"  # reference stream key, ClusterServing.scala:108
 RESULT_PREFIX = "result:"
+
+
+class ServingTimeout(TimeoutError):
+    """A result did not arrive within the polling deadline.
+
+    Carries the ``uri`` and the ``timeout`` that elapsed, so callers can
+    requeue or alert on the specific lost record instead of parsing a
+    message string."""
+
+    def __init__(self, uri: str, timeout: float):
+        super().__init__(
+            f"no result for {uri!r} within {timeout:.1f}s — the record "
+            "was trimmed under backpressure, dropped as undecodable, or "
+            "the serving fleet is down (check /healthz and "
+            "zoo_serving_backpressure_trims_total)")
+        self.uri = uri
+        self.timeout = timeout
 
 
 def encode_ndarray(arr: np.ndarray) -> str:
@@ -70,6 +88,29 @@ class OutputQueue(API):
         if not h:
             return None
         return _decode_result(h)
+
+    def poll(self, uri: str, timeout: float = 30.0,
+             initial_delay: float = 0.005, max_delay: float = 0.25):
+        """Block until the result for ``uri`` arrives; raise
+        :class:`ServingTimeout` after ``timeout`` seconds.
+
+        Polling backs off exponentially from ``initial_delay`` up to
+        ``max_delay`` — a just-served result returns in milliseconds,
+        while a slow batch costs at most ``max_delay`` staleness and a
+        LOST record (trimmed under backpressure, undecodable) costs a
+        bounded number of broker round-trips instead of a spin loop that
+        hammers the broker forever."""
+        deadline = time.monotonic() + timeout
+        delay = max(initial_delay, 1e-4)
+        while True:
+            res = self.query(uri)
+            if res is not None:
+                return res
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ServingTimeout(uri, timeout)
+            time.sleep(min(delay, remaining))
+            delay = min(delay * 2, max_delay)
 
     def dequeue(self) -> dict:
         """All finished results keyed by uri, removing them from the

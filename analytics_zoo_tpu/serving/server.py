@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -31,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..metrics import (
+    FleetMetrics,
     ServingMetrics,
     StragglerDetector,
     get_flight_recorder,
@@ -44,6 +46,31 @@ from .client import INPUT_STREAM, RESULT_PREFIX, decode_ndarray, \
     encode_ndarray
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+# Continuous-batching latency budget (ms): how long a PARTIAL shape
+# bucket may wait for co-batchable arrivals before it is flushed to
+# predict.  0 disables holding (every claim batch flushes immediately).
+DEFAULT_BATCH_BUDGET_MS = 25.0
+# Fleet work-claim lease (ms): a replica silent for this long forfeits
+# its claimed-but-unserved records to the survivors.
+DEFAULT_LEASE_MS = 10_000
+
+
+def _env_number(name: str, default, cast, minimum):
+    """Eager-validated numeric env knob (the ZooConfig resolve_int
+    pattern, available here without importing the jax-backed engine):
+    a bad value fails at server construction naming the env var."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        val = cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name} must be a number >= {minimum}, got {raw!r}") from None
+    if val < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {val}")
+    return val
 
 
 class ClusterServingHelper:
@@ -75,12 +102,99 @@ class ClusterServingHelper:
         self.filter = overrides.get("filter", params.get("filter"))
         if isinstance(self.filter, str) and self.filter.startswith("topN("):
             self.top_n = int(self.filter[5:].rstrip(")"))
+        # Fleet knobs (claim-mode serving): continuous-batching budget +
+        # work-claim lease.  Precedence: explicit override > yaml params
+        # > env (ZOO_SERVING_BATCH_BUDGET_MS / ZOO_FLEET_LEASE_MS) >
+        # default — the ZooConfig env-tier contract, validated eagerly.
+        budget = overrides.get("batch_budget_ms",
+                               params.get("batch_budget_ms"))
+        if budget is None:  # env parsed only when nothing overrides it
+            budget = _env_number("ZOO_SERVING_BATCH_BUDGET_MS",
+                                 DEFAULT_BATCH_BUDGET_MS, float, 0.0)
+        self.batch_budget_ms = float(budget)
+        if self.batch_budget_ms < 0:
+            raise ValueError(
+                f"batch_budget_ms must be >= 0, got {self.batch_budget_ms}")
+        lease = overrides.get("lease_ms", params.get("lease_ms"))
+        if lease is None:
+            lease = _env_number("ZOO_FLEET_LEASE_MS", DEFAULT_LEASE_MS,
+                                int, 100)
+        self.lease_ms = int(lease)
+        if self.lease_ms < 100:
+            raise ValueError(
+                f"lease_ms must be >= 100 (shorter leases expire inside "
+                f"one broker round-trip), got {self.lease_ms}")
 
     def load_inference_model(self):
         from ..pipeline.inference import InferenceModel
         m = InferenceModel(concurrent_num=1)
         m.load(self.model_path)
         return m
+
+
+class _BucketBatcher:
+    """Per-shape continuous batching for the fleet reader.
+
+    Decoded records are admitted into the in-flight bucket for their
+    SHAPE; a bucket flushes when it reaches ``batch_size`` (reason
+    ``full``) or when its oldest record has waited ``budget_s`` seconds
+    (reason ``budget``) — a lone request is served within the latency
+    budget instead of waiting for co-batchable traffic that may never
+    come, while a trickle of same-shape requests coalesces into one
+    padded predict.  Flushed batches never exceed ``batch_size``, so
+    they land in exactly the power-of-two pad buckets the fixed
+    micro-batch path compiles — continuous batching adds NO new XLA
+    executables.  Single-thread use (the reader owns it); no locks."""
+
+    def __init__(self, batch_size: int, budget_s: float):
+        self.batch_size = max(1, int(batch_size))
+        self.budget_s = max(0.0, float(budget_s))
+        # shape -> list of (rid, uri, arr, t_admit)
+        self._pending: dict = {}
+
+    def add(self, rid: str, uri: str, arr, now: float) -> None:
+        self._pending.setdefault(arr.shape, []).append(
+            (rid, uri, arr, now))
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._pending.values())
+
+    def next_deadline(self) -> float | None:
+        """Monotonic time of the nearest bucket flush, or None when
+        nothing is pending (the reader bounds its claim block on this
+        so a partial bucket is flushed ON its budget, not up to one
+        poll interval late)."""
+        oldest = [recs[0][3] for recs in self._pending.values() if recs]
+        return min(oldest) + self.budget_s if oldest else None
+
+    def _chunk(self, shape, reason: str):
+        recs = self._pending[shape][:self.batch_size]
+        del self._pending[shape][:self.batch_size]
+        if not self._pending[shape]:
+            del self._pending[shape]
+        ids = [r[0] for r in recs]
+        uris = [r[1] for r in recs]
+        arrs = [r[2] for r in recs]
+        return ids, uris, arrs, reason
+
+    def take_ready(self, now: float) -> list:
+        """Flush full buckets, and partial buckets past their budget."""
+        out = []
+        for shape in list(self._pending):
+            while len(self._pending.get(shape, ())) >= self.batch_size:
+                out.append(self._chunk(shape, "full"))
+            recs = self._pending.get(shape)
+            if recs and now - recs[0][3] >= self.budget_s:
+                out.append(self._chunk(shape, "budget"))
+        return out
+
+    def take_all(self) -> list:
+        """Drain everything (shutdown path)."""
+        out = []
+        for shape in list(self._pending):
+            while shape in self._pending:
+                out.append(self._chunk(shape, "drain"))
+        return out
 
 
 class ClusterServing:
@@ -92,6 +206,7 @@ class ClusterServing:
 
     def __init__(self, helper: ClusterServingHelper | None = None,
                  model=None, broker=None, config_path: str | None = None,
+                 owner: str | None = None, serve_log: str | None = None,
                  **overrides):
         self.helper = helper or ClusterServingHelper(config_path,
                                                      **overrides)
@@ -99,6 +214,18 @@ class ClusterServing:
                                  else self.helper.broker_spec)
         self.model = model if model is not None \
             else self.helper.load_inference_model()
+        # Fleet replica identity (serving/fleet.py): when set, run()
+        # CLAIMS records under a lease instead of reading by cursor —
+        # N owners against one broker never double-serve, and this
+        # replica's death forfeits its in-flight claims to survivors
+        # after helper.lease_ms.  Continuous batching rides the same
+        # mode (helper.batch_budget_ms).
+        self.owner = owner
+        # Optional serve audit log: one "<owner> <uri>" line appended
+        # AFTER each batch's results are durable and its claims
+        # released — the exactly-once ledger the fleet tests (and any
+        # delivery audit) read.
+        self.serve_log = serve_log
         self.summary = InferenceSummary(
             self.helper.log_dir,
             time.strftime("%Y%m%d-%H%M%S") + "-ClusterServing")
@@ -307,6 +434,11 @@ class ClusterServing:
         # that stopped cycling.
         health.register("serving_loop", stale_after=120.0)
         try:
+            if self.owner is not None:
+                # fleet replica: claim-based exactly-once loop with
+                # continuous batching (always pipelined — the claim
+                # protocol lives in the reader/writer stages)
+                return self._run_fleet(max_records, idle_timeout, health)
             if pipelined:
                 return self._run_pipelined(max_records, idle_timeout,
                                            health)
@@ -504,6 +636,284 @@ class ClusterServing:
             wt.join(timeout=5.0)
             decode_pool.shutdown(wait=False)
             self._last_id = processed_id
+        return served
+
+    # zoolint: hot-path
+    def _run_fleet(self, max_records, idle_timeout, health) -> int:
+        """Fleet-replica pipeline: claim(lease) + decode + continuous
+        batching → predict → write-back + release(done).
+
+        Differences from :meth:`_run_pipelined`, all in service of
+        exactly-once across N replicas on one broker:
+
+        - the reader CLAIMS records under ``helper.lease_ms`` instead of
+          reading by cursor — other replicas cannot see claimed records,
+          and a keepalive thread extends in-flight leases at lease/3 so
+          a slow batch (first predict pays the bucketed XLA compile)
+          never forfeits mid-flight;
+        - decoded records are admitted into per-shape buckets up to
+          ``helper.batch_budget_ms`` (:class:`_BucketBatcher`) — a lone
+          request is served within the budget, a trickle coalesces into
+          one padded predict, a full bucket flushes immediately;
+        - the writer RELEASES (``done=True``) each batch's claims only
+          after its results are flushed — the claimed-record ack; clean
+          shutdown releases leftovers with ``done=False`` so survivors
+          re-claim them immediately instead of waiting out the lease.
+        """
+        in_q: queue.Queue = queue.Queue(maxsize=self._PIPE_DEPTH)
+        out_q: queue.Queue = queue.Queue(maxsize=self._PIPE_DEPTH * 2)
+        done = threading.Event()
+        end = object()  # pipe sentinel
+        decode_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="zoo-serving-decode")
+        owner = self.owner
+        lease_ms = self.helper.lease_ms
+        batcher = _BucketBatcher(self.helper.batch_size,
+                                 self.helper.batch_budget_ms / 1e3)
+        fleet = FleetMetrics()
+        inflight_lock = threading.Lock()
+        # claimed ids not yet released (reader adds, writer removes,
+        # keepalive extends, shutdown requeues)
+        inflight: set = set()  # guarded-by: inflight_lock
+
+        def stopped():
+            return done.is_set() or self._stop.is_set()
+
+        def bput(q, item) -> bool:
+            while not stopped():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def keepalive():
+            # extend at lease/3: two missed beats of margin before a
+            # survivor may legally take the records over
+            period = max(lease_ms / 3000.0, 0.05)
+            while not done.wait(period):
+                if self._stop.is_set():
+                    return
+                with inflight_lock:
+                    ids = sorted(inflight)
+                if not ids:
+                    continue
+                try:
+                    self.db.extend(INPUT_STREAM, owner, ids, lease_ms)
+                except Exception:
+                    logger.exception(
+                        "serving: lease keepalive failed; continuing")
+
+        def admit(records, now):
+            """Hand one claim batch to the batcher.  On ANY failure the
+            claimed-but-unadmitted records are dropped from ``inflight``
+            (stopping the keepalive renewing their leases forever — the
+            wedged-invisible failure mode) and requeued for immediate
+            re-claim; if even the requeue fails, the lease simply
+            expires to a survivor."""
+            with inflight_lock:
+                inflight.update(r[0] for r in records)
+            admitted: set = set()
+            try:
+                takeovers = self.db.pop_takeovers(owner)
+                if takeovers:
+                    # a dead replica's records reclaimed:
+                    # the fleet's fault-tolerance event
+                    fleet.lease_takeovers.inc(takeovers)
+                    self._flight.record(
+                        "lease_takeover", owner=owner,
+                        records=takeovers)
+                decoded = list(decode_pool.map(
+                    lambda rf: self._decode_one(rf[0], rf[1]),
+                    records))
+                bad = [rid for (rid, _), arr
+                       in zip(records, decoded) if arr is None]
+                if bad:
+                    # undecodable/mis-shaped: judged unservable — ack
+                    # so no replica loops on them (serial-mode parity)
+                    self.db.release(INPUT_STREAM, owner, bad, done=True)
+                    with inflight_lock:
+                        inflight.difference_update(bad)
+                    admitted.update(bad)  # handled: don't requeue
+                for (rid, fields), arr in zip(records, decoded):
+                    if arr is not None:
+                        batcher.add(rid, fields.get("uri", rid),
+                                    arr, now)
+                        admitted.add(rid)
+            except Exception:
+                leftover = [r[0] for r in records
+                            if r[0] not in admitted]
+                with inflight_lock:
+                    inflight.difference_update(leftover)
+                try:
+                    self.db.release(INPUT_STREAM, owner, leftover,
+                                    done=False)
+                except Exception:
+                    pass  # broker down: leases expire to survivors
+                raise
+
+        def reader():
+            health.register("serving_reader", stale_after=120.0)
+            depth_refreshed = 0.0
+            try:
+                while not stopped():
+                    try:
+                        ratio = self.db.memory_ratio()
+                        self.metrics.memory_ratio.set(ratio)
+                        if ratio >= self.INPUT_THRESHOLD:
+                            # zoolint: disable=host-sync -- broker-side host integer, no device involved
+                            keep = int(self.db.xlen(INPUT_STREAM)
+                                       * self.CUT_RATIO)
+                            self.db.xtrim(INPUT_STREAM, keep)
+                            self.metrics.trims.inc()
+                        # block until records OR the nearest partial
+                        # bucket's budget, whichever is sooner
+                        nd = batcher.next_deadline()
+                        block = 100 if nd is None else max(
+                            0, min(100, int((nd - time.monotonic()) * 1e3)))  # zoolint: disable=host-sync -- host clock math, no device value
+                        records = self.db.claim(
+                            INPUT_STREAM, owner, self.helper.batch_size,
+                            lease_ms, block_ms=block)
+                        health.heartbeat("serving_reader")
+                        now = time.monotonic()
+                        if records:
+                            admit(records, now)
+                            if self.metrics.enabled \
+                                    and now - depth_refreshed >= 0.5:
+                                # rate-limited: unclaimed() walks the
+                                # whole stream (spool listdir / full
+                                # scan under the broker lock) — not a
+                                # per-batch hot-path cost for a gauge
+                                depth_refreshed = now
+                                self.metrics.queue_depth.set(
+                                    self.db.unclaimed(INPUT_STREAM))
+                        for bucket in batcher.take_ready(time.monotonic()):
+                            fleet.batch_flushes.labels(
+                                reason=bucket[3]).inc()
+                            if not bput(in_q, bucket):
+                                return
+                    except Exception:
+                        # a bad poll/decode must not kill the pipeline
+                        logger.exception(
+                            "serving: fleet reader failed; continuing")
+                        time.sleep(0.05)
+            finally:
+                health.unregister("serving_reader")
+                bput(in_q, end)  # no-op when the main loop already left
+
+        def writer():
+            health.register("serving_writer", stale_after=120.0)
+            try:
+                while True:
+                    try:
+                        item = out_q.get(timeout=0.5)
+                    except queue.Empty:
+                        health.heartbeat("serving_writer")
+                        continue
+                    if item is end:
+                        return
+                    writes, ids, uris = item
+                    try:
+                        if writes:
+                            self.db.hset_many(writes)
+                        # results durable (or the batch judged failed):
+                        # NOW the claims end and the records leave the
+                        # stream — the exactly-once commit point
+                        self.db.release(INPUT_STREAM, owner, ids,
+                                        done=True)
+                        if self.serve_log and writes:
+                            with open(self.serve_log, "a") as f:
+                                # one write() call: O_APPEND keeps
+                                # concurrent replicas' lines whole
+                                f.write("".join(
+                                    f"{owner} {u}\n" for u in uris))
+                    except Exception:
+                        logger.exception(
+                            "serving: write-back failed; continuing")
+                    with inflight_lock:
+                        inflight.difference_update(ids)
+                    health.heartbeat("serving_writer")
+            finally:
+                health.unregister("serving_writer")
+
+        rt = threading.Thread(target=reader, daemon=True,
+                              name="zoo-serving-reader")
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="zoo-serving-writer")
+        kt = threading.Thread(target=keepalive, daemon=True,
+                              name="zoo-serving-lease")
+        rt.start()
+        wt.start()
+        kt.start()
+        served = 0
+        last_active = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = in_q.get(timeout=0.1)
+                except queue.Empty:
+                    health.heartbeat("serving_loop")
+                    if idle_timeout is not None and \
+                            time.monotonic() - last_active > idle_timeout:
+                        break
+                    continue
+                if item is end:
+                    break
+                ids, uris, arrs, _reason = item
+                t0 = time.perf_counter()
+                n = 0
+                writes = []
+                try:
+                    with span("zoo.serving.step"):
+                        writes = self._predict_groups(
+                            self._group_by_shape(uris, arrs))
+                    n = len(uris)
+                except Exception as e:
+                    self._flight.record_exception(e, where="serving.step")
+                    logger.exception("serving: batch failed; continuing")
+                    writes = []  # failed batch: release done (parity)
+                if not bput(out_q, (writes, ids, uris)):
+                    break
+                t_end = time.perf_counter()
+                health.heartbeat("serving_loop")
+                if n:
+                    served += n
+                    self.total_count += n
+                    last_active = time.monotonic()
+                    self.summary.add_scalar(
+                        "Throughput", n / max(t_end - t0, 1e-9),
+                        self.total_count)
+                    self._record_cycle(len(ids), n, t_end - t0)
+                if max_records is not None and served >= max_records:
+                    break
+        finally:
+            done.set()
+            rt.join(timeout=5.0)
+            # sentinel lands AFTER every enqueued write (FIFO): the
+            # writer flushes + releases all handed-off batches first
+            try:
+                out_q.put(end, timeout=5.0)
+            except queue.Full:
+                pass
+            wt.join(timeout=5.0)
+            kt.join(timeout=5.0)
+            decode_pool.shutdown(wait=False)
+            # requeue every claim this replica still holds (batcher
+            # remnants, in_q items, dropped batches): done=False makes
+            # them immediately claimable by survivors — a clean exit
+            # never makes the fleet wait out a lease
+            with inflight_lock:
+                leftover = sorted(inflight)
+                inflight.clear()
+            if leftover:
+                try:
+                    self.db.release(INPUT_STREAM, owner, leftover,
+                                    done=False)
+                except Exception:
+                    logger.exception(
+                        "serving: shutdown requeue failed; leases will "
+                        "expire instead")
         return served
 
     def start(self, **kwargs) -> "ClusterServing":

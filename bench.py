@@ -381,6 +381,252 @@ def _autotune_main(argv):
 
 
 # ---------------------------------------------------------------------------
+# --fleet: multi-replica serving fleet bench (serving/fleet.py).  No real
+# model — the replicas serve the synthetic sleep model (per-RECORD
+# GIL-releasing service time, like device inference), so the bench
+# measures the CONTROL PLANE: the exactly-once claim protocol's
+# scaling efficiency and the SLO autoscaler's response to a load step.
+# Emits BENCH_FLEET_r09.json so the gains are pinned, not asserted.
+# ---------------------------------------------------------------------------
+
+
+def _fleet_controller(broker, replicas: int, service_ms: float,
+                      batch_size: int = 8, budget_ms: float = 5.0,
+                      scaler=None, interval: float = 0.5,
+                      slo_p99_ms: float = 500.0):
+    from analytics_zoo_tpu.serving import ClusterServingHelper
+    from analytics_zoo_tpu.serving.fleet import (
+        FleetController,
+        _SyntheticModel,
+    )
+    from analytics_zoo_tpu.serving.scaler import SloScaler
+
+    helper = ClusterServingHelper(
+        model_path=None, batch_size=batch_size, batch_budget_ms=budget_ms,
+        lease_ms=5_000, log_dir=os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "zoo-fleet-bench"))
+    if scaler is None:  # fixed-size fleet: min == max pins the count
+        scaler = SloScaler(slo_p99_ms=slo_p99_ms, min_replicas=replicas,
+                           max_replicas=replicas)
+    return FleetController(
+        helper, broker, model_factory=lambda: _SyntheticModel(service_ms),
+        scaler=scaler, interval=interval)
+
+
+def fleet_scaling_bench(quick: bool = False) -> dict:
+    """Saturated-backlog drain: wall-clock throughput of a 2-replica
+    fleet vs 1 replica over ONE shared broker.  The claim protocol is
+    the only coordination; >= 1.8x means leases + continuous batching
+    cost < 10% of the doubled service capacity."""
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue, \
+        OutputQueue
+
+    service_ms = 2.0
+    n_records = 300 if quick else 1200
+    out = {"service_ms_per_record": service_ms, "records": n_records,
+           "throughput_rps": {}}
+    for replicas in (1, 2):
+        broker = InMemoryBroker()
+        inq = InputQueue(broker=broker)
+        rec = np.zeros((8,), np.float32)
+        for i in range(n_records):
+            inq.enqueue(f"u{i}", rec)
+        ctrl = _fleet_controller(broker, replicas, service_ms)
+        outq = OutputQueue(broker=broker)
+        got = 0
+        t0 = time.perf_counter()
+        ctrl.start()
+        deadline = t0 + 300.0
+        while got < n_records and time.perf_counter() < deadline:
+            got += len(outq.dequeue())
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        ctrl.stop()
+        if got != n_records:
+            raise RuntimeError(
+                f"fleet of {replicas} served {got}/{n_records}")
+        out["throughput_rps"][str(replicas)] = round(n_records / wall, 1)
+    out["scaling_2x_vs_1x"] = round(
+        out["throughput_rps"]["2"] / out["throughput_rps"]["1"], 3)
+    return out
+
+
+def fleet_slo_bench(quick: bool = False) -> dict:
+    """Offered-load step through the AUTOSCALING fleet: light traffic →
+    overload (≈2.5x one replica's capacity) → light again.  Reports the
+    client-observed p99 per load phase, the replica-count timeline, and
+    the scaler's decision log — the acceptance story is p99 back under
+    the SLO after scale-up, and replicas back at min after the load
+    drops."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue, \
+        OutputQueue
+    from analytics_zoo_tpu.serving.scaler import SloScaler
+
+    service_ms = 8.0  # one replica saturates at ~125 rec/s
+    slo_p99_ms = 400.0
+    interval = 0.25 if quick else 0.5
+    phases = [("light", 2.0 if quick else 4.0, 30.0),
+              ("overload", 6.0 if quick else 12.0, 300.0),
+              ("light_again", 4.0 if quick else 8.0, 30.0)]
+    # down_windows is the scale-down STABILIZATION window (the HPA
+    # convention: minutes in production, seconds here): once the scaled-
+    # up fleet drains the burst it reads slack, and the window must
+    # outlast the rest of the overload phase or the fleet flaps down
+    # into a marginal capacity that rebuilds the backlog
+    scaler = SloScaler(slo_p99_ms=slo_p99_ms, min_replicas=1,
+                       max_replicas=4, up_windows=2,
+                       down_windows=18 if quick else 22)
+    broker = InMemoryBroker()
+    ctrl = _fleet_controller(broker, 1, service_ms, scaler=scaler,
+                             interval=interval, slo_p99_ms=slo_p99_ms)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    enq_ts: dict = {}
+    lat: dict = {}  # uri -> (phase, latency_s)
+    phase_of: dict = {}
+    timeline = []
+    stop = threading.Event()
+
+    def collector():
+        while not stop.is_set():
+            now = time.perf_counter()
+            for uri in outq.dequeue():
+                t0 = enq_ts.get(uri)
+                if t0 is not None:
+                    lat[uri] = (phase_of[uri], now - t0)
+            time.sleep(0.004)
+
+    def sampler():
+        t_start = time.perf_counter()
+        while not stop.is_set():
+            timeline.append({
+                "t_s": round(time.perf_counter() - t_start, 2),
+                "replicas": ctrl.replica_count(),
+                "backlog": broker.unclaimed("image_stream"),
+            })
+            time.sleep(interval)
+
+    ctrl.start()
+    ct = threading.Thread(target=collector, daemon=True)
+    st = threading.Thread(target=sampler, daemon=True)
+    ct.start()
+    st.start()
+    rec = np.zeros((8,), np.float32)
+    seq = 0
+    phase_windows = {}
+    for phase, duration, rate in phases:
+        t_phase = time.perf_counter()
+        phase_windows[phase] = [t_phase, t_phase + duration]
+        while time.perf_counter() - t_phase < duration:
+            uri = f"q{seq}"
+            seq += 1
+            phase_of[uri] = phase
+            enq_ts[uri] = time.perf_counter()
+            inq.enqueue(uri, rec)
+            # paced offered load (sleep-based, so the achieved rate is
+            # slightly under `rate` — the backlog signal is what counts)
+            time.sleep(1.0 / rate)
+    # drain: everything enqueued must come back before the report
+    deadline = time.perf_counter() + 120.0
+    while len(lat) < seq and time.perf_counter() < deadline:
+        time.sleep(0.05)
+    # let the scaler see the slack windows and come back down
+    down_deadline = time.perf_counter() + (15.0 if quick else 30.0)
+    while ctrl.replica_count() > 1 and time.perf_counter() < down_deadline:
+        time.sleep(0.1)
+    final_replicas = ctrl.replica_count()
+    decisions = ctrl.decision_log()
+    max_replicas_seen = max(
+        [t["replicas"] for t in timeline] +
+        [d["new"] for d in decisions if d["action"] == "up"] + [1])
+    stop.set()
+    ct.join(timeout=5)
+    st.join(timeout=5)
+    ctrl.stop()
+
+    def p99(vals):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(0.99 * len(vals)))] * 1e3, 1)
+
+    by_phase = {}
+    for phase, _, rate in phases:
+        vals = [v for p, v in lat.values() if p == phase]
+        by_phase[phase] = {"offered_rps": rate, "requests": len(vals),
+                           "client_p99_ms": p99(vals)}
+    # the SLO story: requests arriving in the LAST third of the overload
+    # phase (post scale-up) vs the first third (pre scale-up)
+    t0o, t1o = phase_windows["overload"]
+    third = (t1o - t0o) / 3.0
+    early, late = [], []
+    for uri, (p, v) in lat.items():
+        if p != "overload":
+            continue
+        ts = enq_ts[uri]
+        if ts < t0o + third:
+            early.append(v)
+        elif ts > t1o - third:
+            late.append(v)
+    return {
+        "service_ms_per_record": service_ms,
+        "slo_p99_ms": slo_p99_ms,
+        "phases": by_phase,
+        "overload_early_p99_ms": p99(early),
+        "overload_late_p99_ms": p99(late),
+        "slo_held_after_scaleup": (p99(late) or 1e9) <= slo_p99_ms,
+        "scaled_up": max_replicas_seen > 1,
+        "scaled_down_after": final_replicas == 1,
+        "max_replicas_seen": max_replicas_seen,
+        "final_replicas": final_replicas,
+        "replica_timeline": timeline,
+        "decisions": [
+            {k: d[k] for k in ("action", "old", "new", "reason",
+                               "est_p99_ms", "queue_depth")}
+            for d in decisions],
+    }
+
+
+def fleet_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    """Both fleet benches; writes BENCH_FLEET_r09.json."""
+    doc = {
+        "metric": "fleet_throughput_scaling_and_slo_step",
+        "unit": "2-replica/1-replica throughput ratio",
+        "platform": "cpu",
+        "quick": bool(quick),
+        "scaling": fleet_scaling_bench(quick=quick),
+        "slo_step": fleet_slo_bench(quick=quick),
+    }
+    doc["value"] = doc["scaling"]["scaling_2x_vs_1x"]
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_FLEET_r09.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _fleet_main(argv):
+    # control-plane bench: host threads + sleep models, CPU is the point
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(fleet_bench(**kwargs)))
+
+
+# ---------------------------------------------------------------------------
 # --dispatch: fused multi-step dispatch + compile plane bench
 # (ZOO_STEPS_PER_DISPATCH / ZOO_COMPILE_CACHE; docs/performance.md).
 # Two measurements on a deliberately dispatch-bound synthetic model (tiny
@@ -806,6 +1052,8 @@ def _data_pipeline_main(argv):
 if __name__ == "__main__":
     if "--data-pipeline" in sys.argv:
         _data_pipeline_main(sys.argv[1:])
+    elif "--fleet" in sys.argv:
+        _fleet_main(sys.argv[1:])
     elif "--autotune" in sys.argv:
         _autotune_main(sys.argv[1:])
     elif "--dispatch-child" in sys.argv:

@@ -58,6 +58,7 @@ QUICK_FILES = {
     "test_zoolint.py",  # static analysis + package-clean CI gate
     "test_zoosan.py",  # whole-program pass + runtime sanitizer
     "test_telemetry.py",  # ~9s incl. two actor spawns
+    "test_fleet.py",  # serving fleet: claim protocol, autoscaler, kill -9
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier
 }

@@ -26,12 +26,19 @@ program: `zoo_hlo_flops` / `zoo_hlo_bytes_accessed` /
 `zoo_hlo_collectives` / `zoo_hlo_collective_bytes` /
 `zoo_hlo_fused_dispatches` / `zoo_hlo_ops` / `zoo_hlo_findings`, all
 `{label=<compile label>}`, plus `zoo_hlo_lint_findings_total{rule=}`
-— see docs/static-analysis.md), and `zoo_autotune` (the closed-loop
+— see docs/static-analysis.md), `zoo_autotune` (the closed-loop
 controller's current worker/depth/read-ahead/K gauges, RAM
-budget/estimate pair, and `zoo_autotune_decisions_total{knob,reason}`).
-When the scraped ``/varz`` carries the controller's structured decision
-log (``autotune`` section), it is additionally rendered as a table —
-time, knob, old → new, reason — above the metric rows.
+budget/estimate pair, and `zoo_autotune_decisions_total{knob,reason}`),
+and `zoo_fleet` (the serving fleet's live/target replica gauges,
+`zoo_fleet_decisions_total{action,reason}`, the exactly-once
+fault-tolerance pair `zoo_fleet_lease_takeovers_total` /
+`zoo_fleet_replica_deaths_total`, the scaler's
+`zoo_fleet_est_p99_seconds` / `zoo_fleet_unclaimed_backlog` window
+signals, and `zoo_fleet_batch_flushes_total{reason}` from the
+continuous batcher).  When the scraped ``/varz`` carries a structured
+decision log (``autotune`` / ``fleet`` sections), it is additionally
+rendered as a table — time, knob/action, old → new, reason — above the
+metric rows.
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
@@ -135,6 +142,48 @@ def render_autotune(doc, prefix="", out=None):
                  f"{d['reason']}")
 
 
+def render_fleet(doc, prefix="", out=None):
+    """Fleet panel for the ``fleet`` section a live ``/varz`` carries
+    when a FleetController ran (serving/fleet.py): each controller's
+    replica/scaler state, then one row per scale decision (time, action,
+    replicas old→new, estimated p99 vs the window's queue, reason).
+    Skipped when the snapshot has no fleet section or ``--prefix``
+    filters it out."""
+    import datetime
+
+    fleet = doc.get("fleet")
+    if not fleet or (prefix and not "zoo_fleet".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for ctl in fleet.get("controllers", []):
+        cur = ctl.get("current", {})
+        win = cur.get("window", {})
+        emit("\nfleet: replicas={replicas}/{target} (max={max_replicas}) "
+             "slo_p99={slo_p99_ms}ms mode={mode}".format(
+                 **{k: cur.get(k) for k in
+                    ("replicas", "target", "max_replicas", "slo_p99_ms",
+                     "mode")}))
+        emit("  window: predict_p99={predict_p99_ms}ms "
+             "rate={service_rate}/s queue={queue_depth} "
+             "mem={memory_ratio}".format(
+                 **{k: win.get(k) for k in
+                    ("predict_p99_ms", "service_rate", "queue_depth",
+                     "memory_ratio")}))
+    decisions = fleet.get("decisions", [])
+    if decisions:
+        emit(f"\n{'time':<14}{'action':<9}{'replicas':<11}"
+             f"{'est_p99':<11}{'queue':<7}reason")
+        for d in decisions:
+            t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            est = "-" if d.get("est_p99_ms") is None \
+                else f"{d['est_p99_ms']:.0f} ms"
+            emit(f"{t:<14}{d['action']:<9}"
+                 f"{str(d['old']) + ' -> ' + str(d['new']):<11}"
+                 f"{est:<11}{str(d.get('queue_depth', '-')):<7}"
+                 f"{d['reason']}")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("path", nargs="?", help="JSONL metrics file")
@@ -191,6 +240,7 @@ def main():
     src = a.url if a.url else a.path
     print(f"# {src}: {len(docs)} snapshot(s), window {dt:.1f}s")
     render_autotune(last, prefix=a.prefix)
+    render_fleet(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
