@@ -18,6 +18,10 @@ never an exception.
 - ``nondonated-carry``: a jit over a training-carry signature
   (``opt_state``/``carry``) without ``donate_argnums`` doubles peak
   memory — the old buffers stay live across the update.
+- ``raw-jit``: a ``jax.jit``/``pjit`` call site outside the compile
+  plane (``compile_step`` / ``timed_compile``) produces programs the
+  persistent cache, AOT warmup, ``zoo_compile_seconds`` metering and
+  the HLO graph lint never see.
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ from analytics_zoo_tpu.analysis.astlint import (
 from analytics_zoo_tpu.analysis.findings import Finding, Severity
 
 __all__ = ["JAX_RULES", "JitSideEffectRule", "PrngReuseRule",
-           "HostSyncRule", "NonDonatedCarryRule"]
+           "HostSyncRule", "NonDonatedCarryRule", "RawJitRule"]
 
 # Calls that are host side effects when traced.  Exact qualnames plus
 # the numpy.random.* / random.* families.
@@ -275,5 +279,77 @@ class NonDonatedCarryRule(Rule):
                             function=fn.name, carries=carries)
 
 
+class RawJitRule(Rule):
+    """Package code must compile through the compile plane: a raw
+    ``jax.jit``/``pjit`` call bypasses the persistent compile cache,
+    AOT warmup, ``zoo_compile_seconds`` metering and the HLO graph
+    lint/feature extraction — all of which live behind ONE choke point
+    (``parallel.plan.compile_step`` → ``compile_cache.timed_compile``).
+    A jit whose lowering flows INTO ``timed_compile(...)`` in the same
+    expression (the ``timed_compile(jax.jit(f).lower(...))`` idiom) is
+    exempt — that IS the choke point."""
+
+    name = "raw-jit"
+    severity = Severity.WARNING
+    description = ("jax.jit/pjit outside compile_step/timed_compile — "
+                   "the program bypasses the compile plane (persistent "
+                   "cache, metering, HLO lint)")
+
+    _CHOKE_TAILS = ("timed_compile", "compile_step")
+
+    def _inside_choke(self, mod: LintModule, node: ast.AST) -> bool:
+        for a in mod.ancestors(node):
+            if isinstance(a, ast.Call):
+                q = mod.qualname(a.func)
+                if q and q.rsplit(".", 1)[-1] in self._CHOKE_TAILS:
+                    return True
+        return False
+
+    def _jit_call(self, mod: LintModule, node: ast.AST):
+        """The offending jit expression, or None: a ``jax.jit(...)``
+        call, or ``partial(jax.jit, ...)``."""
+        if not isinstance(node, ast.Call):
+            return None
+        q = mod.qualname(node.func)
+        if q in _JIT_NAMES:
+            return q
+        if q in _PARTIAL_NAMES and node.args \
+                and mod.qualname(node.args[0]) in _JIT_NAMES:
+            return mod.qualname(node.args[0])
+        return None
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        decorator_calls = set()
+        for fn in mod.functions():
+            for dec in fn.decorator_list:
+                q = mod.qualname(dec)
+                bare = q in _JIT_NAMES
+                call = self._jit_call(mod, dec)
+                if bare or call:
+                    decorator_calls.add(id(dec))
+                    # anchored at the DECORATOR (the offense — and where
+                    # a suppression comment naturally sits)
+                    yield self.finding(
+                        mod, dec,
+                        f"`{fn.name}` is jitted with a raw "
+                        f"`{call or q}` decorator — route it through "
+                        "compile_step (parallel/plan.py) so it shares "
+                        "the compile plane, or suppress with a "
+                        "justification",
+                        function=fn.name)
+        for node in ast.walk(mod.tree):
+            if id(node) in decorator_calls:
+                continue
+            call = self._jit_call(mod, node)
+            if call is None or self._inside_choke(mod, node):
+                continue
+            yield self.finding(
+                mod, node,
+                f"raw `{call}` call bypasses the compile plane — use "
+                "compile_step (parallel/plan.py) / timed_compile, or "
+                "suppress with a justification",
+                call=call)
+
+
 JAX_RULES = (JitSideEffectRule(), PrngReuseRule(), HostSyncRule(),
-             NonDonatedCarryRule())
+             NonDonatedCarryRule(), RawJitRule())

@@ -134,7 +134,26 @@ class ZooConfig:
                                zoo_compile_* metrics
       ZOO_SHARD_OPTIMIZER      "1": ZeRO-1 — shard optimizer state over
                                the data axis (1/n memory + update compute
-                               per chip; params stay replicated)
+                               per chip; params stay replicated).  Legacy
+                               spelling of ZOO_SHARDING_PLAN=zero1.
+      ZOO_SHARDING_PLAN        named sharding plan for training
+                               (parallel/plan.py; docs/parallelism.md):
+                               "dp" (replicate — default), "zero1"
+                               (optimizer state sharded over data),
+                               "fsdp" (params + optimizer state sharded
+                               over data; gather-on-use /
+                               reduce-scatter — ~1/n param+opt bytes
+                               per chip at a bit-identical loss
+                               trajectory).  Tensor-parallel plans
+                               carry a rule table, so they are passed
+                               as objects (fit(plan=tensor_parallel(
+                               rules))), not named here.
+      ZOO_DCN_AXIS             mesh axis that crosses the data-center
+                               network when parallel.plan.build_mesh
+                               assembles a hybrid ICI x DCN mesh from a
+                               bare slice count (default "data"; a name
+                               not in the ICI axes, e.g. "dcn", is
+                               prepended as a new outermost axis)
       ZOO_METRICS_PORT         serve /metrics /varz /trace /healthz
                                /flightz over HTTP from the serving loop /
                                estimator fit (metrics/http.py; bind
@@ -242,7 +261,15 @@ class ZooConfig:
     # ZeRO-1: shard optimizer state (Adam moments) over the data axis via
     # GSPMD sharding constraints — 1/n optimizer memory and update compute
     # per chip; parameters stay replicated.  Env: ZOO_SHARD_OPTIMIZER=1.
+    # (Legacy spelling of sharding_plan="zero1".)
     shard_optimizer: bool | None = None
+    # Unified partitioner (parallel/plan.py): named sharding plan for
+    # every fit ("dp" | "zero1" | "fsdp"); None = dp (or zero1 when the
+    # legacy shard_optimizer flag is set).  Env: ZOO_SHARDING_PLAN.
+    sharding_plan: str | None = None
+    # Hybrid ICI x DCN meshes (plan.build_mesh): which axis crosses the
+    # DCN when given a bare slice count.  Env: ZOO_DCN_AXIS.
+    dcn_axis: str | None = None
     # Closed-loop autotuning (feature/autotune.py): resize the prefetch
     # plane online and hill-climb steps_per_dispatch from telemetry.
     # Env: ZOO_AUTOTUNE=1 plus the budget knobs below.
@@ -308,6 +335,22 @@ class ZooConfig:
             minimum=1)
         self.shard_optimizer = bool(resolve(
             self.shard_optimizer, "ZOO_SHARD_OPTIMIZER", False))
+        self.sharding_plan = resolve(
+            self.sharding_plan, "ZOO_SHARDING_PLAN", None, cast=str)
+        if self.sharding_plan is not None:
+            # eager validation (the resolve_int contract): a typo'd plan
+            # name fails at context init naming the knob, not from the
+            # first fit()
+            from analytics_zoo_tpu.parallel.plan import PLAN_NAMES
+
+            if str(self.sharding_plan).strip().lower() not in PLAN_NAMES:
+                raise ValueError(
+                    f"ZOO_SHARDING_PLAN must be one of "
+                    f"{', '.join(PLAN_NAMES)}; got {self.sharding_plan!r}")
+        self.dcn_axis = resolve(
+            self.dcn_axis, "ZOO_DCN_AXIS", None, cast=str)
+        if self.dcn_axis is not None and not str(self.dcn_axis).strip():
+            raise ValueError("ZOO_DCN_AXIS must be a mesh axis name")
         def parse_bool(raw):
             s = str(raw).strip().lower()
             if s in ("1", "true", "yes", "on"):
@@ -429,14 +472,17 @@ class ZooContext:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
-    def batch_sharding(self, ndim: int) -> NamedSharding:
-        """Shard the leading (batch) dim over the data axis, replicate rest.
-        Scalars (ndim 0) are replicated."""
+    def batch_sharding(self, ndim: int,
+                       axes: Sequence[str] = (DATA_AXIS,)) -> NamedSharding:
+        """Shard the leading (batch) dim over ``axes`` (default the data
+        axis — a hybrid-mesh plan may pass ``("dcn", "data")``),
+        replicate the rest.  Scalars (ndim 0) are replicated."""
         if ndim == 0:
             return self.replicated()
-        return NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+        lead = axes[0] if len(axes) == 1 else tuple(axes)
+        return NamedSharding(self.mesh, P(lead, *([None] * (ndim - 1))))
 
-    def shard_batch(self, tree):
+    def shard_batch(self, tree, axes: Sequence[str] = (DATA_AXIS,)):
         """Device-put a host batch pytree sharded over the data axis.
 
         This is the per-chip host infeed replacing the reference's
@@ -452,9 +498,11 @@ class ZooContext:
         # batch_sharding(0) is replicated, so scalars (n_valid, seeds —
         # same value on every process) and batch arrays go through the
         # same call.
-        return self._put_tree(tree, self.batch_sharding)
+        return self._put_tree(
+            tree, lambda ndim: self.batch_sharding(ndim, axes))
 
-    def shard_batch_stacked(self, tree):
+    def shard_batch_stacked(self, tree,
+                            axes: Sequence[str] = (DATA_AXIS,)):
         """Device-put a K-STACKED super-batch (leading axis = inner step
         index, axis 1 = batch) for the fused multi-step dispatch
         (``ZOO_STEPS_PER_DISPATCH``, Estimator scan-K path).
@@ -468,8 +516,9 @@ class ZooContext:
         def sharding_of(ndim: int) -> NamedSharding:
             if ndim < 2:
                 return self.replicated()
+            lead = axes[0] if len(axes) == 1 else tuple(axes)
             return NamedSharding(
-                self.mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+                self.mesh, P(None, lead, *([None] * (ndim - 2))))
 
         return self._put_tree(tree, sharding_of)
 

@@ -4,8 +4,15 @@ The reference's only inter-node strategy is synchronous data parallelism on
 Spark (SURVEY.md §2.4); TP/PP/SP/EP are absent.  Here every strategy is a
 first-class mesh axis (common/engine.py axes: data/model/seq/expert/pipe):
 
+- :mod:`plan` — the unified partitioner: :class:`~analytics_zoo_tpu.
+  parallel.plan.ShardingPlan` rule tables (regex → PartitionSpec over
+  logical tree paths), canned plans (``data_parallel``/``zero1``/
+  ``fsdp``/``tensor_parallel``), the hybrid ICI×DCN mesh builder, and
+  ``compile_step`` — the ONE compile choke point every strategy lowers
+  through (persistent cache + HLO lint + compile metering).
 - :mod:`strategies` — explicit shard_map train steps (psum = the
-  AllReduceParameter replacement), tensor-parallel dense helpers.
+  AllReduceParameter replacement), tensor-parallel dense helpers; thin
+  wrappers over :mod:`plan`'s choke point.
 - :mod:`ring_attention` — sequence/context parallelism via ppermute ring —
   the long-context capability the reference lacks.
 - :mod:`pipeline` — GPipe microbatch pipeline parallelism over the ``pipe``
@@ -18,9 +25,21 @@ from analytics_zoo_tpu.parallel.multihost import (  # noqa: F401
     init_distributed,
 )
 from analytics_zoo_tpu.parallel.partition import (  # noqa: F401
+    leaf_path_name,
     match_partition_rules,
     shard_params,
     tree_shardings,
+)
+from analytics_zoo_tpu.parallel.plan import (  # noqa: F401
+    ShardingPlan,
+    build_mesh,
+    compile_step,
+    data_parallel,
+    fsdp,
+    per_chip_bytes,
+    resolve_plan,
+    tensor_parallel,
+    zero1,
 )
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     gpipe,

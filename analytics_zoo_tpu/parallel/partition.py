@@ -16,10 +16,15 @@ parameter's tree path:
 
 Scalars and size-1 leaves are never partitioned (a spec would be wasted on
 them and some optimizers carry scalar state).
+
+These primitives are consumed by :mod:`analytics_zoo_tpu.parallel.plan`
+(the unified partitioner): a :class:`~analytics_zoo_tpu.parallel.plan.
+ShardingPlan` is an ordered rule table plus the compile contract around it.
 """
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Sequence, Tuple
 
@@ -27,43 +32,87 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+logger = logging.getLogger("analytics_zoo_tpu")
+
 
 def leaf_path_name(path) -> str:
-    """Render a jax tree path as a '/'-joined name (dict keys, sequence
-    indices, dataclass field names)."""
+    """Render a jax tree path as a '/'-joined name.
+
+    The rendering is the STABLE rule-matching contract (regexes in
+    partition rules match against it), so every key type is rendered
+    explicitly rather than through its jax ``repr`` (which has moved
+    across jax versions):
+
+    - ``DictKey(k)``   → ``str(k)`` (mapping keys)
+    - ``SequenceKey(i)`` → ``str(i)`` (list/tuple positions)
+    - ``GetAttrKey(n)`` → ``str(n)`` (dataclass / namedtuple fields)
+    - ``FlattenedIndexKey(i)`` → ``str(i)`` (leaves of opaque custom
+      nodes, e.g. some optax states flatten positionally)
+
+    Nested containers join with '/': ``{"a": [{"w": ...}]}`` renders its
+    leaf as ``a/0/w``; tests/test_partition_rules.py pins the rendering
+    for dict/list/tuple/dataclass/flattened trees.
+    """
     parts = []
+    tu = jax.tree_util
     for k in path:
-        if isinstance(k, jax.tree_util.DictKey):
+        if isinstance(k, tu.DictKey):
             parts.append(str(k.key))
-        elif isinstance(k, jax.tree_util.SequenceKey):
+        elif isinstance(k, tu.SequenceKey):
             parts.append(str(k.idx))
-        elif isinstance(k, jax.tree_util.GetAttrKey):
+        elif isinstance(k, tu.GetAttrKey):
             parts.append(str(k.name))
-        else:  # FlattenedIndexKey and anything else
-            parts.append(str(getattr(k, "key", k)))
+        elif isinstance(k, getattr(tu, "FlattenedIndexKey", ())):
+            parts.append(str(k.key))
+        else:  # future key types: fall back to their payload, not repr
+            parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
     return "/".join(parts)
 
 
 def match_partition_rules(
-    rules: Sequence[Tuple[str, P]], params
+    rules: Sequence[Tuple[str, P]], params, *, report_unused: bool = False
 ):
     """PartitionSpec pytree for ``params``: first rule whose regex
     ``re.search``-matches the leaf's '/'-joined path wins.
 
     Raises ValueError naming the unmatched parameter if no rule matches —
     add a catch-all ``(r".*", P())`` as the last rule to default-replicate.
+
+    ``report_unused=True`` returns ``(specs, unused)`` where ``unused``
+    is the list of rule patterns that matched ZERO leaves — a typo'd
+    regex otherwise silently falls through to the catch-all and
+    replicates (or mis-shards) the whole model; unused rules are also
+    logged at WARNING.  Deliberate ``.*`` catch-alls are exempt (a
+    catch-all that everything outranked cannot be a typo), and the
+    audit runs only when asked — spec resolution happens several times
+    per fit (placement, constraints, the checkpoint record), and a
+    legitimately rule-free tree (an all-scalar optimizer state) must
+    not cry wolf on each one.  The estimator audits its plan's param
+    rules once per fit.
     """
+    rules = list(rules)
+    hit_counts = [0] * len(rules)
 
     def spec_for(path, leaf):
         name = leaf_path_name(path)
         if np.ndim(leaf) == 0 or np.size(leaf) == 1:
             return P()
-        for pattern, spec in rules:
+        for i, (pattern, spec) in enumerate(rules):
             if re.search(pattern, name):
+                hit_counts[i] += 1
                 return spec
         raise ValueError(f"no partition rule matches parameter {name!r}")
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    specs = jax.tree_util.tree_map_with_path(spec_for, params)
+    if not report_unused:
+        return specs
+    unused = [pattern for (pattern, _), n in zip(rules, hit_counts)
+              if n == 0 and pattern not in (r".*", ".*")]
+    if unused:
+        logger.warning(
+            "partition rules matched zero leaves (typo'd regex?): %s",
+            unused)
+    return specs, unused
 
 
 def tree_shardings(mesh, specs):

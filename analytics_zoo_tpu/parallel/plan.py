@@ -1,0 +1,537 @@
+# zoolint: disable-file=raw-jit -- this module IS the compile choke point: the jax.jit here is the one every plan routes through (timed_compile telemetry, persistent cache, HLO lint)
+"""zooplan — the unified partitioner: sharding plans + ONE compile entry.
+
+Before this module, sharding decisions were scattered per strategy:
+``parallel/strategies.py`` hand-wrote shard_map specs, the zero1
+resharder re-laid optimizer state ad hoc, and the estimator's
+``ZOO_SHARD_OPTIMIZER`` path picked its own NamedShardings.  FSDP/TP
+were bespoke programs.  Here they are CONFIGURATIONS:
+
+- A :class:`ShardingPlan` carries ordered regex rules → ``PartitionSpec``
+  over the logical parameter / optimizer-state tree paths (T5X-style;
+  ``match_partition_rules`` in :mod:`.partition` does the matching) plus
+  the compile contract (jit + GSPMD constraints, or explicit shard_map).
+  Specs are CLAMPED per leaf to what the mesh can actually divide, so a
+  rule table written for one topology stays valid on another.
+- Canned plans: :func:`data_parallel` (replicate everything — today's
+  default), :func:`zero1` (optimizer state sharded over ``data``, the
+  ZeRO-1 memory win), :func:`fsdp` (params AND optimizer state sharded
+  over ``data`` — XLA all-gathers params on use and reduce-scatters
+  grads, the ZeRO-2/3 direction of arXiv:2004.13336), and
+  :func:`tensor_parallel` (user rules over the ``model`` axis).
+- :func:`build_mesh` — one mesh builder: a plain ``Mesh`` on a single
+  slice, a hybrid ICI×DCN mesh (DCN-crossing axis outermost, riding
+  :func:`~analytics_zoo_tpu.parallel.multihost.hybrid_mesh`) for
+  multi-pod; ``ZOO_DCN_AXIS`` names the crossing axis.
+- :func:`compile_step` — THE compile choke point.  Every strategy's
+  step function (plain DP, fsdp, zero1, TP, explicit shard_map) lowers
+  through :func:`~analytics_zoo_tpu.common.compile_cache.timed_compile`
+  here, so every compiled program shares the persistent compile cache,
+  AOT warmup, ``zoo_compile_seconds`` metering, and the HLO graph
+  lint / analytic cost features (``zoo_hlo_*``) — none of which the
+  explicit strategies saw before.
+
+Loss trajectories are placement-invariant: a plan changes WHERE bytes
+live and which collectives XLA inserts, never the math — the fsdp plan
+trains bit-identically to replicated DP (pinned by
+``tests/test_partitioner.py`` and ``bench.py --partition``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.common.engine import (
+    ALL_AXES,
+    DATA_AXIS,
+    MODEL_AXIS,
+    logger,
+)
+from analytics_zoo_tpu.parallel.partition import (
+    match_partition_rules,
+    tree_shardings,
+)
+
+__all__ = [
+    "ShardingPlan", "data_parallel", "fsdp", "zero1", "tensor_parallel",
+    "resolve_plan", "build_mesh", "compile_step", "PlannedStep",
+    "per_chip_bytes", "serialize_specs", "deserialize_specs",
+    "PLAN_NAMES",
+]
+
+#: names ``ZOO_SHARDING_PLAN`` / ``resolve_plan`` accept (tensor
+#: parallelism needs a rule table, so it is constructed in code, not
+#: named from the environment)
+PLAN_NAMES = ("dp", "data_parallel", "none", "fsdp", "zero1")
+
+_REPLICATE_ALL = ((r".*", P()),)
+
+
+def _freeze_rules(rules):
+    out = []
+    for pat, spec in rules:
+        if isinstance(spec, str):
+            # P(*"model") would silently splat into per-character axes
+            # ('m','o','d','e','l') that all clamp to replicate — the
+            # exact quiet failure the partitioner exists to prevent
+            raise TypeError(
+                f"rule {pat!r}: spec must be a PartitionSpec (or a "
+                f"tuple of axis entries), got the bare string {spec!r} "
+                f"— write P({spec!r}) to shard dim 0 over that axis")
+        out.append((str(pat), spec if isinstance(spec, P) else P(*spec)))
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """Ordered regex rules → PartitionSpec over logical tree paths, plus
+    the compile contract.
+
+    ``param_rules`` / ``opt_rules`` match against
+    :func:`~analytics_zoo_tpu.parallel.partition.leaf_path_name` paths
+    (``opt_rules=None`` reuses ``param_rules`` — optimizer moments
+    mirror the parameter paths under their state prefix, and
+    ``re.search`` matching makes the same regexes hit).  ``batch_axes``
+    is the mesh axes the leading (batch) dimension shards over.
+    ``mode`` picks the compile formulation in :func:`compile_step`:
+    ``"jit"`` (GSPMD — XLA inserts collectives from the shardings) or
+    ``"shard_map"`` (explicit per-shard program with hand-written
+    collectives; requires ``in_specs``/``out_specs`` at compile time).
+    """
+
+    name: str
+    param_rules: tuple = _REPLICATE_ALL
+    opt_rules: tuple | None = None
+    batch_axes: tuple = (DATA_AXIS,)
+    mode: str = "jit"
+    description: str = ""
+
+    def __post_init__(self):
+        if self.mode not in ("jit", "shard_map"):
+            raise ValueError(
+                f"plan mode must be 'jit' or 'shard_map', got {self.mode!r}")
+        object.__setattr__(self, "param_rules",
+                           _freeze_rules(self.param_rules))
+        if self.opt_rules is not None:
+            object.__setattr__(self, "opt_rules",
+                               _freeze_rules(self.opt_rules))
+        object.__setattr__(self, "batch_axes", tuple(self.batch_axes))
+
+    # -- identity ------------------------------------------------------
+    def cache_key(self) -> tuple:
+        """Hashable identity for compiled-step caches: two plans with
+        the same rules compile the same program."""
+        return (self.name, self.param_rules, self.opt_rules,
+                self.batch_axes, self.mode)
+
+    @property
+    def effective_opt_rules(self) -> tuple:
+        return self.opt_rules if self.opt_rules is not None \
+            else self.param_rules
+
+    def _is_replicated(self, rules) -> bool:
+        return all(spec == P() for _, spec in rules)
+
+    @property
+    def shards_params(self) -> bool:
+        return not self._is_replicated(self.param_rules)
+
+    @property
+    def shards_opt(self) -> bool:
+        return not self._is_replicated(self.effective_opt_rules)
+
+    # -- spec resolution ----------------------------------------------
+    def param_specs(self, params, mesh, *, report_unused: bool = False):
+        """Clamped PartitionSpec tree for ``params`` on ``mesh``."""
+        return self._specs(self.param_rules, params, mesh,
+                           report_unused=report_unused)
+
+    def opt_specs(self, opt_state, mesh):
+        """Clamped PartitionSpec tree for an optimizer state on
+        ``mesh`` (scalar step counts replicate via the scalar rule in
+        ``match_partition_rules``)."""
+        return self._specs(self.effective_opt_rules, opt_state, mesh)
+
+    def _specs(self, rules, tree, mesh, *, report_unused: bool = False):
+        out = match_partition_rules(rules, tree,
+                                    report_unused=report_unused)
+        specs, unused = out if report_unused else (out, None)
+        clamped = jax.tree_util.tree_map(
+            lambda leaf, spec: _clamp_spec(spec, np.shape(leaf), mesh),
+            tree, specs)
+        return (clamped, unused) if report_unused else clamped
+
+    def batch_spec(self, ndim: int, stacked: bool = False) -> P:
+        """Spec for one batch leaf: batch dim over ``batch_axes``.
+
+        ``stacked=True`` is the fused-dispatch [K, batch, ...] layout —
+        axis 0 is the inner-step index (replicated), axis 1 the batch.
+        """
+        entry = self.batch_axes[0] if len(self.batch_axes) == 1 \
+            else tuple(self.batch_axes)
+        min_ndim = 2 if stacked else 1
+        if ndim < min_ndim:
+            return P()
+        lead = (None, entry) if stacked else (entry,)
+        return P(*lead, *([None] * (ndim - len(lead))))
+
+    # -- placement -----------------------------------------------------
+    def param_shardings(self, params, mesh):
+        return tree_shardings(mesh, self.param_specs(params, mesh))
+
+    def opt_shardings(self, opt_state, mesh):
+        return tree_shardings(mesh, self.opt_specs(opt_state, mesh))
+
+    def place_params(self, params, mesh):
+        """device_put ``params`` into this plan's layout."""
+        return jax.device_put(params, self.param_shardings(params, mesh))
+
+    def place_opt_state(self, opt_state, mesh):
+        """device_put an optimizer state into this plan's layout — the
+        ONE resharding path elastic resume uses: a checkpoint stores
+        global logical arrays, so restoring onto any mesh size is this
+        device_put (no layout surgery; contrast
+        :func:`~analytics_zoo_tpu.parallel.strategies.
+        reshard_zero1_opt_state`, which the explicit padded-flat-vector
+        layout still needs)."""
+        return jax.device_put(opt_state,
+                              self.opt_shardings(opt_state, mesh))
+
+    # -- in-graph constraints -----------------------------------------
+    def constrain_params(self, params, mesh):
+        """``with_sharding_constraint`` the updated params to the plan
+        layout (inside the jitted step) — pins the OUTPUT layout so
+        donation reuses the plan's buffers, XLA cannot 'helpfully'
+        replicate an fsdp plan's weights, AND a partially-sharded plan
+        cannot leak its sharding into replicated outputs (zero1's
+        sharded moments would otherwise propagate onto the updated
+        params, silently changing the step's signature).  A fully
+        replicated plan (dp) constrains nothing."""
+        if not (self.shards_params or self.shards_opt):
+            return params
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, params,
+            self.param_shardings(params, mesh))
+
+    def constrain_opt(self, opt_state, mesh):
+        if not (self.shards_params or self.shards_opt):
+            return opt_state
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, opt_state,
+            self.opt_shardings(opt_state, mesh))
+
+
+def _clamp_spec(spec: P, shape: tuple, mesh) -> P:
+    """Clamp a rule's spec to what ``mesh`` can divide on this leaf:
+    axes missing from the mesh drop to None, a dim the axis product does
+    not divide evenly drops to None, entries beyond the leaf's rank are
+    truncated.  A rule table written for ``{data: 8, model: 4}`` then
+    stays valid on ``{data: 2}`` — undividable dims just replicate."""
+    if spec == P():
+        return spec
+    entries = list(spec)[: len(shape)]
+    out = []
+    for dim, entry in zip(shape, entries):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        sizes = [dict(mesh.shape).get(a) for a in axes]
+        if any(s is None for s in sizes):
+            out.append(None)
+            continue
+        total = math.prod(sizes)
+        if total <= 1 or dim % total != 0:
+            out.append(None)
+            continue
+        out.append(tuple(axes) if len(axes) > 1 else axes[0])
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+# ---------------------------------------------------------------------------
+# Canned plans — FSDP/TP/ZeRO as rule sets instead of bespoke programs.
+# ---------------------------------------------------------------------------
+
+
+def data_parallel() -> ShardingPlan:
+    """Replicated parameters + optimizer state, batch over ``data`` —
+    the historical default, now spelled as a plan."""
+    return ShardingPlan(
+        name="dp",
+        description="replicated params/opt state, batch over data")
+
+
+def zero1(axis: str = DATA_AXIS) -> ShardingPlan:
+    """Params replicated, optimizer state sharded over ``axis``
+    (ZeRO-1: 1/n moment memory + update compute per chip).  Subsumes the
+    old ``ZOO_SHARD_OPTIMIZER`` GSPMD path."""
+    return ShardingPlan(
+        name="zero1",
+        param_rules=_REPLICATE_ALL,
+        opt_rules=((r".*", P(axis)),),
+        description=f"replicated params, opt state sharded over {axis}")
+
+
+def fsdp(axis: str = DATA_AXIS) -> ShardingPlan:
+    """Params AND optimizer state sharded over ``axis``: XLA all-gathers
+    weights where the forward uses them and reduce-scatters gradients
+    into each chip's shard — per-chip param+opt bytes drop ~1/n at an
+    unchanged (bit-identical) loss trajectory.  The whole-weight-update
+    sharding of arXiv:2004.13336 as a two-line rule set."""
+    rules = ((r".*", P(axis)),)
+    return ShardingPlan(
+        name="fsdp", param_rules=rules, opt_rules=rules,
+        description=f"params + opt state sharded over {axis} "
+                    "(gather-on-use / reduce-scatter)")
+
+
+def tensor_parallel(rules, axis: str = MODEL_AXIS,
+                    name: str = "tp") -> ShardingPlan:
+    """Megatron-style TP from a user rule table over the ``model`` axis
+    (e.g. ``[("kernel", P(None, "model"))]``); anything unmatched
+    replicates via an appended catch-all."""
+    rules = _freeze_rules(rules)
+    if not any(pat in (r".*", ".*") for pat, _ in rules):
+        rules = rules + _REPLICATE_ALL
+    return ShardingPlan(
+        name=name, param_rules=rules,
+        description=f"tensor parallel over {axis} by rule table")
+
+
+def resolve_plan(value=None, config=None) -> ShardingPlan:
+    """Resolve a plan argument: a :class:`ShardingPlan` passes through,
+    a name string maps to its canned plan, ``None`` falls back to
+    ``ZOO_SHARDING_PLAN`` (``config.sharding_plan``), then the legacy
+    ``ZOO_SHARD_OPTIMIZER`` flag (→ :func:`zero1`), then
+    :func:`data_parallel`."""
+    if isinstance(value, ShardingPlan):
+        return value
+    if value is None and config is not None:
+        value = getattr(config, "sharding_plan", None)
+        if value is None and getattr(config, "shard_optimizer", False):
+            return zero1()
+    if value is None:
+        return data_parallel()
+    name = str(value).strip().lower()
+    if name in ("dp", "data_parallel", "none", ""):
+        return data_parallel()
+    if name == "fsdp":
+        return fsdp()
+    if name == "zero1":
+        return zero1()
+    raise ValueError(
+        f"unknown sharding plan {value!r}; valid names: "
+        f"{', '.join(PLAN_NAMES)} (tensor_parallel(...) takes a rule "
+        "table, so it is built in code, not named)")
+
+
+# ---------------------------------------------------------------------------
+# Mesh builder — plain single-slice, or hybrid ICI×DCN for multi-pod.
+# ---------------------------------------------------------------------------
+
+
+def build_mesh(mesh_shape: Mapping[str, int] | None = None,
+               dcn_shape: Mapping[str, int] | int | None = None,
+               axes: Sequence[str] | None = None,
+               devices=None, slice_groups=None, allow_idle: bool = False,
+               dcn_axis: str | None = None) -> Mesh:
+    """One mesh builder for every plan.
+
+    Single slice (``dcn_shape`` unset): today's ``Mesh`` — missing axes
+    get size 1, leftover devices fold into ``data``.  Multi-pod: the
+    DCN-crossing axis goes OUTERMOST and the per-slice (ICI) extents
+    come from ``mesh_shape``, via
+    :func:`~analytics_zoo_tpu.parallel.multihost.hybrid_mesh` (the
+    ``create_hybrid_device_mesh`` layout: inner-axis collectives ride
+    ICI, only the outer axis crosses the data-center network).
+
+    ``dcn_shape`` may be a mapping (``{"data": 2}``) or a bare slice
+    count — then the crossing axis is ``dcn_axis`` > ``ZOO_DCN_AXIS`` >
+    ``"data"``; an axis name not already in ``axes`` (e.g. ``"dcn"``)
+    is prepended as a NEW outermost axis, so a plan can shard the batch
+    over ``("dcn", "data")`` while keeping model axes ICI-only.
+    """
+    if dcn_shape is None:
+        from analytics_zoo_tpu.common.engine import _infer_mesh_shape
+
+        devices = list(jax.devices()) if devices is None else list(devices)
+        axes = tuple(axes) if axes is not None else tuple(
+            a for a in ALL_AXES if a in (mesh_shape or {})) or (DATA_AXIS,)
+        shape = _infer_mesh_shape(devices, axes, mesh_shape)
+        n_used = math.prod(shape.values())
+        dev = np.asarray(devices[:n_used]).reshape(
+            [shape[a] for a in axes])
+        return Mesh(dev, axes)
+
+    from analytics_zoo_tpu.parallel.multihost import hybrid_mesh
+
+    ici = dict(mesh_shape or {})
+    if isinstance(dcn_shape, int):
+        axis = dcn_axis or os.environ.get("ZOO_DCN_AXIS") or DATA_AXIS
+        dcn_shape = {axis: int(dcn_shape)}
+    else:
+        dcn_shape = dict(dcn_shape)
+    if axes is None:
+        named = [a for a in ALL_AXES if a in ici or a in dcn_shape]
+        extra = [a for a in dcn_shape if a not in named]
+        axes = tuple(extra + named)
+    else:
+        axes = tuple(axes)
+        missing = [a for a in dcn_shape if a not in axes]
+        axes = tuple(missing) + axes
+    return hybrid_mesh(ici, dcn_shape, axes=axes, devices=devices,
+                       slice_groups=slice_groups, allow_idle=allow_idle)
+
+
+# ---------------------------------------------------------------------------
+# compile_step — THE choke point.
+# ---------------------------------------------------------------------------
+
+
+class PlannedStep:
+    """A step function compiled through the choke point.
+
+    Call it like the function it wraps: the first call per input
+    signature lowers and compiles through
+    :func:`~analytics_zoo_tpu.common.compile_cache.timed_compile`
+    (persistent-cache hit/miss counters, ``zoo_compile_seconds``, the
+    HLO graph lint + ``zoo_hlo_*`` cost features), caches the
+    executable, and later calls dispatch it directly — so the in-loop
+    cost is one pytree signature probe + the XLA execute.  Signatures
+    key on tree structure, leaf shape/dtype/weak-type AND sharding (a
+    resharded input is a different program; python scalars key on
+    their type).  The probe is a Python-level tree_flatten per call —
+    microseconds against a training dispatch, and the fused scan-K
+    path (ZOO_STEPS_PER_DISPATCH) amortizes it K-fold; the dispatch
+    quick-tier bench guards pin that the trade holds.
+    """
+
+    _MAX_EXES = 32  # tail-batch shape churn bound; oldest evicted
+
+    def __init__(self, jitted, label: str, plan: ShardingPlan):
+        self._jitted = jitted
+        self.label = label
+        self.plan = plan
+        self._exes: dict = {}
+
+    def _sig(self, args) -> tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                sig.append((leaf.shape, leaf.dtype,
+                            getattr(leaf, "weak_type", False),
+                            leaf.sharding))
+            elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                sig.append((tuple(leaf.shape), leaf.dtype, False, None))
+            else:
+                # python scalars: the TYPE is the signature — an int and
+                # a float at the same position are different programs
+                # (int32 vs f32 weak avals), and the AOT executable
+                # rejects a mismatched aval instead of recompiling
+                sig.append(type(leaf))
+        return treedef, tuple(sig)
+
+    def lower(self, *args):
+        """The underlying ``jit(...).lower`` — for callers that need the
+        lowered module (HLO inspection); normal use just calls the
+        step."""
+        return self._jitted.lower(*args)
+
+    def __call__(self, *args):
+        from analytics_zoo_tpu.common.compile_cache import timed_compile
+
+        key = self._sig(args)
+        exe = self._exes.get(key)
+        if exe is None:
+            exe = timed_compile(self._jitted.lower(*args), self.label)
+            while len(self._exes) >= self._MAX_EXES:
+                self._exes.pop(next(iter(self._exes)))
+            self._exes[key] = exe
+        return exe(*args)
+
+
+def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
+                 donate_argnums=(), label: str | None = None,
+                 in_specs=None, out_specs=None, check_vma: bool = False
+                 ) -> PlannedStep:
+    """Compile a step function under a plan — the ONE entry every
+    strategy uses (SNIPPETS [2] Titanax shape).
+
+    ``mode="jit"`` plans run GSPMD: the caller device_puts inputs into
+    the plan layout (:meth:`ShardingPlan.place_params` /
+    ``place_opt_state``) and constrains outputs in-graph
+    (:meth:`ShardingPlan.constrain_params`); XLA inserts the
+    collectives.  ``mode="shard_map"`` plans wrap ``step_fn`` in
+    ``jax.shard_map`` with the given ``in_specs``/``out_specs`` — the
+    explicit-collectives formulation the legacy strategies use.  Either
+    way the result lowers through ``timed_compile``: persistent cache,
+    AOT warmup, compile metering and the HLO lint/feature pipe apply to
+    EVERY plan.
+
+    ``label`` names the program in ``zoo_compile_seconds{label=}`` /
+    ``zoo_hlo_*{label=}`` (default ``<plan.name>_step``).
+    """
+    plan = resolve_plan(plan)
+    if plan.mode == "shard_map" or in_specs is not None:
+        if in_specs is None or out_specs is None:
+            raise ValueError(
+                "shard_map-mode plans need explicit in_specs/out_specs")
+        if mesh is None:
+            from analytics_zoo_tpu.common.engine import get_zoo_context
+
+            mesh = get_zoo_context().mesh
+        step_fn = jax.shard_map(step_fn, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=check_vma)
+    jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+    return PlannedStep(jitted, label or f"{plan.name}_step", plan)
+
+
+# ---------------------------------------------------------------------------
+# Introspection + checkpoint serialization helpers.
+# ---------------------------------------------------------------------------
+
+
+def per_chip_bytes(tree, device=None) -> int:
+    """Bytes of ``tree`` resident on ONE device (default: the first
+    device of the first leaf's sharding) — the quantity an fsdp/zero1
+    plan shrinks.  Replicated leaves count full size; sharded leaves
+    count one shard."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        shards = leaf.addressable_shards
+        if not shards:
+            continue
+        if device is None:
+            device = shards[0].device
+        total += sum(s.data.nbytes for s in shards if s.device == device)
+    return total
+
+
+def serialize_specs(spec_tree) -> list:
+    """PartitionSpec tree → plain-builtin leaves list (tree_leaves
+    order) for checkpoint payloads: each spec becomes a list whose
+    entries are None / axis name / list of axis names — survives
+    ``safe_load`` without any custom-type allowlisting."""
+    flat = jax.tree_util.tree_leaves(
+        spec_tree, is_leaf=lambda s: isinstance(s, P))
+    return [[list(e) if isinstance(e, (tuple, list)) else e
+             for e in spec] for spec in flat]
+
+
+def deserialize_specs(serialized: list) -> list:
+    """Inverse of :func:`serialize_specs` (a flat list of
+    PartitionSpecs, paired by position with the tree's leaves)."""
+    return [P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+            for entries in serialized]

@@ -33,7 +33,16 @@ def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
     """A train step as shard_map with explicit pmean — the literal
     TPU translation of the reference's two Spark jobs (local
     forward/backward, then gradient slice aggregation) into one SPMD
-    program with a single collective."""
+    program with a single collective.
+
+    Now a thin wrapper over the unified partitioner: the per-shard body
+    is unchanged, but it compiles through
+    :func:`~analytics_zoo_tpu.parallel.plan.compile_step` (a
+    ``mode="shard_map"`` plan), so the explicit strategy shares the
+    persistent compile cache, ``zoo_compile_seconds`` and the HLO
+    lint/feature pipe with every jit plan.
+    """
+    from analytics_zoo_tpu.parallel.plan import ShardingPlan, compile_step
     from analytics_zoo_tpu.pipeline.estimator.estimator import (
         _clip_grads,
         _normalize_grad_clip,
@@ -71,13 +80,13 @@ def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
 
     repl = P()
     batch_spec = P(DATA_AXIS)
-    step = jax.shard_map(
-        local_step, mesh=mesh,
+    plan = ShardingPlan(name="shard_map_dp", mode="shard_map",
+                        description="explicit-psum data parallelism")
+    return compile_step(
+        local_step, plan, mesh,
         in_specs=(repl, repl, repl, repl, batch_spec),
         out_specs=(repl, repl, repl, repl),
-        check_vma=False,
-    )
-    return jax.jit(step, donate_argnums=(0, 1, 2))
+        donate_argnums=(0, 1, 2), label="shard_map_step")
 
 
 def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
@@ -95,9 +104,17 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     per-shard pytree, so it must be created by ``init_opt_state(params)``
     (and checkpointed as-is — it is a different layout from the plain
     step's).
+
+    Like :func:`make_shard_map_train_step`, this is now a thin wrapper
+    over the partitioner's choke point: both the step AND
+    ``init_opt_state`` compile through
+    :func:`~analytics_zoo_tpu.parallel.plan.compile_step`.  (The GSPMD
+    spelling of the same idea — and of full FSDP — is
+    ``plan.zero1()`` / ``plan.fsdp()`` through the estimator.)
     """
     from jax.flatten_util import ravel_pytree
 
+    from analytics_zoo_tpu.parallel.plan import ShardingPlan, compile_step
     from analytics_zoo_tpu.pipeline.estimator.estimator import (
         _normalize_grad_clip,
     )
@@ -129,10 +146,15 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         lambda leaf: P(DATA_AXIS) if getattr(leaf, "ndim", 0) >= 1
         else repl, proto)
 
+    plan = ShardingPlan(name="zero1_explicit", mode="shard_map",
+                        description="explicit reduce-scatter/all-gather "
+                                    "ZeRO-1 on the padded flat vector")
+
     def init_opt_state(params):
-        fn = jax.shard_map(_local_init, mesh=mesh, in_specs=(repl,),
-                           out_specs=opt_specs, check_vma=False)
-        return jax.jit(fn)(params)
+        fn = compile_step(_local_init, plan, mesh, in_specs=(repl,),
+                          out_specs=opt_specs,
+                          label="zero1_init_opt_state")
+        return fn(params)
 
     def local_step(params, opt_state, state, rng, batch):
         def loss_of(p):
@@ -175,13 +197,12 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         return unravel(full), opt_state, new_state, l
 
     batch_spec = P(DATA_AXIS)
-    step = jax.shard_map(
-        local_step, mesh=mesh,
+    step = compile_step(
+        local_step, plan, mesh,
         in_specs=(repl, opt_specs, repl, repl, batch_spec),
         out_specs=(repl, opt_specs, repl, repl),
-        check_vma=False,
-    )
-    return jax.jit(step, donate_argnums=(0, 1, 2)), init_opt_state
+        donate_argnums=(0, 1, 2), label="zero1_step")
+    return step, init_opt_state
 
 
 def reshard_zero1_opt_state(opt_state, params, mesh=None,

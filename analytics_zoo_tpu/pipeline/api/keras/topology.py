@@ -152,7 +152,7 @@ class KerasNet(_ContainerBase):
 
     def fit(self, x, y=None, batch_size=32, nb_epoch=10,
             validation_data=None, distributed=True, sample_weight=None,
-            autotune=None):
+            autotune=None, plan=None):
         """Train (reference ``fit`` Topology.scala:418-431 →
         InternalDistriOptimizer.train Topology.scala:1076-1259).
 
@@ -160,7 +160,13 @@ class KerasNet(_ContainerBase):
         closed-loop tuner: prefetch workers/depth/read-ahead and the
         fused-dispatch K are tuned online from telemetry, with a
         bit-identical loss trajectory (see docs/data-pipeline.md
-        "Autotuning")."""
+        "Autotuning").
+
+        ``plan``: sharding plan for params/optimizer state/batch — a
+        :class:`~analytics_zoo_tpu.parallel.plan.ShardingPlan` or a
+        canned name ("dp"/"zero1"/"fsdp"); ``None`` defers to
+        ``ZOO_SHARDING_PLAN``.  Loss trajectory is placement-invariant
+        (see docs/parallelism.md)."""
         from analytics_zoo_tpu.feature.dataset import FeatureSet
 
         train_set = FeatureSet.of(x, y, sample_weight=sample_weight)
@@ -170,7 +176,7 @@ class KerasNet(_ContainerBase):
             self._estimator = self._make_estimator()
         self._estimator.train(
             train_set, batch_size=batch_size, nb_epoch=nb_epoch,
-            validation_set=val_set, autotune=autotune,
+            validation_set=val_set, autotune=autotune, plan=plan,
         )
         self._sync_nested()
         return self
@@ -226,7 +232,13 @@ class KerasNet(_ContainerBase):
                     state=s, training=False)
                 return cast_floats(out, jnp.float32)
 
-            cached = (ctx.compute_dtype, jax.jit(_fwd))
+            # through the unified partitioner's choke point: predict
+            # programs share the persistent compile cache / metering /
+            # HLO features with training (parallel/plan.py)
+            from analytics_zoo_tpu.parallel.plan import compile_step
+
+            cached = (ctx.compute_dtype,
+                      compile_step(_fwd, label="predict_step"))
             self._predict_fn = cached
         fwd = cached[1]
         outs = []

@@ -47,7 +47,6 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.engine import (
-    DATA_AXIS,
     ZooContext,
     cast_floats,
     get_zoo_context,
@@ -254,6 +253,26 @@ class _DeviceFeeder:
         self._stop.set()
 
 
+def _gather_for_save(tree):
+    """Multi-host: replicate plan-sharded device leaves SPMD — every
+    process participates — so the single writer's host conversion can
+    read the full value (``np.asarray`` on a non-fully-addressable
+    ``jax.Array`` raises).  Fully-addressable leaves (every single-host
+    array, replicated multi-host state) pass through untouched, so the
+    pre-partitioner save path is byte-for-byte unchanged."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def fix(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
+                and isinstance(leaf.sharding, NamedSharding):
+            repl = NamedSharding(leaf.sharding.mesh, PartitionSpec())
+            # zoolint: disable=raw-jit -- SPMD replicate-identity (one trivial all-gather per leaf shape, deduped by jit's own cache); not a model program the compile plane should meter
+            return jax.jit(lambda a: a, out_shardings=repl)(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
 @dataclasses.dataclass
 class _Checkpointer:
     """Snapshot (params, opt_state, model state, step/epoch, iterator pos).
@@ -289,13 +308,18 @@ class _Checkpointer:
 
     def save(self, tag: str, payload: dict) -> str:
         fname = os.path.join(self.path, f"ckpt-{tag}.pkl")
-        # Multi-host: exactly one writer.  Every process calls save() (the
-        # payload is replicated SPMD state), but only process 0 touches the
-        # shared checkpoint dir — concurrent writers racing os.replace on
-        # shared storage would interleave half-written snapshots.
+        # Multi-host: exactly one writer.  Every process calls save(),
+        # but only process 0 touches the shared checkpoint dir —
+        # concurrent writers racing os.replace on shared storage would
+        # interleave half-written snapshots.  Plan-sharded leaves
+        # (fsdp/zero1) are replicated SPMD FIRST — all processes
+        # participate in that collective, THEN non-writers return —
+        # so the writer's host gather sees every shard.
         shard = _process_shard()
-        if shard is not None and shard[0] != 0:
-            return fname
+        if shard is not None:
+            payload = _gather_for_save(payload)
+            if shard[0] != 0:
+                return fname
         self._wait()
         os.makedirs(self.path, exist_ok=True)
         # Device-side copies: cheap dispatches; the live arrays stay free
@@ -306,7 +330,12 @@ class _Checkpointer:
 
         def write():
             try:
-                host = jax.tree_util.tree_map(np.asarray, snap)
+                # device arrays → host; python scalars/strings (step
+                # counters, the plan's spec record) stay as-is
+                host = jax.tree_util.tree_map(
+                    lambda a: a if isinstance(a, (str, bytes, bool, int,
+                                                  float)) else np.asarray(a),
+                    snap)
                 host["__ckpt_meta__"] = {
                     "format_version": self.FORMAT_VERSION,
                     "saved_unix": time.time(),
@@ -387,8 +416,13 @@ class Estimator:
     def __init__(self, model, optimizer=None, loss=None, metrics=None,
                  model_dir: str | None = None, grad_clip=None,
                  tensorboard=None, checkpoint=None,
-                 ctx: ZooContext | None = None):
+                 ctx: ZooContext | None = None, plan=None):
         self.model = model
+        # Unified partitioner (parallel/plan.py): a ShardingPlan or a
+        # canned-plan name; None defers to ZOO_SHARDING_PLAN / the
+        # legacy ZOO_SHARD_OPTIMIZER flag, then plain data parallelism.
+        # train(plan=) overrides per fit.
+        self.plan = plan
         self.optimizer = optimizer
         self.loss = loss
         self.metrics = list(metrics or [])
@@ -431,50 +465,54 @@ class Estimator:
         self.last_probe_warmup_seconds: float | None = None
 
     # ------------------------------------------------------------------
-    # ZeRO-1 optimizer-state sharding (ZOO_SHARD_OPTIMIZER)
+    # sharding plan (parallel/plan.py — ZOO_SHARDING_PLAN; the old
+    # ZOO_SHARD_OPTIMIZER ZeRO-1 path is now the zero1() plan)
     # ------------------------------------------------------------------
-    def _shard_optimizer_on(self) -> bool:
-        return bool(self.ctx.config.shard_optimizer) \
-            and self.ctx.data_parallel_size > 1
+    def _resolved_plan(self, override=None):
+        """The effective ShardingPlan: explicit train(plan=) override >
+        estimator plan > ZOO_SHARDING_PLAN > legacy ZOO_SHARD_OPTIMIZER
+        (zero1) > data_parallel."""
+        from analytics_zoo_tpu.parallel.plan import resolve_plan
 
-    def _opt_sharding_of(self, leaf):
-        """Per-leaf placement: shard dim 0 over the data axis when it
-        divides evenly (Adam moments mirror param shapes), else
-        replicate (scalar step counts, ragged leaves)."""
-        from jax.sharding import NamedSharding, PartitionSpec
+        return resolve_plan(
+            override if override is not None else self.plan,
+            self.ctx.config)
 
-        dp = self.ctx.data_parallel_size
-        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
-                and leaf.shape[0] > 0 and leaf.shape[0] % dp == 0:
-            return NamedSharding(self.ctx.mesh, PartitionSpec(DATA_AXIS))
-        return self.ctx.replicated()
+    def _place_opt_state(self, opt_state, plan=None):
+        """Optimizer-state placement through the partitioner — the one
+        resharding path (a checkpoint's global logical arrays land in
+        the CURRENT plan/mesh layout by this device_put, whatever shape
+        they were saved under)."""
+        plan = plan if plan is not None else self._resolved_plan()
+        return plan.place_opt_state(opt_state, self.ctx.mesh)
 
-    def _place_opt_state(self, opt_state):
-        if not self._shard_optimizer_on():
-            return jax.device_put(opt_state, self.ctx.replicated())
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, self._opt_sharding_of(leaf)),
-            opt_state)
+    def _place_params(self, params, plan=None):
+        plan = plan if plan is not None else self._resolved_plan()
+        return plan.place_params(params, self.ctx.mesh)
 
     # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
     def _train_step_for(self, device_transform=None,
-                        steps_per_dispatch: int = 1):
-        """The (cached) jitted train step for this transform/K pair.
+                        steps_per_dispatch: int = 1, plan=None):
+        """The (cached) compiled train step for this transform/K/plan
+        triple.
 
         Returning the SAME function object across calls is what makes
-        jax's dispatch cache effective: a fresh ``jax.jit`` closure per
-        call would retrace and recompile an identical program.  Bounded:
+        the compiled-step cache effective: a fresh closure per call
+        would retrace and recompile an identical program.  Bounded:
         callers that build a fresh transform closure per fit() would
         otherwise pin one compiled program per call forever — oldest
         entries are evicted past 8 (in-flight fns stay alive through the
         caller's local reference)."""
-        key = (device_transform, int(steps_per_dispatch))
+        plan = plan if plan is not None else self._resolved_plan()
+        key = (device_transform, int(steps_per_dispatch),
+               plan.cache_key())
         fn = self._train_step_fns.get(key)
         if fn is None:
             fn = self._build_train_step(device_transform,
-                                        steps_per_dispatch=key[1])
+                                        steps_per_dispatch=key[1],
+                                        plan=plan)
             while len(self._train_step_fns) >= 8:
                 old = next(iter(self._train_step_fns))
                 self._train_step_fns.pop(old)
@@ -489,18 +527,32 @@ class Estimator:
         return fn
 
     def _build_train_step(self, device_transform=None,
-                          steps_per_dispatch: int = 1):
-        """Build the jitted train step.
+                          steps_per_dispatch: int = 1, plan=None):
+        """Build the compiled train step — through ``compile_step``,
+        the unified partitioner's choke point (parallel/plan.py), so
+        every plan's program shares the persistent compile cache, AOT
+        warmup, ``zoo_compile_seconds`` and the HLO lint/feature pipe.
 
         ``steps_per_dispatch=1``: the classic single-step program.
         ``steps_per_dispatch=K>1``: the FUSED program — one donated-carry
-        jit whose body is ``jax.lax.scan`` over K inner steps of the
+        dispatch whose body is ``jax.lax.scan`` over K inner steps of the
         SAME per-step math (shared ``one_step`` closure), consuming a
         [K, batch, ...] super-batch.  Each inner step folds the RNG on
         the GLOBAL step index (``step0 + i``), so the loss trajectory is
         bit-identical to K single dispatches; only the Python→device
         round-trip count changes (1 instead of K).
+
+        The plan's sharding enters twice: inputs are device_put into the
+        plan layout by the caller, and the updated params/opt state are
+        re-constrained in-graph so donation reuses the sharded buffers
+        (an fsdp plan's weights must come back sharded, not
+        'helpfully' replicated by XLA).  The math is placement-invariant
+        — every plan trains bit-identically.
         """
+        from analytics_zoo_tpu.parallel.plan import compile_step
+
+        plan = plan if plan is not None else self._resolved_plan()
+        mesh = self.ctx.mesh
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
         compute_dtype = self.ctx.compute_dtype
@@ -515,9 +567,6 @@ class Estimator:
                     if k in frozen else v)
                 for k, v in tree.items()
             }
-
-        opt_shardings = (self._opt_sharding_of
-                         if self._shard_optimizer_on() else None)
 
         def one_step(params, opt_state, state, rng, batch):
             if device_transform is not None:
@@ -560,33 +609,37 @@ class Estimator:
                 grads = _mask_frozen(grads)
             grads = _clip_grads(grads, grad_clip)
             updates, opt_state = opt.update(grads, opt_state, params)
-            if opt_shardings is not None:
-                # ZeRO-1 via GSPMD: pinning the optimizer state's layout
-                # to the data axis makes XLA partition the moment updates
-                # (and reduce-scatter the grads feeding them) instead of
-                # computing the full update redundantly on every chip;
-                # params stay replicated (one all-gather of updates).
-                opt_state = jax.tree_util.tree_map(
-                    lambda leaf: jax.lax.with_sharding_constraint(
-                        leaf, opt_shardings(leaf)), opt_state)
+            # Plan layout, in-graph: pinning the optimizer state (zero1/
+            # fsdp) makes XLA partition the moment updates — and
+            # reduce-scatter the grads feeding them — instead of
+            # computing the full update redundantly on every chip;
+            # pinning the params (fsdp/tp) keeps the weights stored
+            # sharded (gather-on-use) so donation reuses the 1/n
+            # buffers.  data_parallel constrains nothing (no-ops).
+            opt_state = plan.constrain_opt(opt_state, mesh)
             if frozen:
                 updates = _mask_frozen(updates)
             params = optax.apply_updates(params, updates)
+            params = plan.constrain_params(params, mesh)
             return params, opt_state, new_state, l
 
+        # per-plan compile labels (dp keeps the historical bare names):
+        # zoo_compile_seconds / zoo_hlo_* tell an fsdp program from a dp
+        # one at a glance
+        tag = "" if plan.name == "dp" else f"_{plan.name}"
         if steps_per_dispatch <= 1:
-            @partial(jax.jit, donate_argnums=(0, 1, 2))
             def train_step(params, opt_state, state, seed, step, batch):
                 # RNG derived in-graph: no per-step host-side key
                 # splitting.
                 rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
                 return one_step(params, opt_state, state, rng, batch)
 
-            return train_step
+            return compile_step(train_step, plan, mesh,
+                                donate_argnums=(0, 1, 2),
+                                label=f"train_step{tag}")
 
         k = int(steps_per_dispatch)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step_scan(params, opt_state, state, seed, step0,
                             stacked):
             key = jax.random.PRNGKey(seed)
@@ -606,13 +659,16 @@ class Estimator:
                 (stacked, jnp.arange(k, dtype=jnp.int32)))
             return params, opt_state, state, losses
 
-        return train_step_scan
+        return compile_step(train_step_scan, plan, mesh,
+                            donate_argnums=(0, 1, 2),
+                            label=f"train_step_scan{k}{tag}")
 
     def _build_eval_step(self, device_transform=None):
+        from analytics_zoo_tpu.parallel.plan import compile_step
+
         model, loss_fn, metrics = self.model, self.loss, self.metrics
         compute_dtype = self.ctx.compute_dtype
 
-        @jax.jit
         def eval_step(params, state, batch):
             if device_transform is not None:
                 batch = device_transform(batch)
@@ -641,7 +697,10 @@ class Estimator:
                 stats.append(m.batch_stats(batch["y"], preds, mask=mask))
             return stats
 
-        return eval_step
+        # through the choke point too: eval programs get the same
+        # compile metering / persistent cache / HLO features as train
+        return compile_step(eval_step, self._resolved_plan(),
+                            self.ctx.mesh, label="eval_step")
 
     # ------------------------------------------------------------------
     # train (InternalDistriOptimizer.train, Topology.scala:1076-1259)
@@ -653,8 +712,20 @@ class Estimator:
               validation_set: FeatureSet | None = None,
               validation_trigger: ZooTrigger | None = None,
               seed: int | None = None,
-              autotune=None):
-        """``autotune``: ``True`` (or ``ZOO_AUTOTUNE=1`` via the config
+              autotune=None, plan=None):
+        """``plan``: a :class:`~analytics_zoo_tpu.parallel.plan.
+        ShardingPlan` (or canned-plan name — "dp"/"zero1"/"fsdp") laying
+        out params, optimizer state and the batch for this fit; ``None``
+        defers to the estimator's plan, then ``ZOO_SHARDING_PLAN`` /
+        the legacy ``ZOO_SHARD_OPTIMIZER``, then data parallelism.  A
+        plan changes where bytes live (fsdp: ~1/n param+opt bytes per
+        chip) and which collectives XLA inserts, never the math: fsdp
+        trains BIT-identically to dp; zero1's differently-grouped
+        gradient reduction matches to float tolerance (ulp-level —
+        BENCH_PARTITION_r10.json records the max |Δ|).  See
+        docs/parallelism.md.
+
+        ``autotune``: ``True`` (or ``ZOO_AUTOTUNE=1`` via the config
         tier, which ``None`` defers to) turns on the closed-loop tuner
         (feature/autotune.py): the train set is wrapped in the prefetch
         plane (starting from the configured knobs, or worst-case
@@ -736,6 +807,10 @@ class Estimator:
                     workers=ctx.config.prefetch_workers or 1,
                     controller=controller)
 
+        # Unified partitioner: resolve the plan ONCE per fit; placement,
+        # in-graph constraints, the batch sharding and the checkpoint's
+        # spec record all derive from it.
+        plan = self._resolved_plan(plan)
         params, state = self.model.build_params()
         # Keras continuation semantics: a second fit() on the same estimator
         # keeps optimizer moments and the LR-schedule step count (they live
@@ -743,16 +818,33 @@ class Estimator:
         opt_state = (self._opt_state if self._opt_state is not None
                      else self.optimizer.init(params))
         repl = ctx.replicated()
-        params, state = jax.device_put((params, state), repl)
-        opt_state = self._place_opt_state(opt_state)
+        state = jax.device_put(state, repl)
+        params = self._place_params(params, plan)
+        opt_state = self._place_opt_state(opt_state, plan)
+        # Checkpoint spec record: the plan's clamped spec trees ride
+        # every snapshot, so a resume (any mesh size, any process) can
+        # see what layout the state was trained under and reshard
+        # through the partitioner — not a strategy-specific heuristic.
+        from analytics_zoo_tpu.parallel.plan import serialize_specs
+        # report_unused: the once-per-fit audit point — a typo'd rule
+        # that matched zero params surfaces as ONE warning here
+        param_specs, _ = plan.param_specs(params, ctx.mesh,
+                                          report_unused=True)
+        self._plan_record = {
+            "name": plan.name,
+            "mesh": dict(ctx.mesh.shape),
+            "param_specs": serialize_specs(param_specs),
+            "opt_specs": serialize_specs(
+                plan.opt_specs(opt_state, ctx.mesh)),
+        }
         dev_tf = getattr(train_set, "device_transform", None)
         # Fused multi-step dispatch (ZOO_STEPS_PER_DISPATCH): K>1 runs K
         # inner steps per jitted dispatch; the K=1 step is always built
         # too — it serves partial tail chunks.  (K >= 1 is enforced by
         # ZooConfig.__post_init__ — no silent clamping here.)
         k = int(ctx.config.steps_per_dispatch or 1)
-        step_fn = self._train_step_for(dev_tf, 1)
-        fused_fn = self._train_step_for(dev_tf, k) if k > 1 else None
+        step_fn = self._train_step_for(dev_tf, 1, plan)
+        fused_fn = self._train_step_for(dev_tf, k, plan) if k > 1 else None
         # Persistent compile plane (ZOO_COMPILE_CACHE): enable before the
         # first trace so this fit's compiles populate / hit the cache.
         from analytics_zoo_tpu.common.compile_cache import (
@@ -764,12 +856,26 @@ class Estimator:
         # resume from checkpoint if present (Topology.scala:1220-1242)
         resumed = self._ckpt.latest() if self._ckpt else None
         if resumed is not None:
-            params = jax.device_put(resumed["params"], repl)
+            # Elastic resume through the partitioner: the checkpoint
+            # stores GLOBAL logical arrays, so resharding onto THIS
+            # mesh/plan (saved {data:8}, resuming {data:4}; saved fsdp,
+            # resuming dp; ...) is exactly the plan's placement
+            # device_put — no layout surgery.
+            saved_plan = resumed.get("plan")
+            if saved_plan and (saved_plan.get("name") != plan.name
+                               or saved_plan.get("mesh")
+                               != dict(ctx.mesh.shape)):
+                logger.info(
+                    "resharding checkpoint (saved plan=%s mesh=%s) into "
+                    "plan=%s mesh=%s through the partitioner",
+                    saved_plan.get("name"), saved_plan.get("mesh"),
+                    plan.name, dict(ctx.mesh.shape))
+            params = self._place_params(resumed["params"], plan)
             opt_state = jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(opt_state),
                 [jnp.asarray(x) for x in resumed["opt_flat"]],
             )
-            opt_state = self._place_opt_state(opt_state)
+            opt_state = self._place_opt_state(opt_state, plan)
             state = jax.device_put(resumed["state"], repl)
             self.global_step = int(resumed["global_step"])
             start_epoch = int(resumed["epoch"])
@@ -784,8 +890,8 @@ class Estimator:
         try:
             params, opt_state, state = self._train_with_retries(
                 params, opt_state, state, step_fn, fused_fn, k, dev_tf,
-                controller, train_set, batch_size, seed, start_epoch,
-                start_batch, end_trigger, checkpoint_trigger,
+                plan, controller, train_set, batch_size, seed,
+                start_epoch, start_batch, end_trigger, checkpoint_trigger,
                 validation_set, validation_trigger, retry_times, repl)
         finally:
             if attached_set is not None:
@@ -808,9 +914,9 @@ class Estimator:
         return self
 
     def _train_with_retries(self, params, opt_state, state, step_fn,
-                            fused_fn, k, dev_tf, controller, train_set,
-                            batch_size, seed, start_epoch, start_batch,
-                            end_trigger, checkpoint_trigger,
+                            fused_fn, k, dev_tf, plan, controller,
+                            train_set, batch_size, seed, start_epoch,
+                            start_batch, end_trigger, checkpoint_trigger,
                             validation_set, validation_trigger,
                             retry_times, repl):
         retries = 0
@@ -818,7 +924,7 @@ class Estimator:
             try:
                 params, opt_state, state = self._train_loop(
                     params, opt_state, state, step_fn, fused_fn, k,
-                    dev_tf, controller,
+                    dev_tf, plan, controller,
                     train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger,
                     validation_set, validation_trigger,
@@ -846,15 +952,15 @@ class Estimator:
                 resumed = self._ckpt.latest()
                 if resumed is None:
                     raise
-                params = jax.device_put(resumed["params"], repl)
-                # same ZOO_SHARD_OPTIMIZER placement as the initial/resume
-                # sites: restoring replicated here would retrigger the OOM
-                # the ZeRO-1 layout exists to prevent, mid-retry
+                params = self._place_params(resumed["params"], plan)
+                # same plan placement as the initial/resume sites:
+                # restoring replicated here would retrigger the OOM the
+                # zero1/fsdp layout exists to prevent, mid-retry
                 opt_state = self._place_opt_state(
                     jax.tree_util.tree_unflatten(
                         jax.tree_util.tree_structure(opt_state),
                         [jnp.asarray(x) for x in resumed["opt_flat"]],
-                    ))
+                    ), plan)
                 state = jax.device_put(resumed["state"], repl)
                 self.global_step = int(resumed["global_step"])
                 start_epoch = int(resumed["epoch"])
@@ -863,8 +969,8 @@ class Estimator:
 
     # zoolint: hot-path
     def _train_loop(self, params, opt_state, state, step_fn, fused_fn,
-                    steps_per_dispatch, dev_tf, controller, train_set,
-                    batch_size, seed, start_epoch, start_batch,
+                    steps_per_dispatch, dev_tf, plan, controller,
+                    train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger, validation_set,
                     validation_trigger):
         ctx = self.ctx
@@ -915,6 +1021,11 @@ class Estimator:
             # unregisters the component when it exits (on_exit), so the
             # main thread never races a late beat.
             health.register("infeed", stale_after=60.0)
+            # batch placement comes from the PLAN (its batch_axes — the
+            # data axis for every canned plan; ("dcn", "data") under a
+            # hybrid-mesh plan), not a hard-wired DATA_AXIS
+            baxes = plan.batch_axes
+            shard_single = partial(ctx.shard_batch, axes=baxes)
             chunked = k > 1 or controller is not None
             if chunked:
                 # Fused dispatch: the feeder consumes the CHUNKED stream.
@@ -923,8 +1034,9 @@ class Estimator:
                 # device compute, like every other shard_fn cost) and
                 # sharded with axis 1 on the data axis, so each inner
                 # scan step sees the same per-chip shards as K=1.
-                def shard_item(item, _stack=ctx.shard_batch_stacked,
-                               _single=ctx.shard_batch):
+                def shard_item(item, _stack=partial(
+                        ctx.shard_batch_stacked, axes=baxes),
+                               _single=shard_single):
                     kind, payload = item
                     if kind == "scan":
                         stacked = jax.tree_util.tree_map(
@@ -941,7 +1053,7 @@ class Estimator:
                     else _chunk_batches(batch_iter, k))
                 shard_fn = shard_item
             else:
-                feed_src, shard_fn = batch_iter, ctx.shard_batch
+                feed_src, shard_fn = batch_iter, shard_single
             feeder = _DeviceFeeder(
                 feed_src, shard_fn, depth=cfg.infeed_depth,
                 heartbeat=lambda: health.heartbeat("infeed"),
@@ -981,7 +1093,8 @@ class Estimator:
                                 # looked up per-chunk (a dict hit after
                                 # each K's first compile).
                                 fn = fused_fn if controller is None \
-                                    else self._train_step_for(dev_tf, nk)
+                                    else self._train_step_for(
+                                        dev_tf, nk, plan)
                                 params, opt_state, state, losses = \
                                     fn(
                                         params, opt_state, state,
@@ -1171,7 +1284,12 @@ class Estimator:
                 f"{tstate.iteration}",
                 dict(params=params, state=state, opt_flat=opt_flat,
                      global_step=tstate.iteration, epoch=epoch,
-                     next_batch=next_batch, seed=seed),
+                     next_batch=next_batch, seed=seed,
+                     # the plan's spec trees (plain lists — safe_load
+                     # clean): what layout this snapshot trained under,
+                     # so elastic resume reshards knowingly through the
+                     # partitioner
+                     plan=getattr(self, "_plan_record", None)),
             )
         return params, opt_state, state
 
@@ -1199,15 +1317,17 @@ class Estimator:
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
         ctx = self.ctx
-        step_fn = self._train_step_for(device_transform, 1)
+        plan = self._resolved_plan()
+        step_fn = self._train_step_for(device_transform, 1, plan)
         params, state = self.model.build_params()
         host = jax.tree_util.tree_map(np.asarray, (params, state))
-        params, state = jax.device_put(host, ctx.replicated())
-        opt_state = jax.device_put(self.optimizer.init(params),
-                                   ctx.replicated())
-        sharded = ctx.shard_batch(batch)
+        params = self._place_params(host[0], plan)
+        state = jax.device_put(host[1], ctx.replicated())
+        opt_state = self._place_opt_state(self.optimizer.init(params),
+                                          plan)
+        sharded = ctx.shard_batch(batch, axes=plan.batch_axes)
         seed_arr = np.asarray(0, np.int32)
-        sig = (device_transform, tuple(
+        sig = (device_transform, plan.cache_key(), tuple(
             (path, tuple(leaf.shape), str(leaf.dtype))
             for path, leaf in
             jax.tree_util.tree_flatten_with_path(sharded)[0]))
@@ -1238,7 +1358,7 @@ class Estimator:
     # AOT warmup (the compile plane, common/compile_cache.py)
     # ------------------------------------------------------------------
     def warmup(self, batch: dict, device_transform=None,
-               steps_per_dispatch: int | None = None) -> dict:
+               steps_per_dispatch: int | None = None, plan=None) -> dict:
         """Pay XLA compilation for the train step BEFORE the first real
         batch (``.lower().compile()`` through the compile plane).
 
@@ -1267,9 +1387,9 @@ class Estimator:
         ctx = self.ctx
         from analytics_zoo_tpu.common.compile_cache import (
             maybe_enable_persistent_cache,
-            timed_compile,
         )
         maybe_enable_persistent_cache(ctx.config.compile_cache)
+        plan = self._resolved_plan(plan)
         k = steps_per_dispatch if steps_per_dispatch is not None \
             else int(ctx.config.steps_per_dispatch or 1)
         if int(k) < 1:
@@ -1287,36 +1407,35 @@ class Estimator:
         from analytics_zoo_tpu.feature.dataset import _slice_batch_rows
         host_batch = _slice_batch_rows(host_batch, _process_shard())
         for kk in sorted({1, k}):
-            label = "train_step" if kk == 1 else f"train_step_scan{kk}"
-            step_fn = self._train_step_for(device_transform, kk)
+            step_fn = self._train_step_for(device_transform, kk, plan)
             # fresh device buffers per variant: the throwaway dispatch
             # donates them, and the live model buffers are never touched.
-            # opt_state takes the SAME placement fit() will use
-            # (_place_opt_state — ZeRO-1 sharded under
-            # ZOO_SHARD_OPTIMIZER): jit specializes on input shardings,
+            # params/opt_state take the SAME plan placement fit() will
+            # use: the compiled program specializes on input shardings,
             # so a replicated warm here would compile a program fit
             # never runs.
-            params, state = jax.device_put(host, ctx.replicated())
-            opt_state = self._place_opt_state(self.optimizer.init(params))
+            params = self._place_params(host[0], plan)
+            state = jax.device_put(host[1], ctx.replicated())
+            opt_state = self._place_opt_state(
+                self.optimizer.init(params), plan)
             if kk == 1:
-                sharded = ctx.shard_batch(host_batch)
+                sharded = ctx.shard_batch(host_batch,
+                                          axes=plan.batch_axes)
             else:
-                sharded = ctx.shard_batch_stacked(jax.tree_util.tree_map(
-                    lambda x: np.stack([x] * kk), host_batch))
+                sharded = ctx.shard_batch_stacked(
+                    jax.tree_util.tree_map(
+                        lambda x: np.stack([x] * kk), host_batch),
+                    axes=plan.batch_axes)
             args = (params, opt_state, state, np.asarray(0, np.int32),
                     np.asarray(0, np.int32), sharded)
             t0 = time.perf_counter()
-            from analytics_zoo_tpu.common.compile_cache import cache_dir
-            if cache_dir() is not None:
-                # AOT pass populates the persistent cache; the dispatch
-                # below deserializes it.  Skipped when no cache dir is
-                # enabled — the discarded executable would just make the
-                # dispatch below pay the SAME compile a second time.
-                timed_compile(step_fn.lower(*args), label)
-            # one throwaway dispatch: warms jax's own dispatch cache
+            # ONE dispatch: the PlannedStep (parallel/plan.py) AOT-
+            # lowers through timed_compile on its first call — the
+            # persistent cache is populated / hit and the HLO features
+            # extracted right here — then the cached executable runs.
             res = step_fn(*args)
             jax.block_until_ready(res[-1])
-            out[label] = time.perf_counter() - t0
+            out[step_fn.label] = time.perf_counter() - t0
         logger.info("warmup compiled %s", out)
         return out
 

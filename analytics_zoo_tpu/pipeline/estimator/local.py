@@ -63,12 +63,19 @@ class LocalEstimator:
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, l
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step(params, opt_state, state, rng, bx, by):
+        # through the unified partitioner's choke point (no mesh → the
+        # default replicate-everything plan): the local trainer shares
+        # the persistent compile cache / metering / HLO lint with the
+        # distributed estimator
+        from analytics_zoo_tpu.parallel.plan import compile_step
+
+        def step_fn(params, opt_state, state, rng, bx, by):
             return one_step(params, opt_state, state, rng, bx, by)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step_scan(params, opt_state, state, it0, sbx, sby):
+        step = compile_step(step_fn, donate_argnums=(0, 1, 2),
+                            label="local_step")
+
+        def step_scan_fn(params, opt_state, state, it0, sbx, sby):
             key = jax.random.PRNGKey(seed)
 
             def body(carry, xs):
@@ -83,6 +90,9 @@ class LocalEstimator:
                 body, (params, opt_state, state),
                 (sbx, sby, jnp.arange(k, dtype=jnp.int32)))
             return params, opt_state, state, losses[-1]
+
+        step_scan = compile_step(step_scan_fn, donate_argnums=(0, 1, 2),
+                                 label=f"local_step_scan{k}")
 
         from analytics_zoo_tpu.pipeline.estimator.estimator import (
             _chunk_batches,
@@ -121,12 +131,15 @@ class LocalEstimator:
         return self
 
     def evaluate(self, x, y, batch_size=32):
+        from analytics_zoo_tpu.parallel.plan import compile_step
+
         model = self.model
         params, state = model.build_params()
 
-        @jax.jit
-        def fwd(params, state, bx):
+        def fwd_fn(params, state, bx):
             return model.forward(params, bx, state=state, training=False)[0]
+
+        fwd = compile_step(fwd_fn, label="local_eval")
 
         fs = FeatureSet.of(x, y)
         accums = [None] * (len(self.metrics) + 1)

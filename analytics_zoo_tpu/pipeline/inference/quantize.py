@@ -235,6 +235,7 @@ class Int8Model:
         # one jitted forward for the lifetime of the wrapper: jit caches
         # by function identity, so a per-call lambda would recompile on
         # every predict
+        # zoolint: disable=raw-jit -- int8 apply hooks are install-scoped trace state: the jit must trace under installed() (inference_model holds the lock), and sharing a choke-point executable cache across hook states would serve the wrong program
         self._fwd = jax.jit(lambda p, xb: self.net.forward(
             p, xb, state=self.net.state, training=False)[0])
 

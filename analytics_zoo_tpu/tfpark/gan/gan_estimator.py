@@ -91,6 +91,7 @@ class GANEstimator:
         period = self._g_steps + self._d_steps
         d_steps = self._d_steps
 
+        # zoolint: disable=raw-jit -- single-device GAN demo path kept off the plan machinery on purpose (alternating G/D carries, no mesh); compile cost is one trace per fit
         @jax.jit
         def train_step(gp, dp, g_os, d_os, gs, ds, step, noise, real, rng):
             k_g, k_d = jax.random.split(rng)

@@ -381,6 +381,145 @@ def _autotune_main(argv):
 
 
 # ---------------------------------------------------------------------------
+# --partition: unified-partitioner bench (parallel/plan.py).  Replicated
+# data parallelism vs the fsdp plan (params + optimizer state sharded
+# over `data`) on the 8-device CPU mesh: per-chip param+opt-state bytes
+# measured from the LIVE arrays (one device's resident shards), HLO
+# bytes_accessed from the compile plane's zoo_hlo_* features, steps/sec,
+# and the trajectory-equality flag — the fsdp memory win must be free
+# (placement changes bytes and collectives, never the math).  Emits
+# BENCH_PARTITION_r10.json.
+# ---------------------------------------------------------------------------
+
+
+def _partition_model():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    m = Sequential()
+    m.add(Dense(256, activation="relu", input_shape=(32,)))
+    m.add(Dense(256, activation="relu"))
+    m.add(Dense(10, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    return m
+
+
+def _partition_data(n=512, feat=32, classes=10, seed=7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, feat)).astype(np.float32)
+    w = rng.normal(size=(feat, classes))
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _partition_leg(plan_name, epochs, batch_size=64):
+    """One training leg under a named plan; returns losses, per-chip
+    bytes (live arrays), steps/sec and the plan's HLO features."""
+    import jax
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.metrics import get_registry, snapshot
+    from analytics_zoo_tpu.parallel.plan import per_chip_bytes
+
+    zoo.init_zoo_context(seed=11, mesh_shape={"data": 8}, platform="cpu")
+    x, y = _partition_data()
+    m = _partition_model()
+    t0 = time.perf_counter()
+    m.fit(x, y, batch_size=batch_size, nb_epoch=epochs, plan=plan_name)
+    dt = time.perf_counter() - t0
+    est = m._estimator
+    steps = est.global_step
+    params, opt_state = m.params, est._opt_state
+    chip_bytes = per_chip_bytes((params, opt_state))
+    total_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            (params, opt_state)) if hasattr(leaf, "nbytes"))
+    label = "train_step" if plan_name in (None, "dp") \
+        else f"train_step_{plan_name}"
+    hlo = {}
+    for s in snapshot(get_registry())["samples"]:
+        if s["name"].startswith("zoo_hlo_") \
+                and s.get("labels", {}).get("label") == label:
+            hlo[s["name"]] = s["value"]
+    spec0 = jax.tree_util.tree_leaves(params)[0].sharding.spec
+    return {
+        "plan": plan_name or "dp",
+        "losses": [h["loss"] for h in est.history],
+        "per_chip_param_opt_bytes": int(chip_bytes),
+        "global_param_opt_bytes": int(total_bytes),
+        "steps": int(steps),
+        "steps_per_sec": round(steps / max(dt, 1e-9), 2),
+        "param0_spec": str(spec0),
+        "hlo": hlo,
+    }
+
+
+def partition_bench(quick: bool = False,
+                    out_path: str | None = None) -> dict:
+    """Replicated DP vs the fsdp (and zero1) plans: memory ratio at
+    trajectory equality; writes BENCH_PARTITION_r10.json."""
+    epochs = 2 if quick else 4
+    legs = {name: _partition_leg(name, epochs)
+            for name in ("dp", "fsdp", "zero1")}
+    repl, fs = legs["dp"], legs["fsdp"]
+    ratio = fs["per_chip_param_opt_bytes"] \
+        / max(repl["per_chip_param_opt_bytes"], 1)
+    doc = {
+        "metric": "fsdp_per_chip_param_opt_bytes_vs_replicated",
+        "unit": "ratio (lower is better; target <= 0.6)",
+        "value": round(ratio, 4),
+        "zero1_ratio": round(
+            legs["zero1"]["per_chip_param_opt_bytes"]
+            / max(repl["per_chip_param_opt_bytes"], 1), 4),
+        # the acceptance flag: fsdp must be FREE — the gather-on-use /
+        # reduce-scatter program computes the same sums in the same
+        # order, so the trajectory is bitwise dp's.  zero1's sharded-
+        # moment program groups the gradient reduction differently
+        # (reduce-scatter into moments, all-gather of updates) — ulp
+        # drift, reported as max|Δ| rather than pretending bitwise.
+        "trajectory_bitwise_equal": repl["losses"] == fs["losses"],
+        "zero1_trajectory_max_abs_diff": max(
+            abs(a - b) for a, b in zip(repl["losses"],
+                                       legs["zero1"]["losses"])),
+        "devices": 8,
+        "platform": "cpu",
+        "quick": bool(quick),
+        "legs": legs,
+        "note": ("per_chip bytes counted from live arrays (one device's "
+                 "resident shards); hlo features from the compile "
+                 "plane's zoo_hlo_* extraction at the choke point"),
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_PARTITION_r10.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _partition_main(argv):
+    # the 8-device CPU mesh is the point (memory layout, not FLOPs)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(partition_bench(**kwargs)))
+
+
+# ---------------------------------------------------------------------------
 # --fleet: multi-replica serving fleet bench (serving/fleet.py).  No real
 # model — the replicas serve the synthetic sleep model (per-RECORD
 # GIL-releasing service time, like device inference), so the bench
@@ -1050,7 +1189,9 @@ def _data_pipeline_main(argv):
 
 
 if __name__ == "__main__":
-    if "--data-pipeline" in sys.argv:
+    if "--partition" in sys.argv:
+        _partition_main(sys.argv[1:])
+    elif "--data-pipeline" in sys.argv:
         _data_pipeline_main(sys.argv[1:])
     elif "--fleet" in sys.argv:
         _fleet_main(sys.argv[1:])

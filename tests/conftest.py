@@ -55,6 +55,8 @@ QUICK_FILES = {
     "test_dispatch.py",  # fused scan-K dispatch + --dispatch bench guard
     "test_autotune.py",  # closed-loop autotune + --autotune bench guard
     "test_compile_cache.py",  # persistent compile plane
+    "test_partitioner.py",  # unified partitioner + --partition guard
+    "test_partition_rules.py",  # rule matching + path rendering
     "test_zoolint.py",  # static analysis + package-clean CI gate
     "test_zoosan.py",  # whole-program pass + runtime sanitizer
     "test_telemetry.py",  # ~9s incl. two actor spawns

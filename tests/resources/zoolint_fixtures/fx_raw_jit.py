@@ -1,0 +1,35 @@
+"""zoolint fixture: raw-jit — decorator/partial/call-site positives,
+choke-point + suppressed negatives.  Never imported; linted statically."""
+
+from functools import partial
+
+import jax
+
+from analytics_zoo_tpu.common.compile_cache import timed_compile
+from analytics_zoo_tpu.parallel.plan import compile_step
+
+
+@jax.jit  # POSITIVE (decorator)
+def bare_decorated(x):
+    return x * 2
+
+
+@partial(jax.jit, donate_argnums=(0,))  # POSITIVE (partial decorator)
+def partial_decorated(x):
+    return x * 2
+
+
+def plain(x):
+    return x + 1
+
+
+bad_call = jax.jit(plain)  # POSITIVE (call site)
+
+# NEGATIVE: the jit's lowering flows into timed_compile — that IS the
+# choke point (the inference_model idiom)
+exe = timed_compile(jax.jit(plain).lower(1.0), "fixture")
+
+# NEGATIVE: routed through the partitioner's entry
+stepped = compile_step(plain, label="fixture_step")
+
+justified = jax.jit(plain)  # zoolint: disable=raw-jit -- fixture: deliberate bypass with a recorded reason

@@ -201,11 +201,12 @@ class TestPureStepProbe:
         est = m._make_estimator()
         batch = {"x": x[:32], "y": y[:32]}
         est.measure_pure_step(batch, n_steps=1)
-        fn_probe = est._train_step_fns[(None, 1)]
+        plan_key = est._resolved_plan().cache_key()
+        fn_probe = est._train_step_fns[(None, 1, plan_key)]
         dev_tf = lambda b: b  # noqa: E731
         est._train_step_for(dev_tf, 1)
         est.measure_pure_step(batch, n_steps=1)
-        assert est._train_step_fns[(None, 1)] is fn_probe
+        assert est._train_step_fns[(None, 1, plan_key)] is fn_probe
         assert len(est._train_step_fns) == 2
 
 
@@ -304,7 +305,10 @@ class TestWarmupEdges:
         est = m._make_estimator()
         m._estimator = est
         secs = est.warmup({"x": x[:32], "y": y[:32]})
-        assert set(secs) == {"train_step", "train_step_scan4"}
+        # ZOO_SHARD_OPTIMIZER resolves to the zero1 plan, and plan
+        # programs carry per-plan compile labels (parallel/plan.py)
+        assert set(secs) == {"train_step_zero1",
+                             "train_step_scan4_zero1"}
         m.fit(x, y, batch_size=32, nb_epoch=1)  # reuses the warmed fns
         assert est.global_step == 8
 

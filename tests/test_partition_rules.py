@@ -61,6 +61,107 @@ class TestMatchPartitionRules:
         assert specs[1]["w"] == P("model")
 
 
+class TestLeafPathName:
+    """The rendering is the rule-matching CONTRACT — pinned here so
+    regexes stay stable across jax versions (ISSUE 10 satellite)."""
+
+    def _names(self, tree):
+        from analytics_zoo_tpu.parallel.partition import leaf_path_name
+
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [leaf_path_name(path) for path, _ in flat]
+
+    def test_dict_list_tuple_rendering(self):
+        tree = {"block": [{"w": np.zeros(2)}, {"w": np.zeros(2)}],
+                "pair": (np.zeros(2), np.zeros(2))}
+        assert self._names(tree) == \
+            ["block/0/w", "block/1/w", "pair/0", "pair/1"]
+
+    def test_dataclass_rendering(self):
+        import dataclasses
+
+        @jax.tree_util.register_pytree_node_class
+        class Box:
+            def __init__(self, a, b):
+                self.a, self.b = a, b
+
+            def tree_flatten(self):
+                return (self.a, self.b), None
+
+            @classmethod
+            def tree_unflatten(cls, aux, children):
+                return cls(*children)
+
+        @dataclasses.dataclass
+        class DC:
+            kernel: object
+            bias: object
+
+        jax.tree_util.register_dataclass(
+            DC, data_fields=["kernel", "bias"], meta_fields=[])
+        names = self._names({"layer": DC(np.zeros(2), np.zeros(2))})
+        assert names == ["layer/kernel", "layer/bias"]
+        # opaque custom node: leaves get FlattenedIndexKey positions
+        names = self._names({"box": Box(np.zeros(2), np.zeros(2))})
+        assert names == ["box/0", "box/1"]
+
+    def test_optax_state_paths_are_matchable(self):
+        """The opt_rules=param_rules contract: adam moments render with
+        the param path as a SUFFIX, so param regexes re.search-match."""
+        import optax
+        import re
+
+        params = {"dense_1": {"kernel": np.zeros((4, 8))}}
+        state = optax.adam(1e-2).init(params)
+        names = self._names(state)
+        assert any(n.endswith("dense_1/kernel") for n in names), names
+        assert all(re.search(r"dense_1/kernel", n)
+                   for n in names if "kernel" in n)
+
+
+class TestReportUnused:
+    def test_typo_regex_surfaces(self, caplog):
+        """A typo'd rule silently replicating a whole model is the
+        failure mode report_unused exists for (ISSUE 10 satellite)."""
+        import logging
+
+        from analytics_zoo_tpu.parallel.partition import (
+            match_partition_rules,
+        )
+
+        params = {"dense": {"kernel": np.zeros((4, 8))}}
+        rules = [(r"dense/kernl", P(None, "model")), (r".*", P())]
+        with caplog.at_level(logging.WARNING, "analytics_zoo_tpu"):
+            specs, unused = match_partition_rules(rules, params,
+                                                  report_unused=True)
+        assert unused == [r"dense/kernl"]
+        assert specs["dense"]["kernel"] == P()  # fell through to catch-all
+        assert any("zero leaves" in r.message for r in caplog.records)
+
+    def test_all_rules_used_reports_empty(self):
+        from analytics_zoo_tpu.parallel.partition import (
+            match_partition_rules,
+        )
+
+        params = {"dense": {"kernel": np.zeros((4, 8)),
+                            "bias": np.zeros(8)}}
+        specs, unused = match_partition_rules(
+            [(r"kernel", P(None, "model")), (r".*", P())], params,
+            report_unused=True)
+        assert unused == []
+
+    def test_default_return_shape_unchanged(self):
+        """report_unused=False (the default) keeps the bare-specs
+        return every existing caller relies on."""
+        from analytics_zoo_tpu.parallel.partition import (
+            match_partition_rules,
+        )
+
+        specs = match_partition_rules(
+            [(r".*", P())], {"w": np.zeros((2, 2))})
+        assert specs == {"w": P()}
+
+
 class TestShardParams:
     def test_device_put_lays_out_on_mesh(self):
         from analytics_zoo_tpu import init_zoo_context

@@ -177,6 +177,44 @@ class TestNonDonatedCarryRule:
             [_line_of(self.FX, "reused across probes")]
 
 
+class TestRawJitRule:
+    FX = "fx_raw_jit.py"
+
+    def test_raw_jit_positives(self):
+        """Decorator, partial-decorator and call-site jits outside the
+        compile plane are flagged; the timed_compile idiom and
+        compile_step routing stay quiet."""
+        active = _active(_lint_fixture(self.FX, "raw-jit"))
+        lines = {f.line for f in active}
+        assert _line_of(self.FX, "POSITIVE (decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (partial decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (call site)") in lines
+        assert len(active) == 3  # choke-point negatives stay quiet
+
+    def test_suppressed_negative(self):
+        sup = _suppressed(_lint_fixture(self.FX, "raw-jit"))
+        assert [f.line for f in sup] == \
+            [_line_of(self.FX, "deliberate bypass")]
+
+    def test_package_train_steps_routed(self):
+        """The rewired call sites the rule exists for: the estimator's
+        train/eval steps and both explicit strategies now reach XLA only
+        through compile_step — zero active raw-jit findings in those
+        modules."""
+        from analytics_zoo_tpu.analysis import lint_paths
+
+        mods = [
+            os.path.join(REPO, "analytics_zoo_tpu", p) for p in (
+                "pipeline/estimator/estimator.py",
+                "pipeline/estimator/local.py",
+                "parallel/strategies.py",
+            )
+        ]
+        active = [f for f in _active(lint_paths(mods))
+                  if f.rule == "raw-jit"]
+        assert not active, [str(f) for f in active]
+
+
 class TestGuardedByRule:
     FX = "fx_guarded_by.py"
 
