@@ -73,6 +73,8 @@ __all__ = [
     "lint_source", "lint_file", "lint_paths",
     "HloReport", "analyze_hlo_text", "lint_lowered",
     "load_program", "lint_program", "build_lock_graph", "find_cycles",
+    "ConfigOracle", "ResidualModel", "PeakTable", "resolve_peaks",
+    "predict_steps_per_sec",
 ]
 
 # The HLO tier and the whole-program pass load lazily (PEP 562): the
@@ -86,6 +88,9 @@ _LAZY = {
     "lint_program": "rules_interproc",
     "build_lock_graph": "rules_interproc",
     "find_cycles": "rules_interproc",
+    "ConfigOracle": "oracle",
+    "ResidualModel": "costmodel", "PeakTable": "costmodel",
+    "resolve_peaks": "costmodel", "predict_steps_per_sec": "costmodel",
 }
 
 

@@ -1,0 +1,529 @@
+"""Analytic + fitted cost model for the predictive compile plane.
+
+The compile choke point already extracts a static feature vector per
+compiled program (``analysis/hlo.py``: matmul FLOPs, bytes touched,
+collective bytes, fused-dispatch count) — TpuGraphs (arXiv:2308.13490)
+shows exactly these features rank configs well, and tf.data
+(arXiv:2101.12127) shows an analytic prior refined online beats blind
+search.  This module is both halves:
+
+- :func:`predict_step_seconds` — a **roofline** over the feature
+  vector: per-step time = max(flops/peak_flops, bytes/peak_bw) +
+  collective_bytes/link_bw + dispatch_overhead/K.  The K term is the
+  fused-dispatch amortization the autotuner otherwise discovers by
+  measurement (~53 dispatches, BENCH_AUTOTUNE_r08); the ceilings come
+  from a small per-platform :class:`PeakTable` with a CPU-calibrated
+  default, any field overridable via ``ZOO_ORACLE_PEAKS`` (a JSON
+  object, e.g. ``{"dispatch_overhead_s": 4e-4}``).
+- :func:`predict_chip_bytes` / :func:`plan_collective_bytes` — per-chip
+  memory and per-step interconnect traffic per sharding plan
+  (dp/zero1/fsdp/tp memory factors; ring-collective byte counts), the
+  inputs of ``plan="auto"``.
+- :class:`ResidualModel` — a least-squares fit IN LOG SPACE of
+  measured/predicted against the log-features (stdlib only — the
+  normal equations are solved by Gaussian elimination, no
+  sklearn/numpy.linalg).  Trained from accumulated
+  ``ZOO_HLO_REPORT_DIR`` reports (:func:`load_report_rows`, schema v1
+  accepted with nulls) joined with BENCH_*.json rows
+  (:func:`load_bench_rows`) and the autotuner's persisted decision
+  history (:func:`load_tune_log_rows`, ``ZOO_TUNE_LOG_DIR``).  Below
+  :data:`MIN_FIT_SAMPLES` joined samples the model reports
+  ``ready == False`` and callers fall back to the analytic prediction
+  alone — the zero-data path is first-class, not an error.
+
+Consumed by :mod:`analytics_zoo_tpu.analysis.oracle` (the
+``ConfigOracle`` that primes the autotuner and resolves
+``plan="auto"``); documented in docs/performance.md ("Predictive
+compile plane").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Iterable, Mapping, Sequence
+
+__all__ = [
+    "PeakTable", "resolve_peaks", "PLATFORM_PEAKS", "MIN_FIT_SAMPLES",
+    "normalize_features", "predict_step_seconds", "predict_steps_per_sec",
+    "predict_chip_bytes", "plan_collective_bytes", "PLAN_MEMORY_FACTORS",
+    "ResidualModel", "load_report_rows", "load_bench_rows",
+    "load_tune_log_rows", "training_rows",
+]
+
+#: below this many joined (features, K, measured steps/sec) samples the
+#: residual model refuses to fit and the analytic roofline stands alone
+MIN_FIT_SAMPLES = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class PeakTable:
+    """Hardware ceilings the roofline divides by.
+
+    ``flops``/``hbm_bytes_per_s``/``link_bytes_per_s`` are per-chip
+    peaks; ``dispatch_overhead_s`` is the fixed host cost of one jitted
+    dispatch (the quantity ``steps_per_dispatch`` K amortizes);
+    ``hbm_bytes`` is the per-chip memory budget ``plan="auto"`` fits
+    against.  ``source`` names the table entry (or "env" after a
+    ``ZOO_ORACLE_PEAKS`` override) so artifacts record which
+    calibration produced a prediction.
+    """
+
+    flops: float
+    hbm_bytes_per_s: float
+    link_bytes_per_s: float
+    dispatch_overhead_s: float
+    hbm_bytes: float
+    source: str = "cpu-default"
+
+    def to_doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+#: Per-platform ceilings.  The CPU row is CALIBRATED, not theoretical:
+#: dispatch_overhead_s comes from BENCH_AUTOTUNE_r08's measured
+#: per-step cost curve (cost(K) = compute + overhead/K over
+#: K∈{1..16} gives overhead ≈ 5e-4 s on this harness's host), and the
+#: flops/bandwidth rows are order-of-magnitude host numbers — for the
+#: dispatch-bound programs the CPU backend exists to exercise, the
+#: overhead term dominates and ranking is insensitive to them.  TPU
+#: rows use published per-chip peaks (see also TPU_PEAK_FLOPS in
+#: bench.py for MFU accounting).
+PLATFORM_PEAKS: dict[str, PeakTable] = {
+    "cpu": PeakTable(
+        flops=5.0e10, hbm_bytes_per_s=2.0e10, link_bytes_per_s=1.0e10,
+        dispatch_overhead_s=5.0e-4, hbm_bytes=float(4 << 30),
+        source="cpu-default"),
+    "tpu-v4": PeakTable(
+        flops=2.75e14, hbm_bytes_per_s=1.2e12, link_bytes_per_s=2.4e11,
+        dispatch_overhead_s=1.0e-4, hbm_bytes=float(32 << 30),
+        source="tpu-v4"),
+    "tpu-v5e": PeakTable(
+        flops=1.97e14, hbm_bytes_per_s=8.1e11, link_bytes_per_s=1.6e11,
+        dispatch_overhead_s=1.0e-4, hbm_bytes=float(16 << 30),
+        source="tpu-v5e"),
+    "tpu-v3": PeakTable(
+        flops=1.23e14, hbm_bytes_per_s=9.0e11, link_bytes_per_s=1.4e11,
+        dispatch_overhead_s=1.0e-4, hbm_bytes=float(16 << 30),
+        source="tpu-v3"),
+    "tpu-v2": PeakTable(
+        flops=4.5e13, hbm_bytes_per_s=7.0e11, link_bytes_per_s=1.0e11,
+        dispatch_overhead_s=1.0e-4, hbm_bytes=float(8 << 30),
+        source="tpu-v2"),
+}
+
+
+def resolve_peaks(platform: str | None = None,
+                  device_kind: str | None = None) -> PeakTable:
+    """The ceilings for this process: per-platform table entry (device
+    kind beats bare platform — "TPU v4" maps to the v4 row), then the
+    CPU-calibrated default, with ``ZOO_ORACLE_PEAKS`` (JSON object)
+    overriding individual fields last.  Unknown keys in the override
+    are rejected loudly — a typo'd ceiling must not silently leave the
+    default in place."""
+    table = PLATFORM_PEAKS["cpu"]
+    kind = (device_kind or platform or "cpu").lower().replace(" ", "-")
+    for key, peaks in PLATFORM_PEAKS.items():
+        if key != "cpu" and (key in kind or kind in key):
+            table = peaks
+            break
+    else:
+        if kind.startswith("tpu"):
+            table = PLATFORM_PEAKS["tpu-v4"]
+    raw = os.environ.get("ZOO_ORACLE_PEAKS")
+    if not raw:
+        return table
+    try:
+        override = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"ZOO_ORACLE_PEAKS must be a JSON object of PeakTable "
+            f"fields: {e}") from e
+    if not isinstance(override, dict):
+        raise ValueError(
+            f"ZOO_ORACLE_PEAKS must be a JSON object, got "
+            f"{type(override).__name__}")
+    fields = {f.name for f in dataclasses.fields(PeakTable)}
+    unknown = set(override) - fields
+    if unknown:
+        raise ValueError(
+            f"ZOO_ORACLE_PEAKS: unknown field(s) {sorted(unknown)}; "
+            f"valid: {sorted(fields - {'source'})}")
+    merged = {**table.to_doc(), **{
+        k: (float(v) if k != "source" else str(v))
+        for k, v in override.items()}}
+    merged["source"] = str(override.get("source", "env"))
+    return PeakTable(**merged)
+
+
+# ---------------------------------------------------------------------------
+# Roofline prediction.
+# ---------------------------------------------------------------------------
+
+_FEATURE_ALIASES = {
+    "matmul_flops": ("matmul_flops", "flops", "zoo_hlo_flops"),
+    "bytes_accessed": ("bytes_accessed", "zoo_hlo_bytes_accessed"),
+    "collective_bytes": ("collective_bytes", "zoo_hlo_collective_bytes"),
+    "collective_count": ("collective_count", "zoo_hlo_collectives"),
+    "fused_dispatch_count": ("fused_dispatch_count",
+                             "zoo_hlo_fused_dispatches"),
+    "op_count": ("op_count", "zoo_hlo_ops"),
+}
+
+
+def normalize_features(features: Mapping) -> dict:
+    """Canonical feature dict from any of the shapes the repo emits:
+    :meth:`HloReport.features`, a ``zoo_hlo_*``-prefixed metrics
+    scrape, or a BENCH_*.json ``hlo`` block.  Missing keys become 0 —
+    a v1 report with nulls still yields a usable vector."""
+    out = {}
+    for canon, names in _FEATURE_ALIASES.items():
+        val = 0
+        for name in names:
+            got = features.get(name)
+            if got is not None:
+                val = got
+                break
+        out[canon] = float(val)
+    return out
+
+
+def predict_step_seconds(features: Mapping, k: int = 1,
+                         peaks: PeakTable | None = None) -> float:
+    """Roofline per-STEP wall seconds at ``steps_per_dispatch=k``:
+    ``max(flops/peak_flops, bytes/peak_bw) + collective_bytes/link_bw
+    + dispatch_overhead/k``.  The max() is the classic roofline (the
+    step is bound by the slower of compute and memory); collectives
+    serialize after it (they overlap poorly on the synchronous train
+    step); the overhead term is what K amortizes."""
+    peaks = peaks if peaks is not None else resolve_peaks()
+    f = normalize_features(features)
+    compute_s = f["matmul_flops"] / max(peaks.flops, 1.0)
+    memory_s = f["bytes_accessed"] / max(peaks.hbm_bytes_per_s, 1.0)
+    collective_s = f["collective_bytes"] / max(peaks.link_bytes_per_s, 1.0)
+    overhead_s = peaks.dispatch_overhead_s / max(int(k), 1)
+    return max(compute_s, memory_s) + collective_s + overhead_s
+
+
+def predict_steps_per_sec(features: Mapping, k: int = 1,
+                          peaks: PeakTable | None = None) -> float:
+    """Inverse of :func:`predict_step_seconds`."""
+    return 1.0 / max(predict_step_seconds(features, k=k, peaks=peaks),
+                     1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Per-plan memory + interconnect models (the plan="auto" inputs).
+# ---------------------------------------------------------------------------
+
+#: (param_factor, opt_factor) of per-chip resident bytes as a fraction
+#: of the global tree, for an n-way shard: dp replicates both, zero1
+#: shards optimizer state only, fsdp shards both, tp shards params +
+#: opt over the model axis (rule-table dependent; 1/n is the intended
+#: steady state).  Matches the live-array measurements in
+#: BENCH_PARTITION_r10.json (fsdp ≈ 0.125x on 8 devices).
+PLAN_MEMORY_FACTORS = {
+    "dp": (1.0, 1.0),
+    "zero1": (1.0, None),   # None -> 1/n
+    "fsdp": (None, None),
+    "tp": (None, None),
+}
+
+
+def predict_chip_bytes(param_bytes: int, opt_bytes: int, plan: str,
+                       n_shards: int, batch_bytes: int = 0) -> int:
+    """Predicted per-chip resident param+opt bytes under ``plan`` on an
+    ``n_shards``-way mesh axis (plus the per-chip batch slice when
+    given).  Activations are not modelled — this is the persistent
+    footprint the sharding plan controls."""
+    try:
+        pf, of = PLAN_MEMORY_FACTORS[plan]
+    except KeyError:
+        raise ValueError(
+            f"unknown plan {plan!r}; valid: "
+            f"{', '.join(sorted(PLAN_MEMORY_FACTORS))}") from None
+    n = max(int(n_shards), 1)
+    pf = pf if pf is not None else 1.0 / n
+    of = of if of is not None else 1.0 / n
+    return int(param_bytes * pf + opt_bytes * of
+               + batch_bytes / n)
+
+
+def plan_collective_bytes(param_bytes: int, plan: str,
+                          n_shards: int) -> int:
+    """Per-STEP interconnect bytes a plan moves for ``param_bytes`` of
+    weights on an ``n_shards``-way axis (ring-collective accounting,
+    2·P·(n-1)/n per all-reduce equivalent):
+
+    - dp: one gradient all-reduce (2P);
+    - zero1: reduce-scatter grads into the moment shards + all-gather
+      the updates back (2P, plus the sharded update's gather skew —
+      charged 2.5P so dp ranks strictly first at equal memory);
+    - fsdp: all-gather params on use (forward AND backward) +
+      reduce-scatter grads (3P);
+    - tp: activation collectives, model/rule dependent — charged like
+      dp's 2P as a neutral default.
+
+    These coefficients exist to RANK plans (fewest collectives first at
+    equal feasibility), not to predict absolute seconds; the residual
+    model absorbs the constants once outcomes accumulate."""
+    n = max(int(n_shards), 1)
+    if n <= 1:
+        return 0
+    ring = param_bytes * (n - 1) / n
+    coeff = {"dp": 2.0, "zero1": 2.5, "fsdp": 3.0, "tp": 2.0}
+    try:
+        return int(coeff[plan] * ring)
+    except KeyError:
+        raise ValueError(
+            f"unknown plan {plan!r}; valid: "
+            f"{', '.join(sorted(coeff))}") from None
+
+
+# ---------------------------------------------------------------------------
+# The fitted residual: least squares over log-space features, stdlib
+# only.  target = log(measured_sps) - log(analytic_sps); prediction
+# multiplies the analytic roofline by exp(w·x).
+# ---------------------------------------------------------------------------
+
+
+def _residual_vector(features: Mapping, k: int) -> list[float]:
+    f = normalize_features(features)
+    return [
+        1.0,
+        math.log1p(f["matmul_flops"]),
+        math.log1p(f["bytes_accessed"]),
+        math.log1p(f["collective_bytes"]),
+        math.log(max(int(k), 1)),
+        math.log1p(f["op_count"]),
+    ]
+
+
+def _solve_ridge(rows: Sequence[Sequence[float]],
+                 targets: Sequence[float],
+                 lam: float = 1e-3) -> list[float]:
+    """(AᵀA + λI) w = Aᵀb by Gaussian elimination with partial
+    pivoting — six unknowns, so O(d³) in pure Python is microseconds.
+    The ridge term keeps the system nonsingular when every sample
+    shares a feature value (one model swept over K alone)."""
+    d = len(rows[0])
+    ata = [[lam if i == j else 0.0 for j in range(d)] for i in range(d)]
+    atb = [0.0] * d
+    for row, t in zip(rows, targets):
+        for i in range(d):
+            atb[i] += row[i] * t
+            for j in range(d):
+                ata[i][j] += row[i] * row[j]
+    # augmented elimination
+    for col in range(d):
+        pivot = max(range(col, d), key=lambda r: abs(ata[r][col]))
+        if abs(ata[pivot][col]) < 1e-12:
+            continue
+        ata[col], ata[pivot] = ata[pivot], ata[col]
+        atb[col], atb[pivot] = atb[pivot], atb[col]
+        inv = 1.0 / ata[col][col]
+        for r in range(d):
+            if r == col:
+                continue
+            factor = ata[r][col] * inv
+            if factor == 0.0:
+                continue
+            for c in range(col, d):
+                ata[r][c] -= factor * ata[col][c]
+            atb[r] -= factor * atb[col]
+    return [atb[i] / ata[i][i] if abs(ata[i][i]) > 1e-12 else 0.0
+            for i in range(d)]
+
+
+class ResidualModel:
+    """Multiplicative correction to the analytic roofline, fitted from
+    accumulated (features, K, measured steps/sec) rows.
+
+    ``ready`` stays False below ``min_samples`` rows (or before any
+    :meth:`fit`): callers must then use the analytic prediction alone —
+    :meth:`predict_steps_per_sec` does exactly that, so the zero-data
+    path needs no branching at call sites."""
+
+    def __init__(self, peaks: PeakTable | None = None,
+                 min_samples: int = MIN_FIT_SAMPLES):
+        self.peaks = peaks if peaks is not None else resolve_peaks()
+        self.min_samples = int(min_samples)
+        self.weights: list[float] | None = None
+        self.n_samples = 0
+
+    @property
+    def ready(self) -> bool:
+        return self.weights is not None
+
+    def fit(self, rows: Iterable[Mapping]) -> "ResidualModel":
+        """``rows``: dicts with ``features`` (any alias shape), ``k``
+        and ``measured_steps_per_sec``.  Rows without a positive
+        measurement are dropped; below ``min_samples`` survivors the
+        model stays analytic (``ready`` False)."""
+        xs, ts = [], []
+        for row in rows:
+            sps = row.get("measured_steps_per_sec") or 0
+            if sps <= 0:
+                continue
+            feats = row.get("features") or {}
+            k = int(row.get("k") or 1)
+            analytic = predict_steps_per_sec(feats, k=k, peaks=self.peaks)
+            xs.append(_residual_vector(feats, k))
+            ts.append(math.log(sps) - math.log(analytic))
+        self.n_samples = len(xs)
+        if self.n_samples < self.min_samples:
+            self.weights = None
+            return self
+        self.weights = _solve_ridge(xs, ts)
+        return self
+
+    def predict_steps_per_sec(self, features: Mapping, k: int = 1) -> float:
+        analytic = predict_steps_per_sec(features, k=k, peaks=self.peaks)
+        if self.weights is None:
+            return analytic
+        x = _residual_vector(features, k)
+        log_corr = sum(w * xi for w, xi in zip(self.weights, x))
+        # clamp the correction: an extrapolated fit must dent the
+        # analytic prediction, not replace it with nonsense
+        log_corr = max(-3.0, min(3.0, log_corr))
+        return analytic * math.exp(log_corr)
+
+
+# ---------------------------------------------------------------------------
+# Training-row loaders: the data loop's read side.
+# ---------------------------------------------------------------------------
+
+
+def load_report_rows(report_dir: str) -> list[dict]:
+    """``ZOO_HLO_REPORT_DIR`` reports as feature rows.  Accepts schema
+    ``zoo-hlo-report/1`` (no plan/mesh/K/compile-seconds — those fields
+    come back None) alongside v2; unparseable files are skipped, never
+    raised."""
+    rows = []
+    try:
+        names = sorted(os.listdir(report_dir))
+    except OSError:
+        return rows
+    for name in names:
+        if not (name.startswith("hlo-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(report_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not str(doc.get("schema", "")).startswith("zoo-hlo-report/"):
+            continue
+        rows.append({
+            "label": doc.get("label"),
+            "features": normalize_features(doc.get("features") or {}),
+            "k": doc.get("steps_per_dispatch"),
+            "plan": doc.get("plan"),
+            "mesh_shape": doc.get("mesh_shape"),
+            "compile_seconds": doc.get("compile_seconds"),
+            "dtype_histogram": doc.get("dtype_histogram"),
+            "ts": doc.get("ts"),
+        })
+    return rows
+
+
+def load_bench_rows(bench_dir: str) -> list[dict]:
+    """Measured (features, K, steps/sec) rows from accumulated
+    BENCH_*.json artifacts.  Only self-contained rows are harvested —
+    today the partition bench's per-plan legs, which carry their own
+    ``zoo_hlo_*`` feature block next to the measured steps/sec."""
+    rows = []
+    try:
+        names = sorted(os.listdir(bench_dir))
+    except OSError:
+        return rows
+    for name in names:
+        if not (name.startswith("BENCH_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(bench_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for leg in (doc.get("legs") or {}).values():
+            hlo = leg.get("hlo") or {}
+            sps = leg.get("steps_per_sec")
+            if not hlo or not sps:
+                continue
+            rows.append({
+                "label": f"{name}:{leg.get('plan')}",
+                "features": normalize_features(hlo),
+                "k": 1,
+                "plan": leg.get("plan"),
+                "measured_steps_per_sec": float(sps),
+            })
+    return rows
+
+
+def load_tune_log_rows(tune_log_dir: str) -> list[dict]:
+    """Measured per-K rows from the autotuner's persisted decision
+    history (``ZOO_TUNE_LOG_DIR`` JSONL, feature/autotune.py): each
+    ``settle`` record carries the full measured cost curve
+    ``k_cost_per_step_s`` under the program's compile label — joined
+    with a report row's features by that label, each (K, cost) pair
+    becomes a training sample."""
+    rows = []
+    try:
+        names = sorted(os.listdir(tune_log_dir))
+    except OSError:
+        return rows
+    for name in names:
+        if ".jsonl" not in name:
+            continue
+        try:
+            with open(os.path.join(tune_log_dir, name)) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") != "settle":
+                continue
+            for k, cost in (rec.get("k_cost_per_step_s") or {}).items():
+                if not cost or float(cost) <= 0:
+                    continue
+                rows.append({
+                    "label": rec.get("label"),
+                    "k": int(k),
+                    "measured_steps_per_sec": 1.0 / float(cost),
+                })
+    return rows
+
+
+def training_rows(report_dir: str | None = None,
+                  bench_dir: str | None = None,
+                  tune_log_dir: str | None = None) -> list[dict]:
+    """The residual model's joined training set.  Bench legs are
+    self-contained; tune-log rows (measurement, no features) join with
+    the latest report row of the same compile label (features, no
+    measurement).  Unjoinable rows drop silently — with nothing
+    accumulated yet the result is [] and the caller's fit stays
+    analytic."""
+    report_dir = report_dir or os.environ.get("ZOO_HLO_REPORT_DIR")
+    tune_log_dir = tune_log_dir or os.environ.get("ZOO_TUNE_LOG_DIR")
+    rows = list(load_bench_rows(bench_dir)) if bench_dir else []
+    reports = load_report_rows(report_dir) if report_dir else []
+    by_label: dict[str, dict] = {}
+    for rpt in reports:  # later files win: freshest features per label
+        if rpt.get("label"):
+            by_label[rpt["label"]] = rpt
+    for rec in (load_tune_log_rows(tune_log_dir) if tune_log_dir else []):
+        rpt = by_label.get(rec.get("label"))
+        if rpt is None:
+            continue
+        rows.append({**rec, "features": rpt["features"],
+                     "plan": rpt.get("plan")})
+    return rows

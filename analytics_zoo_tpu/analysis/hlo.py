@@ -45,11 +45,18 @@ exactly what a cost model over the compiled graph consumes.
 
 Per compile the features land in the ``zoo_hlo_*`` registry metrics
 (scrapeable at ``/metrics`` and ``/varz``), in one ``hlo_lint`` flight-
-recorder event (a crash dump says what was compiled), and — when
-``ZOO_HLO_REPORT_DIR`` is set — in a JSON report file (schema
-``zoo-hlo-report/1``, documented in ``docs/static-analysis.md``).
-Disable the whole tier with ``ZOO_HLO_LINT=0``; the hook never raises
-into the compile path.
+recorder event (a crash dump says what was compiled), in a bounded
+in-process last-report-per-label cache (:func:`last_features` — the
+config oracle's feature source, deliberately independent of the
+metrics registry so predictions work under ``ZOO_METRICS=0``), and —
+when ``ZOO_HLO_REPORT_DIR`` is set — in a JSON report file (schema
+``zoo-hlo-report/2``: the v1 feature/finding payload plus compile
+wall-seconds, sharding-plan label, mesh axis shape, steps_per_dispatch
+K and a dtype histogram, so one report row is a self-contained
+cost-model training example; readers accept v1 with those fields
+null — documented in ``docs/static-analysis.md``).  Disable the whole
+tier with ``ZOO_HLO_LINT=0``; the hook never raises into the compile
+path.
 """
 
 from __future__ import annotations
@@ -68,7 +75,8 @@ from analytics_zoo_tpu.analysis.findings import Finding, Severity
 logger = logging.getLogger("analytics_zoo_tpu")
 
 __all__ = ["HloReport", "analyze_hlo_text", "lint_lowered",
-           "maybe_lint_lowered", "DEFAULT_CONSTANT_THRESHOLD"]
+           "maybe_lint_lowered", "maybe_write_report", "last_features",
+           "DEFAULT_CONSTANT_THRESHOLD"]
 
 #: constants larger than this (bytes) baked into the graph are findings
 DEFAULT_CONSTANT_THRESHOLD = 1 << 20
@@ -141,6 +149,13 @@ class HloReport:
     collectives: dict = field(default_factory=dict)
     op_histogram: dict = field(default_factory=dict)
     findings: list = field(default_factory=list)
+    # schema-v2 context (None/empty when the caller provided none —
+    # exactly what a v1 report deserializes to)
+    dtype_histogram: dict = field(default_factory=dict)
+    compile_seconds: float | None = None
+    plan: str | None = None
+    mesh_shape: dict | None = None
+    steps_per_dispatch: int | None = None
 
     def features(self) -> dict:
         """The flat feature dict exported to metrics / JSON — the cost-
@@ -156,7 +171,7 @@ class HloReport:
 
     def to_doc(self) -> dict:
         return {
-            "schema": "zoo-hlo-report/1",
+            "schema": "zoo-hlo-report/2",
             "label": self.label,
             "pid": os.getpid(),
             "ts": time.time(),
@@ -164,6 +179,14 @@ class HloReport:
             "collectives": dict(self.collectives),
             "op_histogram": dict(self.op_histogram),
             "findings": [f.to_dict() for f in self.findings],
+            # v2: the compile/config context that makes one report row
+            # a self-contained cost-model training example
+            "compile_seconds": self.compile_seconds,
+            "plan": self.plan,
+            "mesh_shape": dict(self.mesh_shape)
+            if self.mesh_shape else None,
+            "steps_per_dispatch": self.steps_per_dispatch,
+            "dtype_histogram": dict(self.dtype_histogram),
         }
 
 
@@ -244,6 +267,9 @@ def analyze_hlo_text(
 
         rpt.op_count += 1
         rpt.op_histogram[op] = rpt.op_histogram.get(op, 0) + 1
+        for t in operands + results:
+            rpt.dtype_histogram[t.dtype] = \
+                rpt.dtype_histogram.get(t.dtype, 0) + 1
         if op == "gather" and len(operands) >= 2 and results:
             # a gather reads the index vector and the GATHERED SLICES
             # (result-sized), not the whole operand — charging the full
@@ -354,6 +380,32 @@ def analyze_hlo_text(
 _report_seq = 0  # guarded-by: _report_lock
 _report_lock = threading.Lock()
 
+# Bounded last-report-per-label cache: the config oracle's feature
+# source.  Deliberately NOT the metrics registry — zoo_hlo_* gauges are
+# NULL children under ZOO_METRICS=0, and the oracle must still see the
+# compiled program's features then.
+_LAST_REPORTS_CAP = 64
+_last_lock = threading.Lock()
+_last_reports: dict = {}  # guarded-by: _last_lock  (label -> HloReport)
+
+
+def remember_report(rpt: HloReport) -> None:
+    """Retain ``rpt`` as the latest report for its label (bounded:
+    oldest label evicted past :data:`_LAST_REPORTS_CAP`)."""
+    with _last_lock:
+        _last_reports.pop(rpt.label, None)  # re-insert = move to end
+        _last_reports[rpt.label] = rpt
+        while len(_last_reports) > _LAST_REPORTS_CAP:
+            del _last_reports[next(iter(_last_reports))]
+
+
+def last_features(label: str) -> dict | None:
+    """The feature vector of the most recent compile under ``label``
+    (None when nothing compiled under it yet in this process)."""
+    with _last_lock:
+        rpt = _last_reports.get(label)
+    return rpt.features() if rpt is not None else None
+
 
 def _emit_metrics(rpt: HloReport) -> None:
     from analytics_zoo_tpu.metrics import get_registry
@@ -410,15 +462,28 @@ def _write_report(rpt: HloReport, report_dir: str) -> str | None:
 
 
 def lint_lowered(lowered, label: str = "module",
-                 report_dir: str | None = None) -> HloReport:
+                 report_dir: str | None = None,
+                 meta: dict | None = None,
+                 defer_report: bool = False) -> HloReport:
     """Analyze a ``jax.jit(f).lower(...)`` result: findings + features
     into metrics, the flight recorder and (optionally) a JSON report.
 
     ``report_dir`` defaults to ``ZOO_HLO_REPORT_DIR``; pass a path to
-    force a report, or rely on the env knob.
+    force a report, or rely on the env knob.  ``meta`` carries the
+    schema-v2 compile context the lowered text cannot show (``plan``,
+    ``mesh_shape``, ``steps_per_dispatch``).  ``defer_report=True``
+    skips the report write — :func:`timed_compile` uses it to lint
+    BEFORE compiling (the crash-dump contract: the flight ring must say
+    what was being compiled if the compile dies) and write the report
+    AFTER via :func:`maybe_write_report`, once the compile
+    wall-seconds exist.
     """
     text = lowered.as_text()
     rpt = analyze_hlo_text(text, label=label)
+    for key in ("plan", "mesh_shape", "steps_per_dispatch"):
+        if meta and meta.get(key) is not None:
+            setattr(rpt, key, meta[key])
+    remember_report(rpt)
     _emit_metrics(rpt)
 
     from analytics_zoo_tpu.metrics import get_flight_recorder
@@ -432,20 +497,44 @@ def lint_lowered(lowered, label: str = "module",
     for f in rpt.findings:
         logger.warning("hlo-lint[%s]: %s (%s)", label, f.message, f.rule)
 
-    report_dir = report_dir or os.environ.get("ZOO_HLO_REPORT_DIR")
-    if report_dir:
-        _write_report(rpt, report_dir)
+    if not defer_report:
+        report_dir = report_dir or os.environ.get("ZOO_HLO_REPORT_DIR")
+        if report_dir:
+            _write_report(rpt, report_dir)
     return rpt
 
 
-def maybe_lint_lowered(lowered, label: str = "module") \
-        -> HloReport | None:
+def maybe_write_report(rpt: HloReport | None,
+                       compile_seconds: float | None = None,
+                       report_dir: str | None = None) -> str | None:
+    """The deferred second half of a ``defer_report=True`` lint: stamp
+    the measured compile wall-seconds onto the report and write it if
+    ``ZOO_HLO_REPORT_DIR`` (or ``report_dir``) asks for one.  Safe on
+    None (lint disabled/failed) and never raises."""
+    if rpt is None:
+        return None
+    try:
+        if compile_seconds is not None:
+            rpt.compile_seconds = float(compile_seconds)
+        report_dir = report_dir or os.environ.get("ZOO_HLO_REPORT_DIR")
+        if report_dir:
+            return _write_report(rpt, report_dir)
+    except Exception:  # reports are best-effort, like the lint itself
+        logger.debug("hlo report write failed for %s", rpt.label,
+                     exc_info=True)
+    return None
+
+
+def maybe_lint_lowered(lowered, label: str = "module",
+                       meta: dict | None = None,
+                       defer_report: bool = False) -> HloReport | None:
     """The guarded entry :func:`timed_compile` calls: no-op under
     ``ZOO_HLO_LINT=0``, and NEVER raises into the compile path."""
     if os.environ.get("ZOO_HLO_LINT", "1") == "0":
         return None
     try:
-        return lint_lowered(lowered, label)
+        return lint_lowered(lowered, label, meta=meta,
+                            defer_report=defer_report)
     except Exception:  # the lint must never take a compile down
         logger.debug("hlo lint failed for %s", label, exc_info=True)
         return None

@@ -1,0 +1,305 @@
+"""ConfigOracle — the predictive compile plane's decision surface.
+
+:mod:`analytics_zoo_tpu.analysis.costmodel` predicts; this module
+DECIDES and is wired in as the prior for the two consumers that used
+to search blind:
+
+- the autotuner's K hill-climb (feature/autotune.py) calls
+  :meth:`ConfigOracle.predict_k` after the first compiled dispatch and
+  jumps straight to the predicted ``steps_per_dispatch``, demoting the
+  ladder sweep to a ±1-neighbor validation pass — ≤8 dispatches to
+  settle instead of ~53 (BENCH_AUTOTUNE_r08), trajectory still
+  bitwise-equal because per-inner-step RNG folds on the global step
+  index regardless of the K schedule;
+- ``estimator.fit(plan="auto")`` calls :meth:`ConfigOracle.choose_plan`
+  to pick among dp/zero1/fsdp/tp from predicted per-chip bytes vs the
+  HBM budget, preferring the least-collective-traffic plan that fits.
+
+Every prediction→outcome pair is logged three ways (the autotune
+convention): the ``zoo_oracle_*`` metric family, an ``oracle`` flight
+event, and a bounded predicted-vs-measured table served at ``/varz``
+(rendered by ``tools/metrics_dump.py``) — closing the data loop the
+residual model trains on.  Opt-out: ``ZOO_ORACLE=0`` restores the
+blind sweep everywhere.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+import weakref
+from typing import Iterable, Mapping, Sequence
+
+from analytics_zoo_tpu.analysis.costmodel import (
+    PeakTable,
+    ResidualModel,
+    plan_collective_bytes,
+    predict_chip_bytes,
+    predict_steps_per_sec,
+    resolve_peaks,
+    training_rows,
+)
+from analytics_zoo_tpu.metrics import (
+    OracleMetrics,
+    get_flight_recorder,
+)
+
+__all__ = ["ConfigOracle", "oracle_enabled", "varz_doc"]
+
+#: plans the oracle can choose among for ``plan="auto"`` — tensor
+#: parallelism needs a model-specific rule table, so it participates in
+#: ranking only when the caller passes it explicitly
+DEFAULT_PLAN_CANDIDATES = ("dp", "zero1", "fsdp")
+
+#: a prediction within this margin of the best is "as good" — ties go
+#: to the smaller K (finer checkpoint cadence), mirroring the
+#: autotuner's own k_margin settle rule
+PREDICT_MARGIN = 0.05
+
+
+def oracle_enabled() -> bool:
+    """``ZOO_ORACLE`` gate (default ON — the oracle only reorders
+    searches, it never changes results; ``0``/``false``/``off``
+    restores the blind sweep)."""
+    return os.environ.get("ZOO_ORACLE", "1").strip().lower() not in (
+        "0", "false", "off")
+
+
+# ---------------------------------------------------------------------------
+# Live-oracle registry: /varz (metrics/http.py) includes the
+# predicted-vs-measured tables of whatever oracles exist, via
+# sys.modules only — metrics-only processes never import this module.
+# ---------------------------------------------------------------------------
+
+_active_lock = threading.Lock()
+_active: "weakref.WeakSet[ConfigOracle]" = (  # guarded-by: _active_lock
+    weakref.WeakSet())
+
+
+def varz_doc() -> dict:
+    """The ``oracle`` section of ``/varz``: every live oracle's peak
+    table, residual-fit size, and merged time-ordered
+    prediction→outcome log."""
+    with _active_lock:
+        oracles = list(_active)
+    docs = [o.to_doc() for o in oracles]
+    predictions = sorted(
+        (p for doc in docs for p in doc["predictions"]),
+        key=lambda p: p["ts"])
+    return {"oracles": docs, "predictions": predictions}
+
+
+class ConfigOracle:
+    """Ranks candidate (K, sharding plan) configs from the analytic
+    roofline, corrected by the fitted residual once enough outcome
+    history exists.
+
+    One oracle serves one process; build with :meth:`from_env` to get
+    platform-resolved peaks and a residual fitted from whatever
+    ``ZOO_HLO_REPORT_DIR`` / ``ZOO_TUNE_LOG_DIR`` history has
+    accumulated.  All prediction state is lock-guarded — the autotuner
+    consults it from the estimator loop while /varz snapshots it from
+    the HTTP thread."""
+
+    def __init__(self, peaks: PeakTable | None = None,
+                 residual: ResidualModel | None = None,
+                 registry=None, log_capacity: int = 256):
+        self.peaks = peaks if peaks is not None else resolve_peaks()
+        self.residual = residual if residual is not None else \
+            ResidualModel(peaks=self.peaks)
+        self.metrics = OracleMetrics(registry=registry)
+        self._lock = threading.Lock()
+        # config key -> the latest prediction record for it (outcome
+        # fields filled in when record_outcome closes the pair)
+        self._pairs: "collections.OrderedDict[str, dict]" = (  # guarded-by: _lock
+            collections.OrderedDict())
+        self._log_capacity = int(log_capacity)
+        self.metrics.fit_samples.set(self.residual.n_samples)
+        with _active_lock:
+            _active.add(self)
+
+    # ------------------------------------------------------------------
+    # construction from the env tier
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_env(cls, registry=None) -> "ConfigOracle":
+        """Platform-resolved peaks (device kind when jax is up,
+        ``ZOO_ORACLE_PEAKS`` override last) + a residual model fitted
+        from the accumulated report/tune-log history — analytic-only
+        when nothing has accumulated yet."""
+        platform = kind = None
+        try:
+            import jax
+
+            devices = jax.devices()
+            if devices:
+                platform = devices[0].platform
+                kind = devices[0].device_kind
+        except Exception:
+            pass
+        oracle = cls(peaks=resolve_peaks(platform, kind),
+                     registry=registry)
+        oracle.refit()
+        return oracle
+
+    def refit(self, rows: Iterable[Mapping] | None = None) -> int:
+        """(Re)fit the residual from ``rows``, or from the env-dir
+        history (``ZOO_HLO_REPORT_DIR`` joined with ``ZOO_TUNE_LOG_DIR``)
+        when not given.  Returns the fitted sample count — 0 means the
+        oracle stays analytic."""
+        rows = list(rows) if rows is not None else training_rows()
+        self.residual.fit(rows)
+        self.metrics.fit_samples.set(self.residual.n_samples)
+        return self.residual.n_samples
+
+    # ------------------------------------------------------------------
+    # prediction surface
+    # ------------------------------------------------------------------
+    def predict_steps_per_sec(self, features: Mapping, k: int = 1) -> float:
+        """Fitted prediction when the residual is ready, pure analytic
+        roofline otherwise — callers never branch on readiness."""
+        return self.residual.predict_steps_per_sec(features, k=k)
+
+    def predict_k(self, features: Mapping,
+                  k_candidates: Sequence[int]) -> int:
+        """The ``steps_per_dispatch`` the autotuner should START at:
+        smallest candidate whose predicted steps/sec is within
+        :data:`PREDICT_MARGIN` of the best (the autotuner's own settle
+        tie-break).  Predictions for EVERY candidate are logged, so
+        whatever K the ±1 validation pass settles on has a recorded
+        prediction to score against."""
+        preds = {int(k): self.predict_steps_per_sec(features, k=k)
+                 for k in k_candidates}
+        best = max(preds.values())
+        k_hat = min(k for k, sps in preds.items()
+                    if sps >= best * (1.0 - PREDICT_MARGIN))
+        now = time.time()
+        with self._lock:
+            for k, sps in sorted(preds.items()):
+                self._remember_locked({
+                    "ts": now, "consumer": "autotune_k",
+                    "config": f"k={k}", "predicted_steps_per_sec": sps,
+                    "chosen": k == k_hat,
+                    "measured_steps_per_sec": None, "rel_error": None})
+        self.metrics.predictions.labels(consumer="autotune_k").inc()
+        self.metrics.predicted_sps.labels(
+            config=f"k={k_hat}").set(preds[k_hat])
+        get_flight_recorder().record(
+            "oracle", consumer="autotune_k", config=f"k={k_hat}",
+            predicted_steps_per_sec=round(preds[k_hat], 3),
+            fit_samples=self.residual.n_samples)
+        return k_hat
+
+    def choose_plan(self, param_bytes: int, opt_bytes: int,
+                    n_shards: int, hbm_budget: int | None = None,
+                    features: Mapping | None = None,
+                    plans: Sequence[str] = DEFAULT_PLAN_CANDIDATES,
+                    batch_bytes: int = 0) -> tuple[str, dict]:
+        """The sharding plan ``plan="auto"`` resolves to: among the
+        candidate plans whose predicted per-chip bytes fit the HBM
+        budget, the one whose predicted step time (roofline + the
+        plan's per-step collective traffic over the link ceiling) is
+        lowest — i.e. the least-sharded feasible plan, since sharding
+        only adds collectives.  Ties keep candidate order.  Returns
+        ``(plan_name, doc)`` where the doc records every candidate's
+        predicted bytes/traffic/feasibility for the artifact trail.
+        Infeasible-everywhere falls back to the most memory-frugal
+        candidate (training may still OOM, but that plan is the only
+        one with a chance)."""
+        budget = int(hbm_budget) if hbm_budget else int(self.peaks.hbm_bytes)
+        feats = features or {}
+        base_s = 1.0 / self.predict_steps_per_sec(feats, k=1)
+        candidates = []
+        for plan in plans:
+            chip = predict_chip_bytes(param_bytes, opt_bytes, plan,
+                                      n_shards, batch_bytes=batch_bytes)
+            coll = plan_collective_bytes(param_bytes, plan, n_shards)
+            step_s = base_s + coll / max(self.peaks.link_bytes_per_s, 1.0)
+            candidates.append({
+                "plan": plan, "predicted_chip_bytes": chip,
+                "predicted_collective_bytes_per_step": coll,
+                "predicted_steps_per_sec": round(1.0 / step_s, 3),
+                "fits_budget": chip <= budget})
+        feasible = [c for c in candidates if c["fits_budget"]]
+        pool = feasible or sorted(
+            candidates, key=lambda c: c["predicted_chip_bytes"])[:1]
+        chosen = max(pool, key=lambda c: c["predicted_steps_per_sec"])
+        doc = {"chosen": chosen["plan"], "hbm_budget_bytes": budget,
+               "n_shards": int(n_shards), "param_bytes": int(param_bytes),
+               "opt_bytes": int(opt_bytes), "candidates": candidates,
+               "feasible": bool(feasible)}
+        now = time.time()
+        with self._lock:
+            for c in candidates:
+                self._remember_locked({
+                    "ts": now, "consumer": "plan_auto",
+                    "config": f"plan={c['plan']}",
+                    "predicted_steps_per_sec":
+                        c["predicted_steps_per_sec"],
+                    "chosen": c["plan"] == chosen["plan"],
+                    "measured_steps_per_sec": None, "rel_error": None})
+        self.metrics.predictions.labels(consumer="plan_auto").inc()
+        self.metrics.predicted_sps.labels(
+            config=f"plan={chosen['plan']}").set(
+                chosen["predicted_steps_per_sec"])
+        get_flight_recorder().record(
+            "oracle", consumer="plan_auto", config=f"plan={chosen['plan']}",
+            chip_bytes=chosen["predicted_chip_bytes"],
+            hbm_budget=budget, feasible=bool(feasible))
+        return chosen["plan"], doc
+
+    # ------------------------------------------------------------------
+    # the outcome half of the data loop
+    # ------------------------------------------------------------------
+    def record_outcome(self, config: str, measured_steps_per_sec: float,
+                       consumer: str = "") -> dict | None:
+        """Close a prediction→outcome pair: the consumer reports what
+        the config actually measured (the autotuner at K settle, the
+        bench per plan leg).  Returns the closed pair (or None when no
+        prediction was recorded for ``config`` — outcome still logged,
+        error unknowable)."""
+        measured = float(measured_steps_per_sec)
+        with self._lock:
+            pair = self._pairs.get(config)
+            if pair is not None:
+                pair["measured_steps_per_sec"] = measured
+                predicted = pair["predicted_steps_per_sec"]
+                pair["rel_error"] = round(
+                    abs(predicted - measured) / max(measured, 1e-12), 4)
+                pair = dict(pair)
+        self.metrics.measured_sps.labels(config=config).set(measured)
+        if pair is not None:
+            self.metrics.rel_error.labels(config=config).set(
+                pair["rel_error"])
+        get_flight_recorder().record(
+            "oracle", consumer=consumer or "outcome", config=config,
+            measured_steps_per_sec=round(measured, 3),
+            rel_error=pair["rel_error"] if pair else None)
+        return pair
+
+    def _remember_locked(self, record: dict) -> None:
+        """Insert/refresh one prediction record under the bounded
+        per-config table; called with the lock held."""
+        # zoolint: disable=guarded-by -- _locked suffix: callers hold _lock across this call
+        self._pairs[record["config"]] = record
+        self._pairs.move_to_end(record["config"])
+        while len(self._pairs) > self._log_capacity:
+            # zoolint: disable=guarded-by -- _locked suffix: callers hold _lock across this call
+            self._pairs.popitem(last=False)
+
+    # ------------------------------------------------------------------
+    # introspection (/varz, metrics_dump, benches)
+    # ------------------------------------------------------------------
+    def prediction_log(self) -> list[dict]:
+        with self._lock:
+            return [dict(p) for p in self._pairs.values()]
+
+    def to_doc(self) -> dict:
+        return {
+            "peaks": self.peaks.to_doc(),
+            "fit_samples": self.residual.n_samples,
+            "residual_ready": self.residual.ready,
+            "predictions": self.prediction_log(),
+        }

@@ -163,7 +163,7 @@ def _metrics(label: str):
     )
 
 
-def timed_compile(lowered, label: str):
+def timed_compile(lowered, label: str, meta: dict | None = None):
     """``lowered.compile()`` with the compile plane's telemetry.
 
     Records ``zoo_compile_seconds{label=}`` and increments the
@@ -179,18 +179,28 @@ def timed_compile(lowered, label: str):
     flight recorder and the optional ``ZOO_HLO_REPORT_DIR`` JSON
     report.  Linting before compiling means a crash during XLA
     compilation still leaves "what was being compiled" in the flight
-    ring.  Disable with ``ZOO_HLO_LINT=0``; lint errors never
-    propagate into the compile.
+    ring; the JSON report alone is written AFTER the compile so the
+    ``zoo-hlo-report/2`` row carries the measured compile
+    wall-seconds.  ``meta`` is the compile context the lowered text
+    cannot show (``plan`` / ``mesh_shape`` / ``steps_per_dispatch``),
+    stamped into the report for the cost model's training join.
+    Disable with ``ZOO_HLO_LINT=0``; lint errors never propagate into
+    the compile.
     """
-    from analytics_zoo_tpu.analysis.hlo import maybe_lint_lowered
+    from analytics_zoo_tpu.analysis.hlo import (
+        maybe_lint_lowered,
+        maybe_write_report,
+    )
 
-    maybe_lint_lowered(lowered, label)
+    rpt = maybe_lint_lowered(lowered, label, meta=meta,
+                             defer_report=True)
     hist, hits, misses = _metrics(label)
     before = _cache_entries()
     t0 = time.perf_counter()
     exe = lowered.compile()
     dt = time.perf_counter() - t0
     hist.observe(dt)
+    maybe_write_report(rpt, compile_seconds=dt)
     after = _cache_entries()
     # A true hit deserializes an EXISTING entry, so the dir must be
     # non-empty and unchanged.  (Residual blind spot: a cache dir whose

@@ -166,9 +166,32 @@ class ZooConfig:
                                (analysis/hlo.py; default on — zoo_hlo_*
                                metrics, flight hlo_lint events)
       ZOO_HLO_REPORT_DIR       when set, every compile additionally
-                               writes a zoo-hlo-report/1 JSON file with
-                               the analytic features + findings
-                               (docs/static-analysis.md)
+                               writes a zoo-hlo-report/2 JSON file with
+                               the analytic features + findings plus
+                               compile wall-seconds, plan, mesh shape,
+                               K and a dtype histogram — one row is a
+                               self-contained cost-model training
+                               example (docs/static-analysis.md)
+      ZOO_ORACLE               "0" disables the predictive compile
+                               plane (analysis/oracle.py; default on):
+                               the autotuner's K search falls back to
+                               the blind hill-climb (plan="auto" still
+                               predicts — it is an explicit request)
+      ZOO_ORACLE_PEAKS         JSON object overriding PeakTable fields
+                               (flops, hbm_bytes_per_s,
+                               link_bytes_per_s, dispatch_overhead_s,
+                               hbm_bytes) over the per-platform
+                               defaults — calibrate the roofline, or
+                               pin the HBM budget plan="auto" fits
+                               against (docs/performance.md)
+      ZOO_TUNE_LOG_DIR         when set, the autotuner persists its
+                               decision log there as JSONL (decision +
+                               settle records; the settle rows carry
+                               the measured per-K cost curve the
+                               oracle's residual model trains on);
+                               size-capped by ZOO_TUNE_LOG_MAX_BYTES
+                               (default 4M) with one rotated
+                               predecessor
       ZOO_SAN                  "1": install the runtime concurrency
                                sanitizer at package import — wraps the
                                package's locks (lockdep cycle detection
@@ -343,10 +366,11 @@ class ZooConfig:
             # first fit()
             from analytics_zoo_tpu.parallel.plan import PLAN_NAMES
 
-            if str(self.sharding_plan).strip().lower() not in PLAN_NAMES:
+            valid = tuple(PLAN_NAMES) + ("auto",)
+            if str(self.sharding_plan).strip().lower() not in valid:
                 raise ValueError(
                     f"ZOO_SHARDING_PLAN must be one of "
-                    f"{', '.join(PLAN_NAMES)}; got {self.sharding_plan!r}")
+                    f"{', '.join(valid)}; got {self.sharding_plan!r}")
         self.dcn_axis = resolve(
             self.dcn_axis, "ZOO_DCN_AXIS", None, cast=str)
         if self.dcn_axis is not None and not str(self.dcn_axis).strip():

@@ -24,12 +24,26 @@ Every signal needed is already exported — this module closes the loop:
   of the best per-step time.  Safe to explore online: per-inner-step RNG
   folds on the GLOBAL step index, so the loss trajectory is bit-identical
   regardless of the K sequence (the PR-4 contract).
+- With a :class:`~analytics_zoo_tpu.analysis.oracle.ConfigOracle`
+  attached (``oracle=`` / :meth:`from_config` under ``ZOO_ORACLE``,
+  the default), the hill-climb starts from PREDICTION instead of from
+  K=1: after the first compiled dispatch the controller reads the
+  program's HLO features, jumps to the oracle's predicted K, and
+  demotes the ladder sweep to a ±1-neighbor validation pass — ≤8
+  dispatches to settle instead of ~53 (BENCH_ORACLE_r11 vs
+  BENCH_AUTOTUNE_r08), same bitwise trajectory.  The settle outcome
+  feeds back to the oracle (predicted-vs-measured), closing the loop.
 
 Every decision is recorded three ways so a bad tune is diagnosable
 post-mortem: the ``zoo_autotune_*`` metric family (current knob gauges +
 a decision counter labeled knob/reason), an ``autotune`` flight-recorder
 event, and a bounded structured decision log served at ``/varz`` (and
-rendered as a table by ``tools/metrics_dump.py``).
+rendered as a table by ``tools/metrics_dump.py``).  Set
+``ZOO_TUNE_LOG_DIR`` to additionally PERSIST the log as JSONL (one
+``decision`` record per knob change + one ``settle`` record carrying
+the full measured per-K cost curve; size-capped via
+``ZOO_TUNE_LOG_MAX_BYTES`` with one rotated predecessor) — the decision
+history the oracle's residual model trains on across restarts.
 
 Opt-in: ``ZOO_AUTOTUNE=1`` (or ``Estimator.train(..., autotune=True)``).
 Unset, nothing here is imported, no thread exists, and the hot paths are
@@ -40,6 +54,7 @@ exactly the static-knob code (pinned by test, the ``ZOO_SAN`` /
 from __future__ import annotations
 
 import collections
+import json
 import os
 import threading
 import time
@@ -75,6 +90,44 @@ DEFAULT_RAM_BUDGET = 2 << 30
 _active_lock = threading.Lock()
 _active: "weakref.WeakSet[AutotuneController]" = (  # guarded-by: _active_lock
     weakref.WeakSet())
+
+# ---------------------------------------------------------------------------
+# Persistent decision log (ZOO_TUNE_LOG_DIR): the in-memory bounded log
+# survives only until process exit — this JSONL file is the outcome
+# history the config oracle's residual model trains on across restarts.
+# ---------------------------------------------------------------------------
+
+DEFAULT_TUNE_LOG_MAX_BYTES = 4 << 20
+
+_tune_log_lock = threading.Lock()
+
+
+def _append_tune_log(record: dict) -> None:
+    """Append one JSONL record to ``ZOO_TUNE_LOG_DIR/tune-<pid>.jsonl``
+    (no-op when the env is unset).  Size-capped: past
+    ``ZOO_TUNE_LOG_MAX_BYTES`` the file rotates to ``.1`` (one
+    predecessor kept) so an always-on training job cannot grow the log
+    unboundedly.  Best-effort — a full disk must never take tuning
+    down."""
+    log_dir = os.environ.get("ZOO_TUNE_LOG_DIR")
+    if not log_dir:
+        return
+    try:
+        line = json.dumps(record) + "\n"
+        cap = int(os.environ.get("ZOO_TUNE_LOG_MAX_BYTES",
+                                 DEFAULT_TUNE_LOG_MAX_BYTES))
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, f"tune-{os.getpid()}.jsonl")
+        with _tune_log_lock:
+            try:
+                if os.path.getsize(path) + len(line) > cap:
+                    os.replace(path, path + ".1")
+            except OSError:
+                pass  # no file yet, or rotation raced a cleanup
+            with open(path, "a") as f:
+                f.write(line)
+    except (OSError, ValueError, TypeError):
+        return
 
 
 def varz_doc() -> dict:
@@ -122,7 +175,10 @@ class AutotuneController:
                  k_warm_skip: int = 3,
                  k_margin: float = 0.05,
                  registry: MetricsRegistry | None = None,
-                 log_capacity: int = 256):
+                 log_capacity: int = 256,
+                 oracle=None,
+                 k_prior_warm_skip: int = 1,
+                 k_prior_samples: int = 2):
         self.ram_budget = int(ram_budget) if ram_budget else \
             DEFAULT_RAM_BUDGET
         self.interval = float(interval)
@@ -138,6 +194,13 @@ class AutotuneController:
         self.k_samples = int(k_samples)
         self.k_warm_skip = int(k_warm_skip)
         self.k_margin = float(k_margin)
+        # oracle prior (analysis/oracle.py): when attached, the first
+        # observed dispatch consults it and the sweep becomes a ±1
+        # validation pass with a TIGHTER measurement window — the
+        # prediction already absorbed the risk a long window hedges
+        self.oracle = oracle
+        self.k_prior_warm_skip = int(k_prior_warm_skip)
+        self.k_prior_samples = int(k_prior_samples)
         cands = sorted(set(int(k) for k in k_candidates) | {int(start_k)})
         self.k_candidates = tuple(cands)
 
@@ -165,10 +228,22 @@ class AutotuneController:
         # K hill-climb state
         self._k = int(start_k)  # guarded-by: _lock
         self._k_settled = False  # guarded-by: _lock
+        # prior-mode state: the compile label whose HLO features feed
+        # the oracle, whether the prior was consulted yet, and the
+        # remaining validation candidates (None = blind hill-climb)
+        self._feature_label: str | None = None  # guarded-by: _lock
+        self._prior_consulted = False  # guarded-by: _lock
+        self._k_validate: list | None = None  # guarded-by: _lock
+        self._k_prior_hint: int | None = None  # guarded-by: _lock
         self._k_skip: dict[int, int] = {}  # guarded-by: _lock
         self._k_times: dict[int, list] = {}  # guarded-by: _lock
         self._k_cost: dict[int, float] = {}  # guarded-by: _lock
         self.dispatches_observed = 0  # guarded-by: _lock
+        # dispatches observed AT the tuner's current K — in-flight
+        # chunks queued before a switch keep their old size (see
+        # _chunk_batches_dynamic) and are pipeline latency, not tuning
+        # observations; k_settle_dispatch counts search cost only
+        self.tuning_dispatches = 0  # guarded-by: _lock
         self.k_settle_dispatch: int | None = None  # guarded-by: _lock
         self._decisions: collections.deque = (  # guarded-by: _lock
             collections.deque(maxlen=int(log_capacity)))
@@ -185,15 +260,37 @@ class AutotuneController:
     # construction from the env tier
     # ------------------------------------------------------------------
     @classmethod
-    def from_config(cls, cfg) -> "AutotuneController":
+    def from_config(cls, cfg, oracle=None) -> "AutotuneController":
         """Build from a :class:`~analytics_zoo_tpu.common.engine.ZooConfig`
-        (the ``ZOO_AUTOTUNE_*`` env tier)."""
+        (the ``ZOO_AUTOTUNE_*`` env tier).  Unless ``ZOO_ORACLE=0`` (or
+        an explicit ``oracle`` is given), a
+        :class:`~analytics_zoo_tpu.analysis.oracle.ConfigOracle` is
+        built from the env so the K search starts from prediction."""
+        if oracle is None:
+            try:
+                from analytics_zoo_tpu.analysis.oracle import (
+                    ConfigOracle,
+                    oracle_enabled,
+                )
+
+                if oracle_enabled():
+                    oracle = ConfigOracle.from_env()
+            except Exception:  # a broken prior must never block tuning
+                oracle = None
         return cls(
             ram_budget=cfg.autotune_ram_budget,
             interval=cfg.autotune_interval,
             max_workers=cfg.autotune_max_workers,
             start_k=int(cfg.steps_per_dispatch or 1),
+            oracle=oracle,
         )
+
+    def set_feature_label(self, label: str) -> None:
+        """Name the compile label whose HLO features the oracle prior
+        reads (the estimator calls this with the train step's label
+        once the plan/K tag is known)."""
+        with self._lock:
+            self._feature_label = str(label)
 
     # ------------------------------------------------------------------
     # pipeline attachment (PrefetchFeatureSet.batches)
@@ -373,13 +470,15 @@ class AutotuneController:
             self.metrics.read_ahead.set(int(read_ahead))
 
     def _record_decision(self, knob: str, old, new, reason: str):
+        record = {"ts": time.time(), "knob": knob, "old": old,
+                  "new": new, "reason": reason}
         with self._lock:
-            self._decisions.append({
-                "ts": time.time(), "knob": knob, "old": old, "new": new,
-                "reason": reason})
+            self._decisions.append(dict(record))
         self.metrics.decisions.labels(knob=knob, reason=reason).inc()
         get_flight_recorder().record(
             "autotune", knob=knob, old=old, new=new, reason=reason)
+        _append_tune_log({**record, "type": "decision",
+                          "pid": os.getpid()})
 
     # ------------------------------------------------------------------
     # fused-dispatch K (driven inline by the estimator loop)
@@ -397,19 +496,31 @@ class AutotuneController:
         ``k_warm_skip`` warm dispatches paying the new program's
         compile), then either probe the next candidate up — while the
         current K is still the best seen — or settle on the smallest K
-        within ``k_margin`` of the best per-step time."""
+        within ``k_margin`` of the best per-step time.
+
+        With an oracle attached, the FIRST observed dispatch (the
+        compiled program's features now exist) consults the prior
+        instead: jump to the predicted K and validate only its ±1
+        ladder neighbors, with the tighter ``k_prior_*`` window."""
+        self._maybe_consult_prior()
         decision = None
+        settled = None
         with self._lock:
             self.dispatches_observed += 1
             if self._k_settled or nk != self._k:
                 return  # settled, or a stale chunk from before a switch
+            self.tuning_dispatches += 1
             k = self._k
-            if self._k_skip.get(k, 0) < self.k_warm_skip:
+            prior_mode = self._k_validate is not None
+            warm = self.k_prior_warm_skip if prior_mode \
+                else self.k_warm_skip
+            if self._k_skip.get(k, 0) < warm:
                 self._k_skip[k] = self._k_skip.get(k, 0) + 1
                 return
             times = self._k_times.setdefault(k, [])
             times.append(step_s / max(nk, 1))
-            if len(times) < self.k_samples:
+            if len(times) < (self.k_prior_samples if prior_mode
+                             else self.k_samples):
                 return
             # mean over the window = window wall time / steps = inverse
             # THROUGHPUT, the quantity being tuned.  Neither min nor
@@ -420,10 +531,21 @@ class AutotuneController:
             # remaining contiguous window averages to the true rate.
             self._k_cost[k] = sum(times) / len(times)
             decision = self._advance_k_locked(k)
+            if self._k_settled:
+                settled = {
+                    "k": self._k,
+                    "cost": self._k_cost.get(self._k),
+                    "costs": {str(c): round(v, 9) for c, v
+                              in sorted(self._k_cost.items())},
+                    "label": self._feature_label,
+                    "dispatch": self.k_settle_dispatch,
+                }
         if decision is not None:
             old, new, reason = decision
             self._record_decision("k", old, new, reason)
             self.metrics.k.set(new)
+        if settled is not None:
+            self._publish_settle(settled)
 
     def _advance_k_locked(self, k: int):
         """Next hill-climb move; called with the lock held, returns the
@@ -434,6 +556,31 @@ class AutotuneController:
         # smaller K (finer checkpoint/validation cadence for free)
         best_k = min(c for c, m in costs.items()
                      if m <= best_cost * (1.0 + self.k_margin))
+        if self._k_validate is not None:
+            # oracle-prior mode: walk the fixed validation list (the
+            # predicted K and its ladder neighbors), then settle on the
+            # best measured — no probing beyond it.  Within the margin
+            # the measurements cannot distinguish candidates, so the
+            # tie goes to the PREDICTED K (the analytic ranking breaks
+            # the tie), not the smallest — a noisy 2-sample validation
+            # window must not drag the settle off a sound prediction.
+            # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
+            self._k_validate = [c for c in self._k_validate if c != k]
+            if self._k_validate:
+                # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
+                self._k = self._k_validate[0]
+                return (k, self._k, "validate_neighbor")
+            within = {c for c, m in costs.items()
+                      if m <= best_cost * (1.0 + self.k_margin)}
+            if self._k_prior_hint in within:
+                best_k = self._k_prior_hint
+            # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
+            self._k = best_k
+            # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
+            self._k_settled = True
+            # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
+            self.k_settle_dispatch = self.tuning_dispatches
+            return (k, best_k, "settled") if best_k != k else None
         i = self.k_candidates.index(k)
         if k == best_k and i + 1 < len(self.k_candidates):
             # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
@@ -446,8 +593,69 @@ class AutotuneController:
         # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
         self._k_settled = True
         # zoolint: disable=guarded-by -- _locked suffix: observe_dispatch holds _lock across this call
-        self.k_settle_dispatch = self.dispatches_observed
+        self.k_settle_dispatch = self.tuning_dispatches
         return (k, best_k, "settled") if best_k != k else None
+
+    def _maybe_consult_prior(self) -> None:
+        """One-shot oracle consult at the first observed dispatch: the
+        K=1 program has compiled by then, so its HLO features exist in
+        the analysis tier's last-report cache.  On a usable prediction,
+        jump to the predicted K and arm the ±1 validation list; on any
+        failure (no label, nothing compiled, oracle error) the blind
+        hill-climb proceeds untouched."""
+        oracle = self.oracle
+        if oracle is None:
+            return
+        with self._lock:
+            if self._prior_consulted or self._k_settled:
+                return
+            self._prior_consulted = True
+            label = self._feature_label
+        features = None
+        if label:
+            try:
+                from analytics_zoo_tpu.analysis.hlo import last_features
+
+                features = last_features(label)
+            except Exception:
+                features = None
+        if features is None:
+            return
+        try:
+            k_hat = int(oracle.predict_k(features, self.k_candidates))
+            i = self.k_candidates.index(k_hat)
+        except Exception:
+            return  # a broken prior must never take the loop down
+        neighbors = [self.k_candidates[j] for j in (i - 1, i + 1)
+                     if 0 <= j < len(self.k_candidates)]
+        with self._lock:
+            if self._k_settled:
+                return
+            old = self._k
+            self._k_validate = [k_hat] + neighbors
+            self._k_prior_hint = k_hat
+            self._k = k_hat
+        if k_hat != old:
+            self._record_decision("k", old, k_hat, "oracle_prior")
+            self.metrics.k.set(k_hat)
+
+    def _publish_settle(self, settled: dict) -> None:
+        """Outside-lock settle fan-out: the persistent tune-log record
+        (the oracle's cross-restart training join: label + the full
+        measured cost curve) and the prediction→outcome closure."""
+        _append_tune_log({
+            "ts": time.time(), "type": "settle", "pid": os.getpid(),
+            "label": settled["label"], "k": settled["k"],
+            "k_cost_per_step_s": settled["costs"],
+            "dispatches": settled["dispatch"],
+        })
+        if self.oracle is not None and settled["cost"]:
+            try:
+                self.oracle.record_outcome(
+                    f"k={settled['k']}", 1.0 / settled["cost"],
+                    consumer="autotune_k")
+            except Exception:
+                pass  # outcome bookkeeping must never take the loop down
 
     @property
     def k_settled(self) -> bool:
@@ -473,6 +681,7 @@ class AutotuneController:
                     for kk, v in sorted(self._k_cost.items())},
                 "ram_budget_bytes": self.ram_budget,
                 "dispatches_observed": self.dispatches_observed,
+                "tuning_dispatches": self.tuning_dispatches,
                 "k_settle_dispatch": self.k_settle_dispatch,
             }
 

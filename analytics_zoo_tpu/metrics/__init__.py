@@ -68,6 +68,7 @@ from analytics_zoo_tpu.metrics.runtime import (
     AutotuneMetrics,
     DataPipelineMetrics,
     FleetMetrics,
+    OracleMetrics,
     ServingMetrics,
     StepMetrics,
     record_device_memory,
@@ -87,7 +88,8 @@ __all__ = [
     "write_jsonl", "TensorBoardExporter",
     "sanitize_metric_name", "sanitize_label_name",
     "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
-    "AutotuneMetrics", "FleetMetrics", "record_device_memory",
+    "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
+    "record_device_memory",
     "MetricsServer", "maybe_start_from_env",
     "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
     "HealthRegistry", "get_health", "set_health",

@@ -184,6 +184,11 @@ class MetricsServer:
         fleet = sys.modules.get("analytics_zoo_tpu.serving.fleet")
         if fleet is not None:
             doc["fleet"] = fleet.varz_doc()
+        # Oracle panel (analysis/oracle.py): peak table, residual-fit
+        # size and the predicted-vs-measured pairs per config.
+        oracle = sys.modules.get("analytics_zoo_tpu.analysis.oracle")
+        if oracle is not None:
+            doc["oracle"] = oracle.varz_doc()
         if self.aggregator is not None:
             agg = self.aggregator.merged(include_driver=False)
             doc["aggregate"] = {"sources": agg["sources"],

@@ -30,7 +30,8 @@ from analytics_zoo_tpu.metrics.registry import (
 )
 
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
-           "AutotuneMetrics", "FleetMetrics", "record_device_memory"]
+           "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
+           "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -215,6 +216,46 @@ class AutotuneMetrics:
             "zoo_autotune_decisions_total",
             "autotune knob changes, by knob and reason",
             labelnames=("knob", "reason"))
+
+
+class OracleMetrics:
+    """Predictive compile-plane telemetry (``zoo_oracle_*``,
+    analysis/oracle.py).
+
+    The family's job is the data-loop audit: every prediction the
+    oracle hands a consumer (the autotuner's K prior, the estimator's
+    ``plan="auto"``) is counted, and once the consumer measures the
+    outcome the predicted/measured pair lands in per-config gauges with
+    the relative error alongside — a scrape answers "is the model
+    earning its priors" without replaying the run.  ``fit_samples`` is
+    the residual model's training-set size (0 = pure analytic
+    roofline, the <N-samples fallback)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.predictions = reg.counter(
+            "zoo_oracle_predictions_total",
+            "config predictions served, by consumer "
+            "(autotune_k / plan_auto / rank)",
+            labelnames=("consumer",))
+        self.predicted_sps = reg.gauge(
+            "zoo_oracle_predicted_steps_per_sec",
+            "oracle-predicted steps/sec for the chosen config",
+            labelnames=("config",))
+        self.measured_sps = reg.gauge(
+            "zoo_oracle_measured_steps_per_sec",
+            "measured steps/sec reported back for a predicted config",
+            labelnames=("config",))
+        self.rel_error = reg.gauge(
+            "zoo_oracle_prediction_rel_error",
+            "|predicted - measured| / measured for the last "
+            "prediction->outcome pair per config",
+            labelnames=("config",))
+        self.fit_samples = reg.gauge(
+            "zoo_oracle_fit_samples",
+            "training rows behind the residual model "
+            "(0 = analytic-only fallback)")
 
 
 class FleetMetrics:

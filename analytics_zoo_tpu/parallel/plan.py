@@ -322,6 +322,12 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
     if value is None:
         return data_parallel()
     name = str(value).strip().lower()
+    if name == "auto":
+        raise ValueError(
+            'plan="auto" is resolved by the estimator (the config '
+            "oracle picks among dp/zero1/fsdp from predicted per-chip "
+            "bytes vs the HBM budget — analysis/oracle.py); pass a "
+            "concrete plan or name here")
     if name in ("dp", "data_parallel", "none", ""):
         return data_parallel()
     if name == "fsdp":
@@ -417,10 +423,14 @@ class PlannedStep:
 
     _MAX_EXES = 32  # tail-batch shape churn bound; oldest evicted
 
-    def __init__(self, jitted, label: str, plan: ShardingPlan):
+    def __init__(self, jitted, label: str, plan: ShardingPlan,
+                 meta: dict | None = None):
         self._jitted = jitted
         self.label = label
         self.plan = plan
+        # compile context forwarded into the zoo-hlo-report/2 rows
+        # (plan name, mesh axis shape, steps_per_dispatch K)
+        self.meta = dict(meta) if meta else {"plan": plan.name}
         self._exes: dict = {}
 
     def _sig(self, args) -> tuple:
@@ -453,7 +463,8 @@ class PlannedStep:
         key = self._sig(args)
         exe = self._exes.get(key)
         if exe is None:
-            exe = timed_compile(self._jitted.lower(*args), self.label)
+            exe = timed_compile(self._jitted.lower(*args), self.label,
+                                meta=self.meta)
             while len(self._exes) >= self._MAX_EXES:
                 self._exes.pop(next(iter(self._exes)))
             self._exes[key] = exe
@@ -462,8 +473,8 @@ class PlannedStep:
 
 def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
                  donate_argnums=(), label: str | None = None,
-                 in_specs=None, out_specs=None, check_vma: bool = False
-                 ) -> PlannedStep:
+                 in_specs=None, out_specs=None, check_vma: bool = False,
+                 meta: dict | None = None) -> PlannedStep:
     """Compile a step function under a plan — the ONE entry every
     strategy uses (SNIPPETS [2] Titanax shape).
 
@@ -479,7 +490,9 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
     EVERY plan.
 
     ``label`` names the program in ``zoo_compile_seconds{label=}`` /
-    ``zoo_hlo_*{label=}`` (default ``<plan.name>_step``).
+    ``zoo_hlo_*{label=}`` (default ``<plan.name>_step``); ``meta``
+    adds compile context (mesh axis shape, steps_per_dispatch) to the
+    plan name in each ``zoo-hlo-report/2`` row.
     """
     plan = resolve_plan(plan)
     if plan.mode == "shard_map" or in_specs is not None:
@@ -493,7 +506,11 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
         step_fn = jax.shard_map(step_fn, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs, check_vma=check_vma)
     jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
-    return PlannedStep(jitted, label or f"{plan.name}_step", plan)
+    full_meta = {"plan": plan.name, **(meta or {})}
+    if "mesh_shape" not in full_meta and mesh is not None:
+        full_meta["mesh_shape"] = dict(mesh.shape)
+    return PlannedStep(jitted, label or f"{plan.name}_step", plan,
+                       meta=full_meta)
 
 
 # ---------------------------------------------------------------------------

@@ -457,6 +457,11 @@ class Estimator:
         self._loss_buffer: list[tuple[int, Any]] = []
         self._opt_state = None  # persists across fit() calls
         self._profiled = False  # one jax.profiler capture per estimator
+        # plan="auto" resolution cache: the oracle's choice is stable
+        # for one estimator (same model/optimizer/mesh), so it is made
+        # once; _auto_plan_record keeps the per-candidate prediction doc
+        self._auto_plan = None
+        self._auto_plan_record = None
         self.history: list[dict] = []
         # measure_pure_step probe bookkeeping: per-signature first-call
         # warmup time (compile included), so repeated probes report
@@ -468,15 +473,65 @@ class Estimator:
     # sharding plan (parallel/plan.py — ZOO_SHARDING_PLAN; the old
     # ZOO_SHARD_OPTIMIZER ZeRO-1 path is now the zero1() plan)
     # ------------------------------------------------------------------
-    def _resolved_plan(self, override=None):
+    def _resolved_plan(self, override=None, params=None):
         """The effective ShardingPlan: explicit train(plan=) override >
         estimator plan > ZOO_SHARDING_PLAN > legacy ZOO_SHARD_OPTIMIZER
-        (zero1) > data_parallel."""
+        (zero1) > data_parallel.
+
+        ``"auto"`` (any of those tiers) is resolved HERE, not by
+        ``resolve_plan``: the config oracle (analysis/oracle.py) picks
+        among the canned plans from predicted per-chip param+opt bytes
+        vs the peak table's HBM budget — see :meth:`_choose_auto_plan`.
+        The choice is cached per estimator."""
         from analytics_zoo_tpu.parallel.plan import resolve_plan
 
+        requested = override if override is not None else self.plan
+        if requested is None:
+            requested = getattr(self.ctx.config, "sharding_plan", None)
+        if isinstance(requested, str) \
+                and requested.strip().lower() == "auto":
+            if self._auto_plan is None:
+                if params is None:
+                    params, _ = self.model.build_params()
+                self._auto_plan = self._choose_auto_plan(params)
+            return self._auto_plan
         return resolve_plan(
             override if override is not None else self.plan,
             self.ctx.config)
+
+    def _choose_auto_plan(self, params):
+        """Ask the config oracle to pick the sharding plan: predicted
+        per-chip bytes per plan (params measured from the built tree,
+        optimizer state sized via ``jax.eval_shape`` — no allocation)
+        against the HBM budget, preferring the least-collective-traffic
+        plan that fits.  The full per-candidate prediction doc lands in
+        ``_auto_plan_record`` (and the plan record / bench artifacts)."""
+        from analytics_zoo_tpu.analysis.oracle import ConfigOracle
+        from analytics_zoo_tpu.parallel.plan import resolve_plan
+
+        def tree_bytes(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shape = getattr(leaf, "shape", None)
+                dtype = getattr(leaf, "dtype", None)
+                if shape is None or dtype is None:
+                    continue
+                total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+            return total
+
+        param_bytes = tree_bytes(params)
+        opt_bytes = tree_bytes(jax.eval_shape(self.optimizer.init, params))
+        oracle = ConfigOracle.from_env()
+        name, doc = oracle.choose_plan(
+            param_bytes, opt_bytes, self.ctx.data_parallel_size)
+        self._auto_plan_record = doc
+        logger.info(
+            "plan=auto resolved to %r (per-chip %s bytes vs %s budget, "
+            "%s-way)", name,
+            next(c["predicted_chip_bytes"] for c in doc["candidates"]
+                 if c["plan"] == name),
+            doc["hbm_budget_bytes"], doc["n_shards"])
+        return resolve_plan(name)
 
     def _place_opt_state(self, opt_state, plan=None):
         """Optimizer-state placement through the partitioner — the one
@@ -636,7 +691,9 @@ class Estimator:
 
             return compile_step(train_step, plan, mesh,
                                 donate_argnums=(0, 1, 2),
-                                label=f"train_step{tag}")
+                                label=f"train_step{tag}",
+                                meta={"mesh_shape": dict(mesh.shape),
+                                      "steps_per_dispatch": 1})
 
         k = int(steps_per_dispatch)
 
@@ -661,7 +718,9 @@ class Estimator:
 
         return compile_step(train_step_scan, plan, mesh,
                             donate_argnums=(0, 1, 2),
-                            label=f"train_step_scan{k}{tag}")
+                            label=f"train_step_scan{k}{tag}",
+                            meta={"mesh_shape": dict(mesh.shape),
+                                  "steps_per_dispatch": k})
 
     def _build_eval_step(self, device_transform=None):
         from analytics_zoo_tpu.parallel.plan import compile_step
@@ -809,9 +868,11 @@ class Estimator:
 
         # Unified partitioner: resolve the plan ONCE per fit; placement,
         # in-graph constraints, the batch sharding and the checkpoint's
-        # spec record all derive from it.
-        plan = self._resolved_plan(plan)
+        # spec record all derive from it.  Params are built FIRST: a
+        # plan="auto" resolution needs their byte sizes to predict each
+        # candidate's per-chip footprint.
         params, state = self.model.build_params()
+        plan = self._resolved_plan(plan, params=params)
         # Keras continuation semantics: a second fit() on the same estimator
         # keeps optimizer moments and the LR-schedule step count (they live
         # in opt_state), not just the weights.
@@ -837,6 +898,10 @@ class Estimator:
             "opt_specs": serialize_specs(
                 plan.opt_specs(opt_state, ctx.mesh)),
         }
+        if self._auto_plan_record is not None:
+            # plan="auto": keep the oracle's per-candidate predictions
+            # next to the layout the fit actually ran under
+            self._plan_record["auto"] = self._auto_plan_record
         dev_tf = getattr(train_set, "device_transform", None)
         # Fused multi-step dispatch (ZOO_STEPS_PER_DISPATCH): K>1 runs K
         # inner steps per jitted dispatch; the K=1 step is always built
@@ -845,6 +910,12 @@ class Estimator:
         k = int(ctx.config.steps_per_dispatch or 1)
         step_fn = self._train_step_for(dev_tf, 1, plan)
         fused_fn = self._train_step_for(dev_tf, k, plan) if k > 1 else None
+        if controller is not None:
+            # name the K=1 program for the controller's oracle prior:
+            # its compile (first dispatch) caches the HLO features the
+            # predicted-K jump reads
+            tag = "" if plan.name == "dp" else f"_{plan.name}"
+            controller.set_feature_label(f"train_step{tag}")
         # Persistent compile plane (ZOO_COMPILE_CACHE): enable before the
         # first trace so this fit's compiles populate / hit the cache.
         from analytics_zoo_tpu.common.compile_cache import (

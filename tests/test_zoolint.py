@@ -539,13 +539,17 @@ class TestTimedCompileHook:
         assert evs[0]["matmul_flops"] == 2 * 8 * 16 * 4
         assert evs[0]["findings"] == []
 
-        # the JSON report (schema zoo-hlo-report/1)
+        # the JSON report (schema zoo-hlo-report/2: v1 payload plus
+        # compile/config context — compile_seconds is stamped by the
+        # timed_compile hook, the rest when the caller provides them)
         reports = [f for f in os.listdir(tmp_path)
                    if f.startswith("hlo-hlo_gate_test")]
         assert len(reports) == 1
         with open(tmp_path / reports[0]) as f:
             doc = json.load(f)
-        assert doc["schema"] == "zoo-hlo-report/1"
+        assert doc["schema"] == "zoo-hlo-report/2"
+        assert doc["compile_seconds"] is None or \
+            doc["compile_seconds"] >= 0
         assert doc["features"]["matmul_flops"] == 2 * 8 * 16 * 4
         assert doc["findings"] == []
 

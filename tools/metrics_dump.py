@@ -35,10 +35,17 @@ fault-tolerance pair `zoo_fleet_lease_takeovers_total` /
 `zoo_fleet_replica_deaths_total`, the scaler's
 `zoo_fleet_est_p99_seconds` / `zoo_fleet_unclaimed_backlog` window
 signals, and `zoo_fleet_batch_flushes_total{reason}` from the
-continuous batcher).  When the scraped ``/varz`` carries a structured
-decision log (``autotune`` / ``fleet`` sections), it is additionally
-rendered as a table — time, knob/action, old → new, reason — above the
-metric rows.
+continuous batcher), and `zoo_oracle` (the predictive compile plane,
+analysis/oracle.py: `zoo_oracle_predictions_total{consumer}`,
+`zoo_oracle_predicted_steps_per_sec{config}` /
+`zoo_oracle_measured_steps_per_sec{config}` /
+`zoo_oracle_rel_error{config}` per scored config, and
+`zoo_oracle_fit_samples` — the residual model's training-set size, 0
+while the oracle is analytic-only).  When the scraped ``/varz`` carries
+a structured decision log (``autotune`` / ``fleet`` / ``oracle``
+sections), it is additionally rendered as a table — time, knob/action,
+old → new, reason; predicted vs measured per config — above the metric
+rows.
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
@@ -184,6 +191,43 @@ def render_fleet(doc, prefix="", out=None):
                  f"{d['reason']}")
 
 
+def render_oracle(doc, prefix="", out=None):
+    """Predicted-vs-measured panel for the ``oracle`` section a live
+    ``/varz`` carries when a ConfigOracle ran (analysis/oracle.py):
+    each oracle's peak-table source and residual-fit size, then one row
+    per scored config — time, consumer, config, predicted and measured
+    steps/sec, relative error ("-" while the outcome is still open).
+    Skipped when the snapshot has no oracle section or ``--prefix``
+    filters it out."""
+    import datetime
+
+    oracle = doc.get("oracle")
+    if not oracle or (prefix and not "zoo_oracle".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for o in oracle.get("oracles", []):
+        peaks = o.get("peaks", {})
+        emit("\noracle: peaks={source} fit_samples={n} "
+             "residual_ready={ready}".format(
+                 source=peaks.get("source"), n=o.get("fit_samples"),
+                 ready=o.get("residual_ready")))
+    predictions = oracle.get("predictions", [])
+    if predictions:
+        emit(f"\n{'time':<14}{'consumer':<12}{'config':<14}"
+             f"{'predicted/s':>12}{'measured/s':>12}{'rel_err':>9}")
+        for p in predictions:
+            t = datetime.datetime.fromtimestamp(p["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            meas = p.get("measured_steps_per_sec")
+            err = p.get("rel_error")
+            chosen = "*" if p.get("chosen") else " "
+            emit(f"{t:<14}{p['consumer']:<12}"
+                 f"{chosen + p['config']:<14}"
+                 f"{p['predicted_steps_per_sec']:>12.1f}"
+                 f"{('-' if meas is None else f'{meas:.1f}'):>12}"
+                 f"{('-' if err is None else f'{err:.3f}'):>9}")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("path", nargs="?", help="JSONL metrics file")
@@ -241,6 +285,7 @@ def main():
     print(f"# {src}: {len(docs)} snapshot(s), window {dt:.1f}s")
     render_autotune(last, prefix=a.prefix)
     render_fleet(last, prefix=a.prefix)
+    render_oracle(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
