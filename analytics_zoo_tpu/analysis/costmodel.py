@@ -49,6 +49,7 @@ __all__ = [
     "PeakTable", "resolve_peaks", "PLATFORM_PEAKS", "MIN_FIT_SAMPLES",
     "normalize_features", "predict_step_seconds", "predict_steps_per_sec",
     "predict_chip_bytes", "plan_collective_bytes", "PLAN_MEMORY_FACTORS",
+    "REMAT_ACTIVATION_FACTORS", "REMAT_FLOPS_FACTORS",
     "ResidualModel", "load_report_rows", "load_bench_rows",
     "load_tune_log_rows", "training_rows",
 ]
@@ -220,35 +221,81 @@ def predict_steps_per_sec(features: Mapping, k: int = 1,
 
 #: (param_factor, opt_factor) of per-chip resident bytes as a fraction
 #: of the global tree, for an n-way shard: dp replicates both, zero1
-#: shards optimizer state only, fsdp shards both, tp shards params +
-#: opt over the model axis (rule-table dependent; 1/n is the intended
-#: steady state).  Matches the live-array measurements in
-#: BENCH_PARTITION_r10.json (fsdp ≈ 0.125x on 8 devices).
+#: shards optimizer state only, zero2 adds the gradient reduce-scatter
+#: (grads are transient in JAX, so PERSISTENT state matches zero1),
+#: zero3/fsdp shard both, pipeline splits the stage-stacked tree over
+#: the pipe axis, tp shards params + opt over the model axis
+#: (rule-table dependent; 1/n is the intended steady state).  Matches
+#: the live-array measurements in BENCH_PARTITION_r10.json (fsdp ≈
+#: 0.125x on 8 devices) and BENCH_MEMORY_r12.json (zero3 ≈ 0.125x).
 PLAN_MEMORY_FACTORS = {
     "dp": (1.0, 1.0),
     "zero1": (1.0, None),   # None -> 1/n
+    "zero2": (1.0, None),
     "fsdp": (None, None),
+    "zero3": (None, None),
+    "pipeline": (None, None),
     "tp": (None, None),
 }
 
+#: fraction of the ACTIVATION estimate still resident under a remat
+#: policy: full recomputes everything (only layer boundaries survive),
+#: dots keeps contraction outputs, attn keeps only the tagged
+#: attention context.
+REMAT_ACTIVATION_FACTORS = {
+    None: 1.0,
+    "full": 0.15,
+    "dots": 0.5,
+    "attn": 0.35,
+}
+
+#: compute-time multiplier a remat policy costs (the recompute half of
+#: the memory/FLOPs tradeoff): full remat replays the forward inside
+#: the backward (~4/3 of baseline training FLOPs), partial policies
+#: replay proportionally less.
+REMAT_FLOPS_FACTORS = {
+    None: 1.0,
+    "full": 4.0 / 3.0,
+    "dots": 1.15,
+    "attn": 1.25,
+}
+
+
+def _plan_key(plan: str) -> str:
+    """Normalize a plan name for table lookup: a ``+remat_*`` suffix
+    (``with_remat`` naming) strips off, and every ``pipeline_<schedule>``
+    plan shares the ``pipeline`` row."""
+    base = str(plan).split("+", 1)[0]
+    return "pipeline" if base.startswith("pipeline") else base
+
 
 def predict_chip_bytes(param_bytes: int, opt_bytes: int, plan: str,
-                       n_shards: int, batch_bytes: int = 0) -> int:
-    """Predicted per-chip resident param+opt bytes under ``plan`` on an
-    ``n_shards``-way mesh axis (plus the per-chip batch slice when
-    given).  Activations are not modelled — this is the persistent
-    footprint the sharding plan controls."""
+                       n_shards: int, batch_bytes: int = 0,
+                       activation_bytes: int = 0,
+                       remat: str | None = None) -> int:
+    """Predicted per-chip resident bytes under ``plan`` on an
+    ``n_shards``-way mesh axis: the persistent param+opt footprint the
+    sharding plan controls, plus the per-chip batch slice and — when an
+    ``activation_bytes`` estimate is given — the activation residue the
+    ``remat`` policy leaves live (:data:`REMAT_ACTIVATION_FACTORS`)."""
     try:
-        pf, of = PLAN_MEMORY_FACTORS[plan]
+        pf, of = PLAN_MEMORY_FACTORS[_plan_key(plan)]
     except KeyError:
         raise ValueError(
             f"unknown plan {plan!r}; valid: "
             f"{', '.join(sorted(PLAN_MEMORY_FACTORS))}") from None
+    try:
+        af = REMAT_ACTIVATION_FACTORS[remat]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat policy {remat!r}; valid: "
+            f"{', '.join(str(k) for k in REMAT_ACTIVATION_FACTORS)}"
+        ) from None
     n = max(int(n_shards), 1)
     pf = pf if pf is not None else 1.0 / n
     of = of if of is not None else 1.0 / n
     return int(param_bytes * pf + opt_bytes * of
-               + batch_bytes / n)
+               + batch_bytes / n + activation_bytes * af)
 
 
 def plan_collective_bytes(param_bytes: int, plan: str,
@@ -261,8 +308,14 @@ def plan_collective_bytes(param_bytes: int, plan: str,
     - zero1: reduce-scatter grads into the moment shards + all-gather
       the updates back (2P, plus the sharded update's gather skew —
       charged 2.5P so dp ranks strictly first at equal memory);
+    - zero2: zero1's traffic plus the pinned gradient scatter's
+      re-layout (2.6P, so zero1 ranks first at equal memory);
     - fsdp: all-gather params on use (forward AND backward) +
       reduce-scatter grads (3P);
+    - zero3: fsdp's traffic with the explicit gradient-shard pin
+      (3.1P, so fsdp ranks first at equal memory);
+    - pipeline: stage-boundary ppermute traffic, activation-sized and
+      model dependent — charged like dp's 2P as a neutral default;
     - tp: activation collectives, model/rule dependent — charged like
       dp's 2P as a neutral default.
 
@@ -273,9 +326,10 @@ def plan_collective_bytes(param_bytes: int, plan: str,
     if n <= 1:
         return 0
     ring = param_bytes * (n - 1) / n
-    coeff = {"dp": 2.0, "zero1": 2.5, "fsdp": 3.0, "tp": 2.0}
+    coeff = {"dp": 2.0, "zero1": 2.5, "zero2": 2.6, "fsdp": 3.0,
+             "zero3": 3.1, "pipeline": 2.0, "tp": 2.0}
     try:
-        return int(coeff[plan] * ring)
+        return int(coeff[_plan_key(plan)] * ring)
     except KeyError:
         raise ValueError(
             f"unknown plan {plan!r}; valid: "

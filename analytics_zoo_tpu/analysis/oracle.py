@@ -33,6 +33,7 @@ import weakref
 from typing import Iterable, Mapping, Sequence
 
 from analytics_zoo_tpu.analysis.costmodel import (
+    REMAT_FLOPS_FACTORS,
     PeakTable,
     ResidualModel,
     plan_collective_bytes,
@@ -48,10 +49,13 @@ from analytics_zoo_tpu.metrics import (
 
 __all__ = ["ConfigOracle", "oracle_enabled", "varz_doc"]
 
-#: plans the oracle can choose among for ``plan="auto"`` — tensor
-#: parallelism needs a model-specific rule table, so it participates in
-#: ranking only when the caller passes it explicitly
-DEFAULT_PLAN_CANDIDATES = ("dp", "zero1", "fsdp")
+#: plans the oracle can choose among for ``plan="auto"``, ordered from
+#: least to most sharded so infeasible-everywhere ties break toward the
+#: established layout (fsdp before the equivalent-memory zero3) —
+#: tensor parallelism needs a model-specific rule table and pipeline a
+#: staged model, so they participate in ranking only when the caller
+#: passes them explicitly
+DEFAULT_PLAN_CANDIDATES = ("dp", "zero1", "zero2", "fsdp", "zero3")
 
 #: a prediction within this margin of the best is "as good" — ties go
 #: to the smaller K (finer checkpoint cadence), mirroring the
@@ -196,56 +200,75 @@ class ConfigOracle:
                     n_shards: int, hbm_budget: int | None = None,
                     features: Mapping | None = None,
                     plans: Sequence[str] = DEFAULT_PLAN_CANDIDATES,
-                    batch_bytes: int = 0) -> tuple[str, dict]:
+                    batch_bytes: int = 0,
+                    activation_bytes: int = 0,
+                    remat_options: Sequence[str | None] = (None,),
+                    ) -> tuple[str, dict]:
         """The sharding plan ``plan="auto"`` resolves to: among the
-        candidate plans whose predicted per-chip bytes fit the HBM
-        budget, the one whose predicted step time (roofline + the
-        plan's per-step collective traffic over the link ceiling) is
-        lowest — i.e. the least-sharded feasible plan, since sharding
-        only adds collectives.  Ties keep candidate order.  Returns
-        ``(plan_name, doc)`` where the doc records every candidate's
-        predicted bytes/traffic/feasibility for the artifact trail.
-        Infeasible-everywhere falls back to the most memory-frugal
-        candidate (training may still OOM, but that plan is the only
-        one with a chance)."""
+        (plan × remat) candidates whose predicted per-chip bytes fit
+        the HBM budget, the one whose predicted step time (roofline ×
+        the remat recompute factor + the plan's per-step collective
+        traffic over the link ceiling) is lowest — i.e. the
+        least-sharded, least-rematted feasible config, since sharding
+        only adds collectives and remat only adds FLOPs.  Ties keep
+        candidate order.  Returns ``(plan_name, doc)``; the doc records
+        every candidate's predicted bytes/traffic/feasibility plus
+        ``chosen_remat`` (``None`` unless a remat policy was needed to
+        fit).  ``remat_options`` defaults to no-remat-only, so existing
+        callers sweep exactly the old space; ``fit(plan="auto")``
+        passes ``(None, "full")`` and an activation estimate to sweep
+        the full memory plan.  Infeasible-everywhere falls back to the
+        most memory-frugal candidate (training may still OOM, but that
+        config is the only one with a chance)."""
         budget = int(hbm_budget) if hbm_budget else int(self.peaks.hbm_bytes)
         feats = features or {}
         base_s = 1.0 / self.predict_steps_per_sec(feats, k=1)
         candidates = []
-        for plan in plans:
-            chip = predict_chip_bytes(param_bytes, opt_bytes, plan,
-                                      n_shards, batch_bytes=batch_bytes)
-            coll = plan_collective_bytes(param_bytes, plan, n_shards)
-            step_s = base_s + coll / max(self.peaks.link_bytes_per_s, 1.0)
-            candidates.append({
-                "plan": plan, "predicted_chip_bytes": chip,
-                "predicted_collective_bytes_per_step": coll,
-                "predicted_steps_per_sec": round(1.0 / step_s, 3),
-                "fits_budget": chip <= budget})
+        for remat in remat_options:
+            for plan in plans:
+                chip = predict_chip_bytes(
+                    param_bytes, opt_bytes, plan, n_shards,
+                    batch_bytes=batch_bytes,
+                    activation_bytes=activation_bytes, remat=remat)
+                coll = plan_collective_bytes(param_bytes, plan, n_shards)
+                step_s = (base_s * REMAT_FLOPS_FACTORS[remat]
+                          + coll / max(self.peaks.link_bytes_per_s, 1.0))
+                config = f"plan={plan}" if remat is None \
+                    else f"plan={plan}+remat_{remat}"
+                candidates.append({
+                    "plan": plan, "remat": remat, "config": config,
+                    "predicted_chip_bytes": chip,
+                    "predicted_collective_bytes_per_step": coll,
+                    "predicted_steps_per_sec": round(1.0 / step_s, 3),
+                    "fits_budget": chip <= budget})
         feasible = [c for c in candidates if c["fits_budget"]]
         pool = feasible or sorted(
             candidates, key=lambda c: c["predicted_chip_bytes"])[:1]
         chosen = max(pool, key=lambda c: c["predicted_steps_per_sec"])
-        doc = {"chosen": chosen["plan"], "hbm_budget_bytes": budget,
+        doc = {"chosen": chosen["plan"], "chosen_remat": chosen["remat"],
+               "chosen_config": chosen["config"],
+               "hbm_budget_bytes": budget,
                "n_shards": int(n_shards), "param_bytes": int(param_bytes),
-               "opt_bytes": int(opt_bytes), "candidates": candidates,
+               "opt_bytes": int(opt_bytes),
+               "activation_bytes": int(activation_bytes),
+               "candidates": candidates,
                "feasible": bool(feasible)}
         now = time.time()
         with self._lock:
             for c in candidates:
                 self._remember_locked({
                     "ts": now, "consumer": "plan_auto",
-                    "config": f"plan={c['plan']}",
+                    "config": c["config"],
                     "predicted_steps_per_sec":
                         c["predicted_steps_per_sec"],
-                    "chosen": c["plan"] == chosen["plan"],
+                    "chosen": c is chosen,
                     "measured_steps_per_sec": None, "rel_error": None})
         self.metrics.predictions.labels(consumer="plan_auto").inc()
         self.metrics.predicted_sps.labels(
-            config=f"plan={chosen['plan']}").set(
+            config=chosen["config"]).set(
                 chosen["predicted_steps_per_sec"])
         get_flight_recorder().record(
-            "oracle", consumer="plan_auto", config=f"plan={chosen['plan']}",
+            "oracle", consumer="plan_auto", config=chosen["config"],
             chip_bytes=chosen["predicted_chip_bytes"],
             hbm_budget=budget, feasible=bool(feasible))
         return chosen["plan"], doc

@@ -22,6 +22,10 @@ never an exception.
   plane (``compile_step`` / ``timed_compile``) produces programs the
   persistent cache, AOT warmup, ``zoo_compile_seconds`` metering and
   the HLO graph lint never see.
+- ``raw-remat``: a ``jax.checkpoint``/``jax.remat`` call site outside
+  ``apply_remat`` hard-codes a remat decision the sharding plan's
+  ``remat_rules`` and the oracle's sharding × remat sweep can never
+  override.
 """
 
 from __future__ import annotations
@@ -39,7 +43,8 @@ from analytics_zoo_tpu.analysis.astlint import (
 from analytics_zoo_tpu.analysis.findings import Finding, Severity
 
 __all__ = ["JAX_RULES", "JitSideEffectRule", "PrngReuseRule",
-           "HostSyncRule", "NonDonatedCarryRule", "RawJitRule"]
+           "HostSyncRule", "NonDonatedCarryRule", "RawJitRule",
+           "RawRematRule"]
 
 # Calls that are host side effects when traced.  Exact qualnames plus
 # the numpy.random.* / random.* families.
@@ -296,6 +301,11 @@ class RawJitRule(Rule):
                    "cache, metering, HLO lint)")
 
     _CHOKE_TAILS = ("timed_compile", "compile_step")
+    # subclass knobs (RawRematRule): the offending names, the blessed
+    # route to suggest, and what a bypass loses
+    _NAMES = _JIT_NAMES
+    _ROUTE = "compile_step (parallel/plan.py) / timed_compile"
+    _BYPASSES = "the compile plane"
 
     def _inside_choke(self, mod: LintModule, node: ast.AST) -> bool:
         for a in mod.ancestors(node):
@@ -311,10 +321,10 @@ class RawJitRule(Rule):
         if not isinstance(node, ast.Call):
             return None
         q = mod.qualname(node.func)
-        if q in _JIT_NAMES:
+        if q in self._NAMES:
             return q
         if q in _PARTIAL_NAMES and node.args \
-                and mod.qualname(node.args[0]) in _JIT_NAMES:
+                and mod.qualname(node.args[0]) in self._NAMES:
             return mod.qualname(node.args[0])
         return None
 
@@ -323,7 +333,7 @@ class RawJitRule(Rule):
         for fn in mod.functions():
             for dec in fn.decorator_list:
                 q = mod.qualname(dec)
-                bare = q in _JIT_NAMES
+                bare = q in self._NAMES
                 call = self._jit_call(mod, dec)
                 if bare or call:
                     decorator_calls.add(id(dec))
@@ -331,10 +341,10 @@ class RawJitRule(Rule):
                     # a suppression comment naturally sits)
                     yield self.finding(
                         mod, dec,
-                        f"`{fn.name}` is jitted with a raw "
+                        f"`{fn.name}` is wrapped with a raw "
                         f"`{call or q}` decorator — route it through "
-                        "compile_step (parallel/plan.py) so it shares "
-                        "the compile plane, or suppress with a "
+                        f"{self._ROUTE} so it shares "
+                        f"{self._BYPASSES}, or suppress with a "
                         "justification",
                         function=fn.name)
         for node in ast.walk(mod.tree):
@@ -345,11 +355,42 @@ class RawJitRule(Rule):
                 continue
             yield self.finding(
                 mod, node,
-                f"raw `{call}` call bypasses the compile plane — use "
-                "compile_step (parallel/plan.py) / timed_compile, or "
+                f"raw `{call}` call bypasses {self._BYPASSES} — use "
+                f"{self._ROUTE}, or "
                 "suppress with a justification",
                 call=call)
 
 
+# Rematerialization entry points.  `jax.checkpoint` and `jax.remat` are
+# aliases; bare names cover `from jax import checkpoint` imports.
+_REMAT_NAMES = {
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "jax.ad_checkpoint.checkpoint",
+}
+
+
+class RawRematRule(RawJitRule):
+    """Package code must apply rematerialization through the plan: a raw
+    ``jax.checkpoint``/``jax.remat`` call hard-codes one remat decision
+    at the call site, invisible to the sharding plan's ``remat_rules``
+    (``parallel.plan.resolve_remat``) and to the oracle's
+    sharding × remat sweep — the per-layer policy the memory plan owns
+    becomes unoverridable.  ``apply_remat`` (parallel/plan.py) is the
+    ONE blessed ``jax.checkpoint`` site every rule resolves to; a
+    checkpoint flowing into ``apply_remat(...)`` is exempt."""
+
+    name = "raw-remat"
+    severity = Severity.WARNING
+    description = ("jax.checkpoint/jax.remat outside apply_remat — the "
+                   "remat decision bypasses the plan's remat_rules "
+                   "(resolve_remat) and the oracle's remat sweep")
+
+    _CHOKE_TAILS = ("apply_remat",)
+    _NAMES = _REMAT_NAMES
+    _ROUTE = ("apply_remat / a plan's remat_rules "
+              "(parallel/plan.py)")
+    _BYPASSES = "the plan's remat policy"
+
+
 JAX_RULES = (JitSideEffectRule(), PrngReuseRule(), HostSyncRule(),
-             NonDonatedCarryRule(), RawJitRule())
+             NonDonatedCarryRule(), RawJitRule(), RawRematRule())

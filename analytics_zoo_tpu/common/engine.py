@@ -140,14 +140,21 @@ class ZooConfig:
                                (parallel/plan.py; docs/parallelism.md):
                                "dp" (replicate — default), "zero1"
                                (optimizer state sharded over data),
-                               "fsdp" (params + optimizer state sharded
-                               over data; gather-on-use /
-                               reduce-scatter — ~1/n param+opt bytes
+                               "zero2" (zero1 + gradients
+                               reduce-scattered to per-chip shards),
+                               "zero3"/"fsdp" (params + optimizer
+                               state sharded over data; gather-on-use
+                               / reduce-scatter — ~1/n param+opt bytes
                                per chip at a bit-identical loss
-                               trajectory).  Tensor-parallel plans
-                               carry a rule table, so they are passed
-                               as objects (fit(plan=tensor_parallel(
-                               rules))), not named here.
+                               trajectory; zero3 also shards the
+                               gradient tree in-graph).  fit(
+                               plan="auto") asks the oracle to sweep
+                               these × remat policies against the HBM
+                               budget.  Tensor-parallel and pipeline
+                               plans carry a rule table, so they are
+                               passed as objects (fit(plan=
+                               tensor_parallel(rules))), not named
+                               here.
       ZOO_DCN_AXIS             mesh axis that crosses the data-center
                                network when parallel.plan.build_mesh
                                assembles a hybrid ICI x DCN mesh from a
@@ -287,8 +294,9 @@ class ZooConfig:
     # (Legacy spelling of sharding_plan="zero1".)
     shard_optimizer: bool | None = None
     # Unified partitioner (parallel/plan.py): named sharding plan for
-    # every fit ("dp" | "zero1" | "fsdp"); None = dp (or zero1 when the
-    # legacy shard_optimizer flag is set).  Env: ZOO_SHARDING_PLAN.
+    # every fit ("dp" | "zero1" | "zero2" | "zero3" | "fsdp"); None = dp
+    # (or zero1 when the legacy shard_optimizer flag is set).
+    # Env: ZOO_SHARDING_PLAN.
     sharding_plan: str | None = None
     # Hybrid ICI x DCN meshes (plan.build_mesh): which axis crosses the
     # DCN when given a bare slice count.  Env: ZOO_DCN_AXIS.

@@ -7,9 +7,11 @@ first-class mesh axis (common/engine.py axes: data/model/seq/expert/pipe):
 - :mod:`plan` — the unified partitioner: :class:`~analytics_zoo_tpu.
   parallel.plan.ShardingPlan` rule tables (regex → PartitionSpec over
   logical tree paths), canned plans (``data_parallel``/``zero1``/
-  ``fsdp``/``tensor_parallel``), the hybrid ICI×DCN mesh builder, and
-  ``compile_step`` — the ONE compile choke point every strategy lowers
-  through (persistent cache + HLO lint + compile metering).
+  ``zero2``/``zero3``/``fsdp``/``tensor_parallel``/``pipeline_plan``),
+  remat policy as plan rules (``with_remat``/``apply_remat``), the
+  hybrid ICI×DCN mesh builder, and ``compile_step`` — the ONE compile
+  choke point every strategy lowers through (persistent cache + HLO
+  lint + compile metering).
 - :mod:`strategies` — explicit shard_map train steps (psum = the
   AllReduceParameter replacement), tensor-parallel dense helpers; thin
   wrappers over :mod:`plan`'s choke point.
@@ -32,14 +34,21 @@ from analytics_zoo_tpu.parallel.partition import (  # noqa: F401
 )
 from analytics_zoo_tpu.parallel.plan import (  # noqa: F401
     ShardingPlan,
+    apply_remat,
     build_mesh,
     compile_step,
     data_parallel,
     fsdp,
+    live_bytes,
     per_chip_bytes,
+    pipeline_plan,
     resolve_plan,
+    resolve_remat,
     tensor_parallel,
+    with_remat,
     zero1,
+    zero2,
+    zero3,
 )
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     gpipe,

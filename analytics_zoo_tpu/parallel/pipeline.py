@@ -55,6 +55,55 @@ def _record_schedule(schedule: str, n_stages: int, n_micro: int,
                   bubble_ticks / max(n_micro, 1))
 
 
+# compiled-schedule cache for eager entry: key -> PlannedStep, so a
+# training loop calling a schedule repeatedly re-dispatches the cached
+# executable instead of re-lowering every step (the choke point's
+# signature probe handles shape churn per entry)
+_PLANNED_CACHE: dict = {}
+_PLANNED_CACHE_MAX = 32
+
+
+def _args_sig(args) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(
+        (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", type(l))))
+        for l in leaves))
+
+
+def _run_planned(local, schedule, mesh, in_specs, out_specs, fns_key,
+                 args):
+    """Run a per-shard schedule body through the compile choke point.
+
+    Called eagerly, the schedule lowers via ``compile_step`` under a
+    ``pipeline_<schedule>`` plan: per-plan compile label, persistent
+    compile cache, ``zoo_hlo_*`` feature extraction — everything the
+    other plans already get.  Called under someone ELSE's trace (the
+    schedule composes inside jax.jit / jax.grad — test_pipeline_parallel
+    pins it), the shard_map stages inline instead: the OUTER program
+    owns the choke point, and nesting a second jit would break the
+    grad-of-pipeline story."""
+    if any(isinstance(l, jax.core.Tracer)
+           for l in jax.tree_util.tree_leaves(args)):
+        fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return fn(*args)
+    from analytics_zoo_tpu.parallel.plan import compile_step, pipeline_plan
+
+    key = (schedule, mesh, fns_key, in_specs, out_specs, _args_sig(args))
+    step = _PLANNED_CACHE.get(key)
+    if step is None:
+        step = compile_step(local, pipeline_plan(schedule), mesh,
+                            in_specs=in_specs, out_specs=out_specs,
+                            check_vma=False,
+                            label=f"pipeline_{schedule}_step",
+                            meta={"mesh_shape": dict(mesh.shape),
+                                  "schedule": schedule})
+        while len(_PLANNED_CACHE) >= _PLANNED_CACHE_MAX:
+            _PLANNED_CACHE.pop(next(iter(_PLANNED_CACHE)))
+        _PLANNED_CACHE[key] = step
+    return step(*args)
+
+
 def _pipeline_local(stage_params, x_mb, *, stage_fn, axis_name, n_stages,
                     n_micro):
     """Per-shard GPipe schedule.
@@ -172,14 +221,9 @@ def gpipe(stage_fn, stage_params, x, *, n_microbatch, mesh=None,
         p_arg = jax.tree_util.tree_map(
             lambda a: a.reshape((v, n_stages) + a.shape[1:]), stage_params)
         p_spec = P(None, axis_name)
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(p_spec, mb_spec),
-        out_specs=mb_spec,
-        check_vma=False,
-    )
-    out = fn(p_arg, x_mb)
+    out = _run_planned(local, "gpipe" if v == 1 else "gpipe_circular",
+                       mesh, (p_spec, mb_spec), mb_spec,
+                       (stage_fn, v), (p_arg, x_mb))
     return out.reshape((b,) + out.shape[2:])
 
 
@@ -383,17 +427,16 @@ def gpipe_hetero(stage_fns, edge_params, stacked_params, x, *,
 
     _record_schedule("gpipe_hetero", n_stages, n_microbatch,
                      n_stages - 1, n_microbatch + n_stages - 1)
-    fn = jax.shard_map(
+    out = _run_planned(
         partial(_pipeline_local_hetero, stage_fns=stage_fns,
                 axis_name=axis_name, n_stages=n_stages,
                 n_micro=n_microbatch, boundaries=bound, flen=flen,
                 ilen=ilen),
-        mesh=mesh,
-        in_specs=(P(), P(axis_name), P(None, batch_axis)),
-        out_specs=P(None, batch_axis),
-        check_vma=False,
-    )
-    out = fn(tuple(edge_params), stacked_params, x_mb)
+        "gpipe_hetero", mesh,
+        (P(), P(axis_name), P(None, batch_axis)),
+        P(None, batch_axis),
+        tuple(stage_fns),
+        (tuple(edge_params), stacked_params, x_mb))
     return jax.tree_util.tree_map(
         lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), out)
 
@@ -604,16 +647,15 @@ def gpipe_1f1b_grads(stage_fn, loss_fn, stage_params, x, y, *,
     # stage)
     _record_schedule("1f1b", n_stages, n_microbatch,
                      2 * n_stages - 1, n_microbatch + 2 * n_stages - 1)
-    fn = jax.shard_map(
+    return _run_planned(
         partial(_pipeline_local_1f1b, stage_fn=stage_fn, loss_fn=loss_fn,
                 axis_name=axis_name, n_stages=n_stages,
                 n_micro=n_microbatch, batch_axis=batch_axis),
-        mesh=mesh,
-        in_specs=(P(axis_name), P(None, batch_axis), P(None, batch_axis)),
-        out_specs=(P(), P(axis_name)),
-        check_vma=False,
-    )
-    return fn(stage_params, x_mb, y_mb)
+        "1f1b", mesh,
+        (P(axis_name), P(None, batch_axis), P(None, batch_axis)),
+        (P(), P(axis_name)),
+        (stage_fn, loss_fn),
+        (stage_params, x_mb, y_mb))
 
 
 def _pipeline_local_1f1b_hetero(edge_params, stacked_params, x_mb, y_mb,
@@ -787,17 +829,16 @@ def gpipe_hetero_1f1b_grads(stage_fns, edge_params, stacked_params, x, y,
 
     _record_schedule("1f1b_hetero", n_stages, n_microbatch,
                      2 * n_stages - 1, n_microbatch + 2 * n_stages - 1)
-    fn = jax.shard_map(
+    return _run_planned(
         partial(_pipeline_local_1f1b_hetero, stage_fns=stage_fns,
                 loss_fn=loss_fn, axis_name=axis_name, n_stages=n_stages,
                 n_micro=n_microbatch, boundaries=bound,
                 out_struct=bound[n_stages], flen=flen, ilen=ilen),
-        mesh=mesh,
-        in_specs=(P(), P(axis_name), P(), P()),
-        out_specs=(P(), P(), P(axis_name)),
-        check_vma=False,
-    )
-    return fn(tuple(edge_params), stacked_params, x_mb, y_mb)
+        "1f1b_hetero", mesh,
+        (P(), P(axis_name), P(), P()),
+        (P(), P(), P(axis_name)),
+        (tuple(stage_fns), loss_fn),
+        (tuple(edge_params), stacked_params, x_mb, y_mb))
 
 
 def stack_stage_params(per_stage: list):
@@ -850,9 +891,14 @@ def transformer_gpipe_lm(layer, params, head_kernel, head_bias, tokens, *,
             (n_stages, per) + leaves[0].shape), *list(blocks))
 
     def run_blocks(stacked_local, h):
-        body = layer._block_forward
-        if layer.remat:
-            body = jax.checkpoint(body, static_argnums=(3,))
+        from analytics_zoo_tpu.parallel.plan import (
+            apply_remat,
+            resolve_remat,
+        )
+
+        policy = resolve_remat("blocks", default=layer.remat)
+        body = apply_remat(layer._block_forward, policy,
+                           static_argnums=(3,))
         for j in range(per):
             bp = jax.tree_util.tree_map(lambda a, _j=j: a[_j],
                                         stacked_local)
@@ -925,11 +971,17 @@ def transformer_gpipe(layer, params, h, *, n_microbatch, mask=None,
     blocks = params["blocks"] if isinstance(params, dict) else params
     stacked = stack_stage_params(list(blocks))
 
-    def stage_fn(bp, act):
+    from analytics_zoo_tpu.parallel.plan import apply_remat, resolve_remat
+
+    def block_fn(bp, act):
         return layer._block_forward(bp, act, mask, False, None)
 
-    if layer.remat:
-        stage_fn = jax.checkpoint(stage_fn)
+    def stage_fn(bp, act):
+        # resolved INSIDE the stage body, i.e. at trace time, so a
+        # remat_rules entry on the plan being compiled wins over the
+        # layer flag
+        policy = resolve_remat("blocks", default=layer.remat)
+        return apply_remat(block_fn, policy)(bp, act)
 
     return gpipe(stage_fn, stacked, h, n_microbatch=n_microbatch,
                  mesh=mesh, axis_name=axis_name, batch_axis=batch_axis)

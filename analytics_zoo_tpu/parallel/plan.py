@@ -1,4 +1,4 @@
-# zoolint: disable-file=raw-jit -- this module IS the compile choke point: the jax.jit here is the one every plan routes through (timed_compile telemetry, persistent cache, HLO lint)
+# zoolint: disable-file=raw-jit,raw-remat -- this module IS the compile choke point: the jax.jit here is the one every plan routes through (timed_compile telemetry, persistent cache, HLO lint), and apply_remat is the one jax.checkpoint site every remat rule resolves to
 """zooplan — the unified partitioner: sharding plans + ONE compile entry.
 
 Before this module, sharding decisions were scattered per strategy:
@@ -39,9 +39,11 @@ trains bit-identically to replicated DP (pinned by
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import os
+import re
 from typing import Mapping, Sequence
 
 import jax
@@ -52,6 +54,7 @@ from analytics_zoo_tpu.common.engine import (
     ALL_AXES,
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     logger,
 )
 from analytics_zoo_tpu.parallel.partition import (
@@ -60,16 +63,28 @@ from analytics_zoo_tpu.parallel.partition import (
 )
 
 __all__ = [
-    "ShardingPlan", "data_parallel", "fsdp", "zero1", "tensor_parallel",
+    "ShardingPlan", "data_parallel", "fsdp", "zero1", "zero2", "zero3",
+    "tensor_parallel", "pipeline_plan", "with_remat",
     "resolve_plan", "build_mesh", "compile_step", "PlannedStep",
-    "per_chip_bytes", "serialize_specs", "deserialize_specs",
+    "apply_remat", "resolve_remat", "REMAT_POLICIES",
+    "per_chip_bytes", "live_bytes", "record_mem_gauges",
+    "serialize_specs", "deserialize_specs",
     "PLAN_NAMES",
 ]
 
 #: names ``ZOO_SHARDING_PLAN`` / ``resolve_plan`` accept (tensor
 #: parallelism needs a rule table, so it is constructed in code, not
 #: named from the environment)
-PLAN_NAMES = ("dp", "data_parallel", "none", "fsdp", "zero1")
+PLAN_NAMES = ("dp", "data_parallel", "none", "fsdp", "zero1", "zero2",
+              "zero3")
+
+#: remat policy names a plan's ``remat_rules`` may map a path to —
+#: ``"full"`` recomputes everything in the matched scope, ``"dots"``
+#: keeps contraction outputs (``dots_with_no_batch_dims_saveable``),
+#: ``"attn"`` keeps only tensors tagged ``checkpoint_name(
+#: "attn_context")``; any other string resolves as an attribute of
+#: ``jax.checkpoint_policies``
+REMAT_POLICIES = ("full", "dots", "attn")
 
 _REPLICATE_ALL = ((r".*", P()),)
 
@@ -104,6 +119,15 @@ class ShardingPlan:
     ``"jit"`` (GSPMD — XLA inserts collectives from the shardings) or
     ``"shard_map"`` (explicit per-shard program with hand-written
     collectives; requires ``in_specs``/``out_specs`` at compile time).
+
+    ``grad_rules`` extends the rule table to the GRADIENTS inside the
+    step (``None`` = unconstrained, today's behavior): zero2/zero3 pin
+    grads to per-chip shards so XLA reduce-scatters instead of
+    all-reducing — the weight-update sharding of arXiv:2004.13336.
+    ``remat_rules`` maps logical scope names (layer names, ``"blocks"``)
+    to a :data:`REMAT_POLICIES` entry; :func:`resolve_remat` consults
+    the plan active during tracing, so activation checkpointing is plan
+    configuration, not a per-layer flag.
     """
 
     name: str
@@ -112,6 +136,8 @@ class ShardingPlan:
     batch_axes: tuple = (DATA_AXIS,)
     mode: str = "jit"
     description: str = ""
+    grad_rules: tuple | None = None
+    remat_rules: tuple = ()
 
     def __post_init__(self):
         if self.mode not in ("jit", "shard_map"):
@@ -122,6 +148,18 @@ class ShardingPlan:
         if self.opt_rules is not None:
             object.__setattr__(self, "opt_rules",
                                _freeze_rules(self.opt_rules))
+        if self.grad_rules is not None:
+            object.__setattr__(self, "grad_rules",
+                               _freeze_rules(self.grad_rules))
+        remat = []
+        for pat, policy in self.remat_rules:
+            if policy is not None and not isinstance(policy, str):
+                raise TypeError(
+                    f"remat rule {pat!r}: policy must be a name from "
+                    f"REMAT_POLICIES (or a jax.checkpoint_policies "
+                    f"attribute name, or None), got {policy!r}")
+            remat.append((str(pat), policy))
+        object.__setattr__(self, "remat_rules", tuple(remat))
         object.__setattr__(self, "batch_axes", tuple(self.batch_axes))
 
     # -- identity ------------------------------------------------------
@@ -129,7 +167,8 @@ class ShardingPlan:
         """Hashable identity for compiled-step caches: two plans with
         the same rules compile the same program."""
         return (self.name, self.param_rules, self.opt_rules,
-                self.batch_axes, self.mode)
+                self.batch_axes, self.mode, self.grad_rules,
+                self.remat_rules)
 
     @property
     def effective_opt_rules(self) -> tuple:
@@ -197,10 +236,10 @@ class ShardingPlan:
         """device_put an optimizer state into this plan's layout — the
         ONE resharding path elastic resume uses: a checkpoint stores
         global logical arrays, so restoring onto any mesh size is this
-        device_put (no layout surgery; contrast
-        :func:`~analytics_zoo_tpu.parallel.strategies.
-        reshard_zero1_opt_state`, which the explicit padded-flat-vector
-        layout still needs)."""
+        device_put.  Even the explicit padded-flat-vector layout
+        (:func:`~analytics_zoo_tpu.parallel.strategies.
+        reshard_zero1_opt_state`) routes its final placement here after
+        its host-side pad surgery."""
         return jax.device_put(opt_state,
                               self.opt_shardings(opt_state, mesh))
 
@@ -226,6 +265,20 @@ class ShardingPlan:
         return jax.tree_util.tree_map(
             jax.lax.with_sharding_constraint, opt_state,
             self.opt_shardings(opt_state, mesh))
+
+    def constrain_grads(self, grads, mesh):
+        """Pin the gradients inside the step to ``grad_rules`` — the
+        zero2/zero3 hook: constraining grads to per-chip shards forces
+        XLA to lower the gradient sum as a reduce-scatter (each chip
+        keeps only its shard) instead of a full all-reduce, so the
+        optimizer update runs on 1/n of every leaf.  ``grad_rules=None``
+        (dp/zero1/fsdp) leaves the gradients to GSPMD's own choice."""
+        if self.grad_rules is None:
+            return grads
+        specs = self._specs(self.grad_rules, grads, mesh)
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, grads,
+            tree_shardings(mesh, specs))
 
 
 def _clamp_spec(spec: P, shape: tuple, mesh) -> P:
@@ -255,6 +308,69 @@ def _clamp_spec(spec: P, shape: tuple, mesh) -> P:
     while out and out[-1] is None:
         out.pop()
     return P(*out)
+
+
+# ---------------------------------------------------------------------------
+# Remat policy — the ONE jax.checkpoint site (zoolint raw-remat keeps it
+# that way), plus the active-plan context resolve_remat consults.
+# ---------------------------------------------------------------------------
+
+# plans entered by compile_step for the duration of tracing, innermost
+# last — resolve_remat walks it top-down so the plan being compiled wins
+_ACTIVE_PLANS: list = []
+
+
+@contextlib.contextmanager
+def _active_plan(plan: "ShardingPlan"):
+    _ACTIVE_PLANS.append(plan)
+    try:
+        yield plan
+    finally:
+        _ACTIVE_PLANS.pop()
+
+
+def resolve_remat(path: str, default: str | None = None) -> str | None:
+    """Remat policy for a logical scope name (a layer name, ``"blocks"``)
+    under the plan currently being compiled: first ``remat_rules`` match
+    (``re.search``, innermost active plan first) wins; no active plan or
+    no match falls back to ``default`` — so a plan's rules SUBSUME the
+    per-layer ``remat=`` flag without breaking it."""
+    for plan in reversed(_ACTIVE_PLANS):
+        for pat, policy in plan.remat_rules:
+            if re.search(pat, path):
+                return policy
+    return default
+
+
+def apply_remat(fn, policy: str | None, *, static_argnums=()):
+    """Wrap ``fn`` in ``jax.checkpoint`` under a named policy — the one
+    remat site every layer and pipeline schedule routes through.
+
+    ``None`` returns ``fn`` unchanged; ``"full"`` recomputes the whole
+    scope in the backward pass (max memory saving, ~1/3 extra FLOPs);
+    ``"dots"`` keeps contraction outputs
+    (``dots_with_no_batch_dims_saveable``); ``"attn"`` keeps only
+    tensors tagged ``checkpoint_name(..., "attn_context")``; any other
+    name resolves as an attribute of ``jax.checkpoint_policies``."""
+    if policy in (None, "", "none"):
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn, static_argnums=static_argnums)
+    if policy == "dots":
+        ckpt_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif policy == "attn":
+        ckpt_policy = jax.checkpoint_policies.save_only_these_names(
+            "attn_context")
+    else:
+        try:
+            ckpt_policy = getattr(jax.checkpoint_policies, policy)
+        except AttributeError:
+            raise ValueError(
+                f"unknown remat policy {policy!r}; expected one of "
+                f"{REMAT_POLICIES} or a jax.checkpoint_policies "
+                "attribute name") from None
+    return jax.checkpoint(fn, policy=ckpt_policy,
+                          static_argnums=static_argnums)
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +410,66 @@ def fsdp(axis: str = DATA_AXIS) -> ShardingPlan:
                     "(gather-on-use / reduce-scatter)")
 
 
+def zero2(axis: str = DATA_AXIS) -> ShardingPlan:
+    """ZeRO-2 (arXiv:2004.13336): optimizer state sharded AND grads
+    reduce-scattered into per-chip shards over ``axis``; params stay
+    replicated, so the update all-gathers the new weights once per step
+    (grad_rules pin the scatter, constrain_params pins the gather-at-
+    update).  Same math as DP — per-chip persistent state matches
+    zero1, and the transient gradient buffer drops to 1/n."""
+    shard = ((r".*", P(axis)),)
+    return ShardingPlan(
+        name="zero2",
+        param_rules=_REPLICATE_ALL,
+        opt_rules=shard,
+        grad_rules=shard,
+        description=f"replicated params, opt state + grads sharded over "
+                    f"{axis} (reduce-scatter, gather at update)")
+
+
+def zero3(axis: str = DATA_AXIS) -> ShardingPlan:
+    """ZeRO-3: params, optimizer state AND grads all sharded over
+    ``axis`` — XLA all-gathers each weight where the forward uses it
+    and reduce-scatters its gradient straight into the owning chip's
+    shard, so per-chip param+opt state is ~1/n (the fsdp layout with
+    the gradient scatter pinned explicitly)."""
+    shard = ((r".*", P(axis)),)
+    return ShardingPlan(
+        name="zero3",
+        param_rules=shard,
+        opt_rules=shard,
+        grad_rules=shard,
+        description=f"params + opt state + grads sharded over {axis} "
+                    "(gather-on-use, reduce-scatter)")
+
+
+def pipeline_plan(schedule: str, axis: str = PIPE_AXIS,
+                  remat: str | None = None) -> ShardingPlan:
+    """Stage assignment as a plan: stage-stacked params (leading dim =
+    stage index) shard over the ``pipe`` axis, and the schedule lowers
+    through :func:`compile_step` in shard_map mode — so gpipe/1F1B
+    share the persistent compile cache, per-plan labels and the
+    ``zoo_hlo_*`` feature pipe like every other plan.  ``remat`` adds a
+    catch-all remat rule for the stage bodies."""
+    return ShardingPlan(
+        name=f"pipeline_{schedule}",
+        param_rules=((r".*", P(axis)),),
+        mode="shard_map",
+        remat_rules=((r".*", remat),) if remat else (),
+        description=f"{schedule} schedule over the {axis} axis")
+
+
+def with_remat(plan: ShardingPlan, policy: str = "full",
+               pattern: str = r".*") -> ShardingPlan:
+    """A copy of ``plan`` with a remat rule appended (and the policy in
+    the name, so compile labels and cost-model lookups see it):
+    ``with_remat(zero3(), "full")`` → ``"zero3+remat_full"``."""
+    return dataclasses.replace(
+        plan,
+        name=f"{plan.name}+remat_{policy}",
+        remat_rules=plan.remat_rules + ((str(pattern), policy),))
+
+
 def tensor_parallel(rules, axis: str = MODEL_AXIS,
                     name: str = "tp") -> ShardingPlan:
     """Megatron-style TP from a user rule table over the ``model`` axis
@@ -325,15 +501,19 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
     if name == "auto":
         raise ValueError(
             'plan="auto" is resolved by the estimator (the config '
-            "oracle picks among dp/zero1/fsdp from predicted per-chip "
-            "bytes vs the HBM budget — analysis/oracle.py); pass a "
-            "concrete plan or name here")
+            "oracle sweeps dp/zero1/zero2/fsdp/zero3 × remat against "
+            "predicted per-chip bytes vs the HBM budget — "
+            "analysis/oracle.py); pass a concrete plan or name here")
     if name in ("dp", "data_parallel", "none", ""):
         return data_parallel()
     if name == "fsdp":
         return fsdp()
     if name == "zero1":
         return zero1()
+    if name == "zero2":
+        return zero2()
+    if name == "zero3":
+        return zero3()
     raise ValueError(
         f"unknown sharding plan {value!r}; valid names: "
         f"{', '.join(PLAN_NAMES)} (tensor_parallel(...) takes a rule "
@@ -494,6 +674,15 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
     adds compile context (mesh axis shape, steps_per_dispatch) to the
     plan name in each ``zoo-hlo-report/2`` row.
     """
+    # the choke point owns the compile plane end to end: a plan compiled
+    # here gets the persistent cache whenever ZOO_COMPILE_CACHE is set,
+    # even when no estimator entry point ran first (e.g. the eager
+    # pipeline schedules).  Idempotent; no-op without the env knob.
+    from analytics_zoo_tpu.common.compile_cache import (
+        maybe_enable_persistent_cache,
+    )
+
+    maybe_enable_persistent_cache()
     plan = resolve_plan(plan)
     if plan.mode == "shard_map" or in_specs is not None:
         if in_specs is None or out_specs is None:
@@ -505,6 +694,15 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
             mesh = get_zoo_context().mesh
         step_fn = jax.shard_map(step_fn, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs, check_vma=check_vma)
+    if plan.remat_rules:
+        # enter the plan for the duration of TRACING, so resolve_remat
+        # inside any layer sees this plan's remat_rules (tracing happens
+        # under the jit call below, inside this wrapper's with-block)
+        inner = step_fn
+
+        def step_fn(*args):
+            with _active_plan(plan):
+                return inner(*args)
     jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
     full_meta = {"plan": plan.name, **(meta or {})}
     if "mesh_shape" not in full_meta and mesh is not None:
@@ -534,6 +732,77 @@ def per_chip_bytes(tree, device=None) -> int:
             device = shards[0].device
         total += sum(s.data.nbytes for s in shards if s.device == device)
     return total
+
+
+def live_bytes(device=None) -> dict:
+    """Measured per-chip memory: ``{"live_bytes", "peak_bytes",
+    "source"}`` for ONE device (default: the first).
+
+    On accelerators with allocator stats the numbers come straight from
+    ``device.memory_stats()`` (``bytes_in_use`` / ``peak_bytes_in_use``).
+    The CPU backend has no allocator stats, so the fallback sums the
+    shard bytes of every live ``jax.Array`` resident on the device —
+    live == peak there (what is referenced is what exists), which is
+    exactly the persistent param+opt state the bench compares against
+    :func:`~analytics_zoo_tpu.analysis.costmodel.predict_chip_bytes`."""
+    if device is None:
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("bytes_in_use") is not None:
+        in_use = int(stats["bytes_in_use"])
+        return {"live_bytes": in_use,
+                "peak_bytes": int(stats.get("peak_bytes_in_use", in_use)),
+                "source": "memory_stats"}
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for s in arr.addressable_shards:
+                if s.device == device:
+                    total += s.data.nbytes
+        except Exception:  # deleted/donated buffers mid-iteration
+            continue
+    return {"live_bytes": int(total), "peak_bytes": int(total),
+            "source": "live_arrays"}
+
+
+def record_mem_gauges(label: str, predicted_bytes: int | None = None,
+                      measured_bytes: int | None = None,
+                      device=None) -> dict:
+    """Publish the ``zoo_mem_*`` gauge family for one plan label —
+    closing the memory loop the way ``zoo_oracle`` rel_error does for
+    steps/sec: ``zoo_mem_live_bytes`` / ``zoo_mem_peak_bytes`` (from
+    :func:`live_bytes`, or ``measured_bytes`` when the caller already
+    measured, e.g. ``per_chip_bytes`` of the state it placed),
+    ``zoo_mem_predicted_bytes`` and ``zoo_mem_rel_error`` when the cost
+    model's prediction is given.  Returns the measured dict."""
+    from analytics_zoo_tpu.metrics import get_registry
+
+    if measured_bytes is not None:
+        meas = {"live_bytes": int(measured_bytes),
+                "peak_bytes": int(measured_bytes), "source": "caller"}
+    else:
+        meas = live_bytes(device)
+    reg = get_registry()
+    lab = ("label",)
+    reg.gauge("zoo_mem_live_bytes",
+              "measured per-chip bytes for a plan label",
+              lab).labels(label=label).set(meas["live_bytes"])
+    reg.gauge("zoo_mem_peak_bytes",
+              "peak per-chip bytes for a plan label",
+              lab).labels(label=label).set(meas["peak_bytes"])
+    if predicted_bytes is not None:
+        reg.gauge("zoo_mem_predicted_bytes",
+                  "cost-model predicted per-chip bytes",
+                  lab).labels(label=label).set(int(predicted_bytes))
+        if predicted_bytes > 0:
+            rel = abs(meas["live_bytes"] - predicted_bytes) / predicted_bytes
+            reg.gauge("zoo_mem_rel_error",
+                      "|measured - predicted| / predicted chip bytes",
+                      lab).labels(label=label).set(rel)
+    return meas
 
 
 def serialize_specs(spec_tree) -> list:

@@ -232,14 +232,23 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None,
     length; a coincidental unrelated 1-D leaf is almost surely unique),
     falling back to the smallest overall for single-mirror states.
     Pass ``n_old`` when the state shape is unusual.  Leaves that do NOT
-    match the flat-vector layout are left untouched and placed
-    REPLICATED — never truncated, never force-sharded onto a dimension
-    the new mesh cannot divide.
+    match the flat-vector layout are left value-untouched and REPLICATED
+    (the plan's rules name exactly the matched flat vectors by tree
+    path) — never truncated, never force-sharded onto a dimension the
+    new mesh cannot divide.
+
+    Placement goes through :meth:`ShardingPlan.place_opt_state` — the
+    same rule→spec→clamp path every canned plan uses — so the explicit
+    layout shares one placement code path with the GSPMD plans.
     """
+    import re
+
     from jax.flatten_util import ravel_pytree
-    from jax.sharding import NamedSharding
 
     import numpy as np
+
+    from .partition import leaf_path_name
+    from .plan import ShardingPlan
 
     mesh = mesh or get_zoo_context().mesh
     n_new = dict(mesh.shape)[DATA_AXIS]
@@ -273,12 +282,22 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None,
         return leaf
 
     out = jax.tree_util.tree_map(fix, opt_state)
-    # shardings keyed on the ORIGINAL leaves (the re-padded length of a
-    # matched leaf differs from `expected` whenever n_new != n_old)
-    shardings = jax.tree_util.tree_map(
-        lambda l: NamedSharding(
-            mesh, P(DATA_AXIS) if is_flat_vec(l) else P()), opt_state)
-    return jax.device_put(out, shardings)
+    # placement through the partitioner: the rules name EXACTLY the
+    # flat vectors is_flat_vec matched (by rendered tree path), so the
+    # plan shards those over data and replicates every other leaf —
+    # including a coincidental 1-D leaf whose length happens to divide
+    # n_new, which a blanket catch-all rule would wrongly shard
+    matched = {
+        leaf_path_name(path)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]
+        if is_flat_vec(leaf)
+    }
+    plan = ShardingPlan(
+        name="zero1_explicit",
+        opt_rules=tuple((rf"^{re.escape(name)}$", P(DATA_AXIS))
+                        for name in sorted(matched))
+        + ((r".*", P()),))
+    return plan.place_opt_state(out, mesh)
 
 
 # ---------------------------------------------------------------------------

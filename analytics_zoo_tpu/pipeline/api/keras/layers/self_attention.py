@@ -167,20 +167,22 @@ class _TransformerCore(Layer):
 
     def _run_blocks_aux(self, blocks, h, mask, training, rng):
         """Run the stack; also return (mean aux loss, mean drop fraction)
-        over the MoE blocks (zeros for a dense stack)."""
-        body = self._block_forward_aux
-        if self.remat == "full":
-            body = jax.checkpoint(body, static_argnums=(3,))
-        elif self.remat == "dots":
-            body = jax.checkpoint(
-                body, static_argnums=(3,),
-                policy=jax.checkpoint_policies
-                .dots_with_no_batch_dims_saveable)
-        elif self.remat == "attn":
-            body = jax.checkpoint(
-                body, static_argnums=(3,),
-                policy=jax.checkpoint_policies
-                .save_only_these_names("attn_context"))
+        over the MoE blocks (zeros for a dense stack).
+
+        The remat policy is PLAN-resolved: a ``remat_rules`` entry on
+        the sharding plan being compiled (matched against this layer's
+        name) overrides the per-layer ``remat=`` flag, which stays the
+        trace-time default — so activation checkpointing is memory-plan
+        configuration, with one jax.checkpoint site (``apply_remat``)."""
+        from analytics_zoo_tpu.parallel.plan import (
+            apply_remat,
+            resolve_remat,
+        )
+
+        policy = resolve_remat(getattr(self, "name", None) or "blocks",
+                               default=self.remat)
+        body = apply_remat(self._block_forward_aux, policy,
+                           static_argnums=(3,))
         aux = jnp.zeros((), jnp.float32)
         drop = jnp.zeros((), jnp.float32)
         n_moe = 0

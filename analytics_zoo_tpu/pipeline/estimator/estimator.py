@@ -500,14 +500,17 @@ class Estimator:
             self.ctx.config)
 
     def _choose_auto_plan(self, params):
-        """Ask the config oracle to pick the sharding plan: predicted
-        per-chip bytes per plan (params measured from the built tree,
-        optimizer state sized via ``jax.eval_shape`` — no allocation)
+        """Ask the config oracle to pick the memory plan: predicted
+        per-chip bytes per (plan × remat) candidate (params measured
+        from the built tree, optimizer state sized via
+        ``jax.eval_shape`` — no allocation; activations estimated as
+        one param-tree copy, the usual MLP-ish order of magnitude)
         against the HBM budget, preferring the least-collective-traffic
-        plan that fits.  The full per-candidate prediction doc lands in
-        ``_auto_plan_record`` (and the plan record / bench artifacts)."""
+        least-rematted config that fits.  The full per-candidate
+        prediction doc lands in ``_auto_plan_record`` (and the plan
+        record / bench artifacts)."""
         from analytics_zoo_tpu.analysis.oracle import ConfigOracle
-        from analytics_zoo_tpu.parallel.plan import resolve_plan
+        from analytics_zoo_tpu.parallel.plan import resolve_plan, with_remat
 
         def tree_bytes(tree):
             total = 0
@@ -523,15 +526,20 @@ class Estimator:
         opt_bytes = tree_bytes(jax.eval_shape(self.optimizer.init, params))
         oracle = ConfigOracle.from_env()
         name, doc = oracle.choose_plan(
-            param_bytes, opt_bytes, self.ctx.data_parallel_size)
+            param_bytes, opt_bytes, self.ctx.data_parallel_size,
+            activation_bytes=param_bytes,
+            remat_options=(None, "full"))
         self._auto_plan_record = doc
         logger.info(
-            "plan=auto resolved to %r (per-chip %s bytes vs %s budget, "
-            "%s-way)", name,
+            "plan=auto resolved to %r (remat=%s; per-chip %s bytes vs "
+            "%s budget, %s-way)", name, doc["chosen_remat"],
             next(c["predicted_chip_bytes"] for c in doc["candidates"]
-                 if c["plan"] == name),
+                 if c["config"] == doc["chosen_config"]),
             doc["hbm_budget_bytes"], doc["n_shards"])
-        return resolve_plan(name)
+        plan = resolve_plan(name)
+        if doc["chosen_remat"]:
+            plan = with_remat(plan, doc["chosen_remat"])
+        return plan
 
     def _place_opt_state(self, opt_state, plan=None):
         """Optimizer-state placement through the partitioner — the one
@@ -544,6 +552,33 @@ class Estimator:
     def _place_params(self, params, plan=None):
         plan = plan if plan is not None else self._resolved_plan()
         return plan.place_params(params, self.ctx.mesh)
+
+    def _publish_mem_gauges(self, plan, params, opt_state):
+        """zoo_mem_* per plan label: measured per-chip param+opt bytes
+        of the state just placed, against the cost model's
+        ``predict_chip_bytes`` for this plan/mesh."""
+        from analytics_zoo_tpu.analysis.costmodel import predict_chip_bytes
+        from analytics_zoo_tpu.parallel.plan import (
+            per_chip_bytes,
+            record_mem_gauges,
+        )
+
+        try:
+            global_bytes = [
+                sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                    for l in jax.tree_util.tree_leaves(t)
+                    if hasattr(l, "shape"))
+                for t in (params, opt_state)]
+            predicted = predict_chip_bytes(
+                global_bytes[0], global_bytes[1], plan.name,
+                self.ctx.data_parallel_size)
+            measured = per_chip_bytes((params, opt_state))
+            tag = "" if plan.name == "dp" else f"_{plan.name}"
+            record_mem_gauges(f"train_step{tag}",
+                              predicted_bytes=predicted,
+                              measured_bytes=measured)
+        except Exception as e:  # telemetry must never fail a fit
+            logger.debug("zoo_mem gauges skipped: %s", e)
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -663,6 +698,11 @@ class Estimator:
             if frozen:
                 grads = _mask_frozen(grads)
             grads = _clip_grads(grads, grad_clip)
+            # ZeRO-2/3: grad_rules pin each gradient to per-chip shards,
+            # so XLA lowers the gradient sum as a reduce-scatter and the
+            # optimizer update below runs on 1/n of every leaf; plans
+            # without grad_rules (dp/zero1/fsdp) leave this to GSPMD.
+            grads = plan.constrain_grads(grads, mesh)
             updates, opt_state = opt.update(grads, opt_state, params)
             # Plan layout, in-graph: pinning the optimizer state (zero1/
             # fsdp) makes XLA partition the moment updates — and
@@ -773,15 +813,19 @@ class Estimator:
               seed: int | None = None,
               autotune=None, plan=None):
         """``plan``: a :class:`~analytics_zoo_tpu.parallel.plan.
-        ShardingPlan` (or canned-plan name — "dp"/"zero1"/"fsdp") laying
-        out params, optimizer state and the batch for this fit; ``None``
-        defers to the estimator's plan, then ``ZOO_SHARDING_PLAN`` /
-        the legacy ``ZOO_SHARD_OPTIMIZER``, then data parallelism.  A
-        plan changes where bytes live (fsdp: ~1/n param+opt bytes per
-        chip) and which collectives XLA inserts, never the math: fsdp
-        trains BIT-identically to dp; zero1's differently-grouped
+        ShardingPlan` (or canned-plan name — "dp"/"zero1"/"zero2"/
+        "fsdp"/"zero3") laying out params, optimizer state, grads and
+        the batch for this fit; ``None`` defers to the estimator's
+        plan, then ``ZOO_SHARDING_PLAN`` / the legacy
+        ``ZOO_SHARD_OPTIMIZER``, then data parallelism.  A plan changes
+        where bytes live (fsdp/zero3: ~1/n param+opt bytes per chip;
+        zero2 reduce-scatters grads at zero1's resident state) and
+        which collectives XLA inserts, never the math: fsdp/zero3 train
+        BIT-identically to dp; zero1/zero2's differently-grouped
         gradient reduction matches to float tolerance (ulp-level —
-        BENCH_PARTITION_r10.json records the max |Δ|).  See
+        BENCH_PARTITION_r10.json / BENCH_MEMORY_r12.json record the
+        max |Δ|).  ``"auto"`` asks the config oracle to sweep the
+        (plan × remat) space against the HBM budget.  See
         docs/parallelism.md.
 
         ``autotune``: ``True`` (or ``ZOO_AUTOTUNE=1`` via the config
@@ -882,6 +926,10 @@ class Estimator:
         state = jax.device_put(state, repl)
         params = self._place_params(params, plan)
         opt_state = self._place_opt_state(opt_state, plan)
+        # Close the MEMORY loop (zoo_mem_* family): measured per-chip
+        # param+opt bytes under this plan vs predict_chip_bytes, the
+        # way zoo_oracle rel_error closes steps/sec predictions.
+        self._publish_mem_gauges(plan, params, opt_state)
         # Checkpoint spec record: the plan's clamped spec trees ride
         # every snapshot, so a resume (any mesh size, any process) can
         # see what layout the state was trained under and reshard

@@ -1,0 +1,32 @@
+"""zoolint fixture: raw-remat — decorator/partial/call-site positives,
+apply_remat choke-point + suppressed negatives.  Never imported; linted
+statically."""
+
+from functools import partial
+
+import jax
+
+from analytics_zoo_tpu.parallel.plan import apply_remat
+
+
+@jax.checkpoint  # POSITIVE (decorator)
+def bare_decorated(x):
+    return x * 2
+
+
+@partial(jax.remat, static_argnums=(1,))  # POSITIVE (partial decorator)
+def partial_decorated(x, flag):
+    return x * 2
+
+
+def plain(x):
+    return x + 1
+
+
+bad_call = jax.checkpoint(plain)  # POSITIVE (call site)
+
+# NEGATIVE: routed through the plan's one blessed checkpoint site — the
+# policy stays overridable by a plan's remat_rules
+blessed = apply_remat(plain, "full")
+
+justified = jax.remat(plain)  # zoolint: disable=raw-remat -- fixture: deliberate bypass with a recorded reason

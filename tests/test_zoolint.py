@@ -215,6 +215,42 @@ class TestRawJitRule:
         assert not active, [str(f) for f in active]
 
 
+class TestRawRematRule:
+    FX = "fx_raw_remat.py"
+
+    def test_raw_remat_positives(self):
+        """Decorator, partial-decorator and call-site checkpoints outside
+        apply_remat are flagged; the apply_remat routing stays quiet."""
+        active = _active(_lint_fixture(self.FX, "raw-remat"))
+        lines = {f.line for f in active}
+        assert _line_of(self.FX, "POSITIVE (decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (partial decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (call site)") in lines
+        assert len(active) == 3  # apply_remat negative stays quiet
+
+    def test_suppressed_negative(self):
+        sup = _suppressed(_lint_fixture(self.FX, "raw-remat"))
+        assert [f.line for f in sup] == \
+            [_line_of(self.FX, "deliberate bypass")]
+
+    def test_package_remat_routed(self):
+        """The call sites the rule exists for: the transformer blocks and
+        the pipeline stage bodies now checkpoint only through
+        apply_remat/resolve_remat — zero active raw-remat findings."""
+        from analytics_zoo_tpu.analysis import lint_paths
+
+        mods = [
+            os.path.join(REPO, "analytics_zoo_tpu", p) for p in (
+                "pipeline/api/keras/layers/self_attention.py",
+                "parallel/pipeline.py",
+                "pipeline/estimator/estimator.py",
+            )
+        ]
+        active = [f for f in _active(lint_paths(mods))
+                  if f.rule == "raw-remat"]
+        assert not active, [str(f) for f in active]
+
+
 class TestGuardedByRule:
     FX = "fx_guarded_by.py"
 
