@@ -48,6 +48,7 @@ from typing import Iterable, Mapping, Sequence
 __all__ = [
     "PeakTable", "resolve_peaks", "PLATFORM_PEAKS", "MIN_FIT_SAMPLES",
     "normalize_features", "predict_step_seconds", "predict_steps_per_sec",
+    "plan_exposed_fraction", "EXPOSED_FRACTIONS",
     "predict_chip_bytes", "plan_collective_bytes", "PLAN_MEMORY_FACTORS",
     "REMAT_ACTIVATION_FACTORS", "REMAT_FLOPS_FACTORS",
     "ResidualModel", "load_report_rows", "load_bench_rows",
@@ -171,6 +172,10 @@ _FEATURE_ALIASES = {
     "fused_dispatch_count": ("fused_dispatch_count",
                              "zoo_hlo_fused_dispatches"),
     "op_count": ("op_count", "zoo_hlo_ops"),
+    "async_collective_count": ("async_collective_count",
+                               "zoo_hlo_async_collectives"),
+    "overlapped_collective_bytes": ("overlapped_collective_bytes",
+                                    "zoo_hlo_overlapped_collective_bytes"),
 }
 
 
@@ -191,28 +196,75 @@ def normalize_features(features: Mapping) -> dict:
     return out
 
 
+#: fraction of a plan's collective seconds that stays EXPOSED (serial
+#: with compute) per overlap mode.  Serial plans expose everything —
+#: the pre-overlap additive roofline exactly.  Bucketed "+overlap"
+#: plans hide all but the tail: the last gradient bucket's
+#: reduce-scatter has no backward segment left to hide behind, and the
+#: first prefetch gather precedes any compute — validated against the
+#: measured serial/bucketed legs in BENCH_OVERLAP_r13.json.
+EXPOSED_FRACTIONS = {"serial": 1.0, "overlap": 0.25}
+
+
+def plan_exposed_fraction(plan: str | None) -> float:
+    """Exposed-collective fraction for a plan NAME: ``+overlap`` plans
+    (bucketed grad scatter / gather prefetch) hide all but the tail
+    bucket; every other plan serializes its collectives after the
+    backward (fraction 1.0 — the old additive model)."""
+    if plan is None:
+        return EXPOSED_FRACTIONS["serial"]
+    # segment match, not suffix: with_remat() composes names like
+    # "fsdp+overlap+remat_full"
+    return (EXPOSED_FRACTIONS["overlap"]
+            if "overlap" in str(plan).split("+")
+            else EXPOSED_FRACTIONS["serial"])
+
+
 def predict_step_seconds(features: Mapping, k: int = 1,
-                         peaks: PeakTable | None = None) -> float:
-    """Roofline per-STEP wall seconds at ``steps_per_dispatch=k``:
-    ``max(flops/peak_flops, bytes/peak_bw) + collective_bytes/link_bw
-    + dispatch_overhead/k``.  The max() is the classic roofline (the
-    step is bound by the slower of compute and memory); collectives
-    serialize after it (they overlap poorly on the synchronous train
-    step); the overhead term is what K amortizes."""
+                         peaks: PeakTable | None = None,
+                         plan: str | None = None,
+                         exposed_fraction: float | None = None) -> float:
+    """Overlap-aware roofline per-STEP wall seconds at
+    ``steps_per_dispatch=k``:
+    ``max(compute, memory, overlappable_collectives)
+    + exposed_collectives + dispatch_overhead/k``.
+
+    The max() is the classic roofline extended with the collective
+    seconds a latency-hiding schedule can run CONCURRENTLY with
+    compute; only the exposed remainder serializes after it.  The
+    exposed fraction comes from (highest priority first) the
+    ``exposed_fraction`` argument, the ``overlapped_collective_bytes``
+    feature when the HLO actually contains async start/done pairs, or
+    the plan name (:func:`plan_exposed_fraction` — serial plans expose
+    1.0, which reproduces the pre-overlap additive model EXACTLY).  The
+    overhead term is what K amortizes."""
     peaks = peaks if peaks is not None else resolve_peaks()
     f = normalize_features(features)
     compute_s = f["matmul_flops"] / max(peaks.flops, 1.0)
     memory_s = f["bytes_accessed"] / max(peaks.hbm_bytes_per_s, 1.0)
     collective_s = f["collective_bytes"] / max(peaks.link_bytes_per_s, 1.0)
+    if exposed_fraction is None:
+        overlapped = f["overlapped_collective_bytes"]
+        if overlapped > 0 and f["collective_bytes"] > 0:
+            exposed_fraction = 1.0 - overlapped / f["collective_bytes"]
+        else:
+            exposed_fraction = plan_exposed_fraction(plan)
+    exposed_fraction = min(max(float(exposed_fraction), 0.0), 1.0)
+    overlappable_s = collective_s * (1.0 - exposed_fraction)
+    exposed_s = collective_s * exposed_fraction
     overhead_s = peaks.dispatch_overhead_s / max(int(k), 1)
-    return max(compute_s, memory_s) + collective_s + overhead_s
+    return max(compute_s, memory_s, overlappable_s) + exposed_s \
+        + overhead_s
 
 
 def predict_steps_per_sec(features: Mapping, k: int = 1,
-                          peaks: PeakTable | None = None) -> float:
+                          peaks: PeakTable | None = None,
+                          plan: str | None = None,
+                          exposed_fraction: float | None = None) -> float:
     """Inverse of :func:`predict_step_seconds`."""
-    return 1.0 / max(predict_step_seconds(features, k=k, peaks=peaks),
-                     1e-12)
+    return 1.0 / max(
+        predict_step_seconds(features, k=k, peaks=peaks, plan=plan,
+                             exposed_fraction=exposed_fraction), 1e-12)
 
 
 # ---------------------------------------------------------------------------
@@ -262,9 +314,10 @@ REMAT_FLOPS_FACTORS = {
 
 
 def _plan_key(plan: str) -> str:
-    """Normalize a plan name for table lookup: a ``+remat_*`` suffix
-    (``with_remat`` naming) strips off, and every ``pipeline_<schedule>``
-    plan shares the ``pipeline`` row."""
+    """Normalize a plan name for table lookup: a ``+remat_*`` /
+    ``+overlap`` suffix (``with_remat`` / ``overlap=`` naming) strips
+    off, and every ``pipeline_<schedule>`` plan shares the ``pipeline``
+    row."""
     base = str(plan).split("+", 1)[0]
     return "pipeline" if base.startswith("pipeline") else base
 

@@ -35,7 +35,14 @@ first inputs of the ROADMAP's cost-model-driven compile plane):
   ``collective_permute``/``collective_broadcast``; per op the FULL
   participating tensor counts (max of operand/result bytes), so a
   2-device reduce-scatter of a per-device ``tensor<4xf32>`` is 16
-  bytes even though each device keeps only half;
+  bytes even though each device keeps only half.  Asynchronous
+  *paired* forms — ``all_gather_start``/``all_gather_done`` (and the
+  XLA-HLO dashed spellings ``all-gather-start``/``-done``, plus async
+  ``custom_call`` wrappers) — count ONCE per pair, at the start op;
+- ``async_collective_count`` / ``overlapped_collective_bytes``: the
+  subset of the collectives above issued as start/done pairs — the
+  latency-hiding scheduler's overlappable traffic, the overlap-aware
+  roofline's ``exposed_fraction`` numerator;
 - ``fused_dispatch_count``: ``stablehlo.while`` ops (one per
   ``lax.scan``/``fori_loop`` — the K-step fused dispatch shape).
 
@@ -89,6 +96,27 @@ DEFAULT_EXPECTED_COLLECTIVES = (
 _COLLECTIVE_OPS = frozenset(
     {"all_reduce", "all_gather", "reduce_scatter", "all_to_all",
      "collective_permute", "collective_broadcast"})
+
+#: the XLA latency-hiding scheduler splits a collective into a
+#: start/done pair; the pair is ONE logical transfer and must count
+#: once (at the start op) or the expected-collectives lint misfires
+#: twice per overlap-scheduled reduce
+_XLA_ASYNC_RE = re.compile(
+    r"\b(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute|collective-broadcast)-(start|done)\b")
+_ASYNC_CUSTOM_CALL_RE = re.compile(
+    r"(all_gather|all_reduce|reduce_scatter|all_to_all|"
+    r"collective_permute|collective_broadcast)[\w.]*?_(start|done)\b")
+
+
+def _split_async_collective(op: str) -> tuple[str, str | None]:
+    """``all_gather_start`` -> ``("all_gather", "start")``; a plain
+    (synchronous) op comes back with phase ``None``."""
+    for phase in ("start", "done"):
+        suffix = "_" + phase
+        if op.endswith(suffix) and op[:-len(suffix)] in _COLLECTIVE_OPS:
+            return op[:-len(suffix)], phase
+    return op, None
 
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
@@ -145,6 +173,8 @@ class HloReport:
     bytes_accessed: int = 0
     collective_count: int = 0
     collective_bytes: int = 0
+    async_collective_count: int = 0
+    overlapped_collective_bytes: int = 0
     fused_dispatch_count: int = 0
     collectives: dict = field(default_factory=dict)
     op_histogram: dict = field(default_factory=dict)
@@ -156,6 +186,7 @@ class HloReport:
     plan: str | None = None
     mesh_shape: dict | None = None
     steps_per_dispatch: int | None = None
+    xla_flags: tuple | None = None
 
     def features(self) -> dict:
         """The flat feature dict exported to metrics / JSON — the cost-
@@ -166,6 +197,9 @@ class HloReport:
             "bytes_accessed": self.bytes_accessed,
             "collective_count": self.collective_count,
             "collective_bytes": self.collective_bytes,
+            "async_collective_count": self.async_collective_count,
+            "overlapped_collective_bytes":
+                self.overlapped_collective_bytes,
             "fused_dispatch_count": self.fused_dispatch_count,
         }
 
@@ -186,6 +220,8 @@ class HloReport:
             "mesh_shape": dict(self.mesh_shape)
             if self.mesh_shape else None,
             "steps_per_dispatch": self.steps_per_dispatch,
+            "xla_flags": list(self.xla_flags) if self.xla_flags
+            else None,
             "dtype_histogram": dict(self.dtype_histogram),
         }
 
@@ -292,29 +328,68 @@ def analyze_hlo_text(
             rpt.matmul_flops += _conv_flops(line, operands, results)
         elif op == "while":
             rpt.fused_dispatch_count += 1
-        elif op in _COLLECTIVE_OPS:
-            rpt.collective_count += 1
-            rpt.collectives[op] = rpt.collectives.get(op, 0) + 1
-            # the FULL participating tensor moves over the interconnect:
-            # for all_reduce operand == result, for reduce_scatter the
-            # operand is N× the (scattered) result, for all_gather the
-            # result is N× the operand — max() covers all three shapes
-            rpt.collective_bytes += max(
-                sum(t.nbytes for t in operands),
-                sum(t.nbytes for t in results))
-            if op not in expected_collectives:
-                rpt.findings.append(Finding(
-                    rule="hlo-all-gather" if "gather" in op
-                    else "hlo-collective", severity=Severity.WARNING,
-                    path=label, line=lineno,
-                    message=f"unexpected `{op}` in the graph — in a "
-                    "data-parallel step this usually means a sharding "
-                    "mismatch is regathering state every dispatch",
-                    data={"op": op}))
+        elif _split_async_collective(op)[0] in _COLLECTIVE_OPS:
+            base, phase = _split_async_collective(op)
+            if phase != "done":
+                # a start/done pair is ONE logical transfer: count it at
+                # the start op, skip the done op entirely (counting both
+                # would double traffic and fire the expected-collectives
+                # lint twice per overlap-scheduled reduce)
+                rpt.collective_count += 1
+                rpt.collectives[base] = rpt.collectives.get(base, 0) + 1
+                # the FULL participating tensor moves over the
+                # interconnect: for all_reduce operand == result, for
+                # reduce_scatter the operand is N× the (scattered)
+                # result, for all_gather the result is N× the operand —
+                # max() covers all three shapes
+                moved = max(
+                    sum(t.nbytes for t in operands),
+                    sum(t.nbytes for t in results))
+                rpt.collective_bytes += moved
+                if phase == "start":
+                    rpt.async_collective_count += 1
+                    rpt.overlapped_collective_bytes += moved
+                if base not in expected_collectives:
+                    rpt.findings.append(Finding(
+                        rule="hlo-all-gather" if "gather" in base
+                        else "hlo-collective", severity=Severity.WARNING,
+                        path=label, line=lineno,
+                        message=f"unexpected `{op}` in the graph — in a "
+                        "data-parallel step this usually means a "
+                        "sharding mismatch is regathering state every "
+                        "dispatch", data={"op": op, "base": base}))
         elif op == "custom_call":
             m = _CUSTOM_CALL_RE.search(line)
             target = m.group(1) if m else "?"
-            if re.search(r"callback|python|py_", target, re.IGNORECASE):
+            am = _ASYNC_CUSTOM_CALL_RE.search(target)
+            if am:
+                # async wrapper spelled as a custom_call (some backends
+                # lower latency-hiding collectives this way) — same
+                # pair-counts-once rule keyed on the target name
+                base = am.group(1)
+                if am.group(2) == "start":
+                    moved = max(
+                        sum(t.nbytes for t in operands),
+                        sum(t.nbytes for t in results))
+                    rpt.collective_count += 1
+                    rpt.collectives[base] = \
+                        rpt.collectives.get(base, 0) + 1
+                    rpt.collective_bytes += moved
+                    rpt.async_collective_count += 1
+                    rpt.overlapped_collective_bytes += moved
+                    if base not in expected_collectives:
+                        rpt.findings.append(Finding(
+                            rule="hlo-all-gather" if "gather" in base
+                            else "hlo-collective",
+                            severity=Severity.WARNING,
+                            path=label, line=lineno,
+                            message=f"unexpected async `{target}` in "
+                            "the graph — in a data-parallel step this "
+                            "usually means a sharding mismatch is "
+                            "regathering state every dispatch",
+                            data={"target": target, "base": base}))
+            elif re.search(r"callback|python|py_", target,
+                           re.IGNORECASE):
                 rpt.findings.append(Finding(
                     rule="hlo-host-callback", severity=Severity.WARNING,
                     path=label, line=lineno,
@@ -353,6 +428,13 @@ def analyze_hlo_text(
             continue
         m = _OP_RE.search(line)
         if not m:
+            # post-optimization XLA HLO spells async pairs with dashes
+            # (`all-gather-start` / `all-gather-done`) and no stablehlo.
+            # prefix — normalize to the underscore pair form
+            am = _XLA_ASYNC_RE.search(line)
+            if am:
+                account(am.group(1).replace("-", "_") + "_"
+                        + am.group(2), line, lineno)
             continue
         op = m.group(1)
         if op == "return":
@@ -424,6 +506,12 @@ def _emit_metrics(rpt: HloReport) -> None:
         "zoo_hlo_collective_bytes":
             ("bytes moved by collective ops in the lowered module",
              rpt.collective_bytes),
+        "zoo_hlo_async_collectives":
+            ("async start/done collective pairs in the lowered module "
+             "(each pair counts once)", rpt.async_collective_count),
+        "zoo_hlo_overlapped_collective_bytes":
+            ("bytes moved by async (overlappable) collective pairs in "
+             "the lowered module", rpt.overlapped_collective_bytes),
         "zoo_hlo_fused_dispatches":
             ("while loops (lax.scan / fori_loop) in the lowered module",
              rpt.fused_dispatch_count),
@@ -471,7 +559,9 @@ def lint_lowered(lowered, label: str = "module",
     ``report_dir`` defaults to ``ZOO_HLO_REPORT_DIR``; pass a path to
     force a report, or rely on the env knob.  ``meta`` carries the
     schema-v2 compile context the lowered text cannot show (``plan``,
-    ``mesh_shape``, ``steps_per_dispatch``).  ``defer_report=True``
+    ``mesh_shape``, ``steps_per_dispatch``; an optional
+    ``expected_collectives`` widens the collective lint's allow-list
+    for graphs that gather by design).  ``defer_report=True``
     skips the report write — :func:`timed_compile` uses it to lint
     BEFORE compiling (the crash-dump contract: the flight ring must say
     what was being compiled if the compile dies) and write the report
@@ -479,8 +569,16 @@ def lint_lowered(lowered, label: str = "module",
     wall-seconds exist.
     """
     text = lowered.as_text()
-    rpt = analyze_hlo_text(text, label=label)
-    for key in ("plan", "mesh_shape", "steps_per_dispatch"):
+    expected = DEFAULT_EXPECTED_COLLECTIVES
+    if meta and meta.get("expected_collectives"):
+        # the caller KNOWS its graph gathers (zero3 / fsdp prefetch
+        # regather parameters by design) — widening the expected set
+        # here beats suppressing the finding after the fact
+        expected = tuple(meta["expected_collectives"])
+    rpt = analyze_hlo_text(text, label=label,
+                           expected_collectives=expected)
+    for key in ("plan", "mesh_shape", "steps_per_dispatch",
+                "xla_flags"):
         if meta and meta.get(key) is not None:
             setattr(rpt, key, meta[key])
     remember_report(rpt)

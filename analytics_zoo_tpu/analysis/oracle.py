@@ -37,6 +37,7 @@ from analytics_zoo_tpu.analysis.costmodel import (
     PeakTable,
     ResidualModel,
     plan_collective_bytes,
+    plan_exposed_fraction,
     predict_chip_bytes,
     predict_steps_per_sec,
     resolve_peaks,
@@ -207,8 +208,10 @@ class ConfigOracle:
         """The sharding plan ``plan="auto"`` resolves to: among the
         (plan × remat) candidates whose predicted per-chip bytes fit
         the HBM budget, the one whose predicted step time (roofline ×
-        the remat recompute factor + the plan's per-step collective
-        traffic over the link ceiling) is lowest — i.e. the
+        the remat recompute factor, plus the *exposed* slice of the
+        plan's per-step collective traffic over the link ceiling —
+        ``+overlap`` candidates hide the rest behind compute, serial
+        plans expose all of it) is lowest — i.e. the
         least-sharded, least-rematted feasible config, since sharding
         only adds collectives and remat only adds FLOPs.  Ties keep
         candidate order.  Returns ``(plan_name, doc)``; the doc records
@@ -231,8 +234,18 @@ class ConfigOracle:
                     batch_bytes=batch_bytes,
                     activation_bytes=activation_bytes, remat=remat)
                 coll = plan_collective_bytes(param_bytes, plan, n_shards)
-                step_s = (base_s * REMAT_FLOPS_FACTORS[remat]
-                          + coll / max(self.peaks.link_bytes_per_s, 1.0))
+                coll_s = coll / max(self.peaks.link_bytes_per_s, 1.0)
+                # Overlap-aware roofline: a "+overlap" candidate hides
+                # (1 - exposed) of its collective time behind compute,
+                # so only the exposed slice is additive.  Serial plans
+                # have exposed == 1.0, which reduces to the old purely
+                # additive formula bit-for-bit — the default candidate
+                # sweep (and fit(plan="auto") agreement with it) is
+                # unchanged.
+                exposed = plan_exposed_fraction(plan)
+                compute_s = base_s * REMAT_FLOPS_FACTORS[remat]
+                step_s = (max(compute_s, coll_s * (1.0 - exposed))
+                          + coll_s * exposed)
                 config = f"plan={plan}" if remat is None \
                     else f"plan={plan}+remat_{remat}"
                 candidates.append({

@@ -174,9 +174,11 @@ class PrngReuseRule(Rule):
 class HostSyncRule(Rule):
     name = "host-sync"
     severity = Severity.WARNING
-    description = ("device sync (block_until_ready / np.asarray / "
-                   "float()/int() on arrays) inside a "
-                   "`# zoolint: hot-path` function")
+    description = ("device sync (block_until_ready / device_get / "
+                   "np.asarray / .item() / float()/int() on arrays) "
+                   "inside a `# zoolint: hot-path` function; syncs "
+                   "lexically inside the dispatch loop itself are "
+                   "called out as blocking the next feed")
 
     _SYNC_QUALNAMES = {
         "jax.block_until_ready", "jax.device_get",
@@ -191,6 +193,19 @@ class HostSyncRule(Rule):
             fn = mod.enclosing_function(fn)
         return False
 
+    def _in_loop(self, mod: LintModule, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a for/while loop of its
+        enclosing function (not counting outer functions' loops)?  A
+        sync there runs BETWEEN dispatches: it blocks the host until
+        the device drains before the next batch can even be fed."""
+        fn = mod.enclosing_function(node)
+        cur = mod.parents.get(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            cur = mod.parents.get(cur)
+        return False
+
     def check(self, mod: LintModule) -> Iterator[Finding]:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
@@ -199,6 +214,10 @@ class HostSyncRule(Rule):
             if isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "block_until_ready":
                 what = ".block_until_ready()"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" \
+                    and not node.args and not node.keywords:
+                what = ".item()"
             else:
                 q = mod.qualname(node.func)
                 if q in self._SYNC_QUALNAMES:
@@ -208,14 +227,26 @@ class HostSyncRule(Rule):
                     what = f"{q}()"
             if what is None or not self._in_hot_path(mod, node):
                 continue
-            yield self.finding(
-                mod, node,
-                f"{what} on a hot path forces a host/device sync — "
-                "it stalls async dispatch until the device catches up; "
-                "move it off the hot path or suppress with a "
-                "justification if the sync (or host-only data) is "
-                "intentional",
-                call=what)
+            if self._in_loop(mod, node):
+                yield self.finding(
+                    mod, node,
+                    f"{what} between dispatch and the next feed in a "
+                    "hot-path loop — the host blocks until the device "
+                    "drains before it can even feed the next batch, "
+                    "serializing every iteration; hoist it out of the "
+                    "loop (or onto a background thread) or suppress "
+                    "with a justification if the per-iteration sync is "
+                    "deliberate",
+                    call=what, in_loop=True)
+            else:
+                yield self.finding(
+                    mod, node,
+                    f"{what} on a hot path forces a host/device sync — "
+                    "it stalls async dispatch until the device catches "
+                    "up; move it off the hot path or suppress with a "
+                    "justification if the sync (or host-only data) is "
+                    "intentional",
+                    call=what)
 
 
 class NonDonatedCarryRule(Rule):

@@ -147,7 +147,15 @@ class ZooConfig:
                                / reduce-scatter — ~1/n param+opt bytes
                                per chip at a bit-identical loss
                                trajectory; zero3 also shards the
-                               gradient tree in-graph).  fit(
+                               gradient tree in-graph).  Any of
+                               zero1/zero2/zero3/fsdp also accepts a
+                               "+overlap" suffix (e.g.
+                               "zero2+overlap"): gradient collectives
+                               are bucketed behind backward compute
+                               and fsdp gathers double-buffered —
+                               same bitwise trajectory, less exposed
+                               collective time (docs/performance.md
+                               "Latency hiding").  fit(
                                plan="auto") asks the oracle to sweep
                                these × remat policies against the HBM
                                budget.  Tensor-parallel and pipeline
@@ -155,6 +163,25 @@ class ZooConfig:
                                passed as objects (fit(plan=
                                tensor_parallel(rules))), not named
                                here.
+      ZOO_OVERLAP_BUCKET_BYTES target gradient-bucket size (bytes) for
+                               "+overlap" plans — each bucket's
+                               reduce-scatter/all-reduce is issued as
+                               its backward segment completes
+                               (parallel/plan.py
+                               default_bucket_bytes; default 4 MiB).
+                               Grouping is part of the plan cache key,
+                               so changing it recompiles but never
+                               changes the trajectory.
+      ZOO_ASYNC_CHECKPOINT     "0" forces checkpoint saves back onto
+                               the train thread (gather + serialize +
+                               atomic rename inline).  Default on:
+                               saves snapshot on-device, then gather/
+                               serialize/rename on a daemon writer
+                               thread — fit stalls only for the
+                               snapshot (zoo_ckpt_stall_seconds vs
+                               zoo_ckpt_write_seconds), a kill mid-
+                               write leaves the previous complete
+                               checkpoint loadable.
       ZOO_DCN_AXIS             mesh axis that crosses the data-center
                                network when parallel.plan.build_mesh
                                assembles a hybrid ICI x DCN mesh from a
@@ -375,10 +402,18 @@ class ZooConfig:
             from analytics_zoo_tpu.parallel.plan import PLAN_NAMES
 
             valid = tuple(PLAN_NAMES) + ("auto",)
-            if str(self.sharding_plan).strip().lower() not in valid:
+            name = str(self.sharding_plan).strip().lower()
+            base = name[:-len("+overlap")] \
+                if name.endswith("+overlap") else name
+            overlappable = ("zero1", "zero2", "zero3", "fsdp")
+            ok = name in valid or (name.endswith("+overlap")
+                                   and base in overlappable)
+            if not ok:
                 raise ValueError(
                     f"ZOO_SHARDING_PLAN must be one of "
-                    f"{', '.join(valid)}; got {self.sharding_plan!r}")
+                    f"{', '.join(valid)} (zero1/zero2/zero3/fsdp also "
+                    f"accept a '+overlap' suffix); "
+                    f"got {self.sharding_plan!r}")
         self.dcn_axis = resolve(
             self.dcn_axis, "ZOO_DCN_AXIS", None, cast=str)
         if self.dcn_axis is not None and not str(self.dcn_axis).strip():

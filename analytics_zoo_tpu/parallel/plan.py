@@ -69,7 +69,8 @@ __all__ = [
     "apply_remat", "resolve_remat", "REMAT_POLICIES",
     "per_chip_bytes", "live_bytes", "record_mem_gauges",
     "serialize_specs", "deserialize_specs",
-    "PLAN_NAMES",
+    "PLAN_NAMES", "DEFAULT_BUCKET_BYTES", "default_bucket_bytes",
+    "grad_bucket_indices",
 ]
 
 #: names ``ZOO_SHARDING_PLAN`` / ``resolve_plan`` accept (tensor
@@ -86,7 +87,99 @@ PLAN_NAMES = ("dp", "data_parallel", "none", "fsdp", "zero1", "zero2",
 #: ``jax.checkpoint_policies``
 REMAT_POLICIES = ("full", "dots", "attn")
 
+#: default gradient-overlap bucket size (bytes) when a canned plan is
+#: built with ``overlap=True`` — override per process with
+#: ``ZOO_OVERLAP_BUCKET_BYTES`` or per plan with ``overlap=<bytes>``.
+#: ~4 MiB groups enough small leaves to amortize a collective's latency
+#: without deferring the first reduce behind the whole backward.
+DEFAULT_BUCKET_BYTES = 4 << 20
+
 _REPLICATE_ALL = ((r".*", P()),)
+
+
+def default_bucket_bytes() -> int:
+    """The overlap bucket size ``overlap=True`` resolves to:
+    ``ZOO_OVERLAP_BUCKET_BYTES`` (validated > 0) over
+    :data:`DEFAULT_BUCKET_BYTES`."""
+    raw = os.environ.get("ZOO_OVERLAP_BUCKET_BYTES")
+    if not raw:
+        return DEFAULT_BUCKET_BYTES
+    try:
+        out = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ZOO_OVERLAP_BUCKET_BYTES must be a positive integer byte "
+            f"count, got {raw!r}") from None
+    if out < 1:
+        raise ValueError(
+            f"ZOO_OVERLAP_BUCKET_BYTES must be >= 1, got {out}")
+    return out
+
+
+def grad_bucket_indices(leaves, bucket_bytes: int) -> list:
+    """Group leaf INDICES into ~``bucket_bytes`` buckets in REVERSE
+    traversal order — the order the backward pass completes gradients
+    (last forward layer first), so bucket k's collective can be issued
+    while bucket k+1's backward segment is still computing.  Every
+    bucket holds at least one leaf (a single leaf larger than the
+    bucket is its own bucket)."""
+    buckets, cur, size = [], [], 0
+    for idx in reversed(range(len(leaves))):
+        leaf = leaves[idx]
+        nbytes = int(getattr(leaf, "nbytes", 0) or
+                     np.size(leaf) * np.dtype(
+                         getattr(leaf, "dtype", np.float32)).itemsize)
+        cur.append(idx)
+        size += nbytes
+        if size >= bucket_bytes:
+            buckets.append(cur)
+            cur, size = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _chain_buckets(leaves, buckets):
+    """Pin the buckets' schedule with an ``optimization_barrier`` chain:
+    bucket k+1's values pass through a barrier together with a token
+    aliased from bucket k's output, so XLA cannot collapse the bucketed
+    collectives back into one post-backward group.  Identity on values
+    (bitwise — the trajectory cannot change), and only used OUTSIDE
+    differentiated regions (``optimization_barrier`` has no AD rule;
+    the differentiable spelling is :func:`_sched_barrier`)."""
+    out = list(leaves)
+    token = None
+    for bucket in buckets:
+        vals = tuple(out[i] for i in bucket)
+        if token is None:
+            vals = jax.lax.optimization_barrier(vals)
+        else:
+            chained = jax.lax.optimization_barrier(vals + (token,))
+            vals = chained[:-1]
+        for i, v in zip(bucket, vals):
+            out[i] = v
+        token = vals[0]
+    return out
+
+
+@jax.custom_vjp
+def _sched_barrier(values: tuple):
+    """Differentiable schedule barrier: identity on ``values`` with an
+    ``optimization_barrier`` in BOTH directions — the forward barrier
+    pins the prefetch-gather order, and the transpose barrier pins the
+    matching reduce order in the backward pass."""
+    return jax.lax.optimization_barrier(values)
+
+
+def _sched_barrier_fwd(values):
+    return jax.lax.optimization_barrier(values), None
+
+
+def _sched_barrier_bwd(_, cts):
+    return (jax.lax.optimization_barrier(tuple(cts)),)
+
+
+_sched_barrier.defvjp(_sched_barrier_fwd, _sched_barrier_bwd)
 
 
 def _freeze_rules(rules):
@@ -128,6 +221,19 @@ class ShardingPlan:
     to a :data:`REMAT_POLICIES` entry; :func:`resolve_remat` consults
     the plan active during tracing, so activation checkpointing is plan
     configuration, not a per-layer flag.
+
+    ``bucket_bytes`` turns on bucketed gradient overlap (the latency-
+    hiding plane): inside the step, gradients are grouped into
+    ~bucket-sized chunks in backward-completion order and each group's
+    reduction collective is pinned (via an ``optimization_barrier``
+    chain) to issue as soon as that group's backward segment completes,
+    instead of all collectives queuing behind the full backward.
+    Identity on values — the trajectory stays bitwise equal to the
+    unbucketed plan.  ``prefetch`` adds the fsdp gather-on-use
+    schedule: sharded params are explicitly gathered bucket-by-bucket
+    ahead of use (double-buffered order pin via
+    :func:`_sched_barrier`), so layer k+1's all-gather can overlap
+    layer k's compute under a latency-hiding scheduler.
     """
 
     name: str
@@ -138,11 +244,20 @@ class ShardingPlan:
     description: str = ""
     grad_rules: tuple | None = None
     remat_rules: tuple = ()
+    bucket_bytes: int | None = None
+    prefetch: bool = False
 
     def __post_init__(self):
         if self.mode not in ("jit", "shard_map"):
             raise ValueError(
                 f"plan mode must be 'jit' or 'shard_map', got {self.mode!r}")
+        if self.bucket_bytes is not None:
+            bb = int(self.bucket_bytes)
+            if bb < 1:
+                raise ValueError(
+                    f"bucket_bytes must be a positive byte count, "
+                    f"got {self.bucket_bytes!r}")
+            object.__setattr__(self, "bucket_bytes", bb)
         object.__setattr__(self, "param_rules",
                            _freeze_rules(self.param_rules))
         if self.opt_rules is not None:
@@ -168,7 +283,7 @@ class ShardingPlan:
         the same rules compile the same program."""
         return (self.name, self.param_rules, self.opt_rules,
                 self.batch_axes, self.mode, self.grad_rules,
-                self.remat_rules)
+                self.remat_rules, self.bucket_bytes, self.prefetch)
 
     @property
     def effective_opt_rules(self) -> tuple:
@@ -272,13 +387,80 @@ class ShardingPlan:
         XLA to lower the gradient sum as a reduce-scatter (each chip
         keeps only its shard) instead of a full all-reduce, so the
         optimizer update runs on 1/n of every leaf.  ``grad_rules=None``
-        (dp/zero1/fsdp) leaves the gradients to GSPMD's own choice."""
-        if self.grad_rules is None:
+        (dp/zero1/fsdp) leaves the gradients to GSPMD's own choice.
+
+        With ``bucket_bytes`` set, the constrained gradients are
+        additionally grouped into ~bucket-sized chunks in backward-
+        completion order and schedule-pinned with an
+        ``optimization_barrier`` chain (:func:`_chain_buckets`): each
+        bucket's reduce-scatter/all-reduce is issued as its backward
+        segment completes instead of queueing behind the full backward.
+        Values are untouched — the trajectory is bitwise equal to the
+        unbucketed plan (the per-leaf reduction grouping is unchanged).
+        """
+        if self.grad_rules is not None:
+            specs = self._specs(self.grad_rules, grads, mesh)
+            grads = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, grads,
+                tree_shardings(mesh, specs))
+        if not self.bucket_bytes:
             return grads
-        specs = self._specs(self.grad_rules, grads, mesh)
-        return jax.tree_util.tree_map(
-            jax.lax.with_sharding_constraint, grads,
-            tree_shardings(mesh, specs))
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        arrays = [i for i, leaf in enumerate(leaves)
+                  if hasattr(leaf, "dtype")]
+        if len(arrays) < 2:
+            return grads  # nothing to bucket
+        buckets = grad_bucket_indices(
+            [leaves[i] for i in arrays], self.bucket_bytes)
+        chained = _chain_buckets(
+            [leaves[i] for i in arrays],
+            buckets)
+        for pos, val in zip(arrays, chained):
+            leaves[pos] = val
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def prefetch_params(self, params, mesh):
+        """The fsdp gather-prefetch schedule: explicitly all-gather
+        sharded params bucket-by-bucket IN FORWARD ORDER, each bucket's
+        gather chained behind the previous one through the
+        differentiable :func:`_sched_barrier` — a double-buffered
+        gather-on-use order pin, so bucket k+1's all-gather can issue
+        while bucket k's layer computes (XLA's latency-hiding scheduler
+        does the overlap; the chain keeps it from collapsing the
+        gathers into one prologue group).  The transpose of the
+        explicit gather is a reduce-scatter of the cotangent, barriered
+        in the matching reverse order — so the backward inherits the
+        bucketed reduction schedule for free.  No-op unless the plan
+        sets ``prefetch`` and shards params."""
+        if not (self.prefetch and self.shards_params):
+            return params
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        arrays = [i for i, leaf in enumerate(leaves)
+                  if hasattr(leaf, "dtype")]
+        if not arrays:
+            return params
+        repl = NamedSharding(mesh, P())
+        gathered = [jax.lax.with_sharding_constraint(leaves[i], repl)
+                    for i in arrays]
+        bucket_bytes = self.bucket_bytes or default_bucket_bytes()
+        # forward traversal order: gather the buckets the forward
+        # consumes first, first
+        buckets = [list(reversed(b)) for b in reversed(
+            grad_bucket_indices(gathered, bucket_bytes))]
+        token = None
+        for bucket in buckets:
+            vals = tuple(gathered[i] for i in bucket)
+            if token is None:
+                vals = _sched_barrier(vals)
+            else:
+                chained = _sched_barrier(vals + (token,))
+                vals = chained[:-1]
+            for i, v in zip(bucket, vals):
+                gathered[i] = v
+            token = vals[0]
+        for pos, val in zip(arrays, gathered):
+            leaves[pos] = val
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _clamp_spec(spec: P, shape: tuple, mesh) -> P:
@@ -386,61 +568,94 @@ def data_parallel() -> ShardingPlan:
         description="replicated params/opt state, batch over data")
 
 
-def zero1(axis: str = DATA_AXIS) -> ShardingPlan:
+def _overlap_fields(overlap) -> dict:
+    """Resolve a canned plan's ``overlap=`` argument: ``False`` → no
+    overlap (today's serial schedule), ``True`` → bucketed gradient
+    overlap at :func:`default_bucket_bytes`, an int → that bucket size.
+    The plan name gains a ``+overlap`` suffix so compile labels, the
+    estimator's step cache and the cost model's exposed-fraction lookup
+    all see the bucketed variant as a distinct program."""
+    if not overlap:
+        return {}
+    bb = default_bucket_bytes() if overlap is True else int(overlap)
+    return {"bucket_bytes": bb}
+
+
+def zero1(axis: str = DATA_AXIS, overlap=False) -> ShardingPlan:
     """Params replicated, optimizer state sharded over ``axis``
     (ZeRO-1: 1/n moment memory + update compute per chip).  Subsumes the
-    old ``ZOO_SHARD_OPTIMIZER`` GSPMD path."""
+    old ``ZOO_SHARD_OPTIMIZER`` GSPMD path.  ``overlap`` turns on
+    bucketed gradient overlap (``True`` = default bucket size, an int =
+    that many bytes per bucket; trajectory stays bitwise)."""
+    extra = _overlap_fields(overlap)
     return ShardingPlan(
-        name="zero1",
+        name="zero1+overlap" if extra else "zero1",
         param_rules=_REPLICATE_ALL,
         opt_rules=((r".*", P(axis)),),
-        description=f"replicated params, opt state sharded over {axis}")
+        description=f"replicated params, opt state sharded over {axis}",
+        **extra)
 
 
-def fsdp(axis: str = DATA_AXIS) -> ShardingPlan:
+def fsdp(axis: str = DATA_AXIS, overlap=False) -> ShardingPlan:
     """Params AND optimizer state sharded over ``axis``: XLA all-gathers
     weights where the forward uses them and reduce-scatters gradients
     into each chip's shard — per-chip param+opt bytes drop ~1/n at an
     unchanged (bit-identical) loss trajectory.  The whole-weight-update
-    sharding of arXiv:2004.13336 as a two-line rule set."""
+    sharding of arXiv:2004.13336 as a two-line rule set.  ``overlap``
+    adds bucketed gradient overlap AND the double-buffered gather
+    prefetch (:meth:`ShardingPlan.prefetch_params` — layer k+1's
+    all-gather issues while layer k computes)."""
     rules = ((r".*", P(axis)),)
+    extra = _overlap_fields(overlap)
     return ShardingPlan(
-        name="fsdp", param_rules=rules, opt_rules=rules,
+        name="fsdp+overlap" if extra else "fsdp",
+        param_rules=rules, opt_rules=rules,
+        prefetch=bool(extra),
         description=f"params + opt state sharded over {axis} "
-                    "(gather-on-use / reduce-scatter)")
+                    "(gather-on-use / reduce-scatter)",
+        **extra)
 
 
-def zero2(axis: str = DATA_AXIS) -> ShardingPlan:
+def zero2(axis: str = DATA_AXIS, overlap=False) -> ShardingPlan:
     """ZeRO-2 (arXiv:2004.13336): optimizer state sharded AND grads
     reduce-scattered into per-chip shards over ``axis``; params stay
     replicated, so the update all-gathers the new weights once per step
     (grad_rules pin the scatter, constrain_params pins the gather-at-
     update).  Same math as DP — per-chip persistent state matches
-    zero1, and the transient gradient buffer drops to 1/n."""
+    zero1, and the transient gradient buffer drops to 1/n.  ``overlap``
+    buckets the reduce-scatters into backward-completion-order groups
+    (bitwise trajectory)."""
     shard = ((r".*", P(axis)),)
+    extra = _overlap_fields(overlap)
     return ShardingPlan(
-        name="zero2",
+        name="zero2+overlap" if extra else "zero2",
         param_rules=_REPLICATE_ALL,
         opt_rules=shard,
         grad_rules=shard,
         description=f"replicated params, opt state + grads sharded over "
-                    f"{axis} (reduce-scatter, gather at update)")
+                    f"{axis} (reduce-scatter, gather at update)",
+        **extra)
 
 
-def zero3(axis: str = DATA_AXIS) -> ShardingPlan:
+def zero3(axis: str = DATA_AXIS, overlap=False) -> ShardingPlan:
     """ZeRO-3: params, optimizer state AND grads all sharded over
     ``axis`` — XLA all-gathers each weight where the forward uses it
     and reduce-scatters its gradient straight into the owning chip's
     shard, so per-chip param+opt state is ~1/n (the fsdp layout with
-    the gradient scatter pinned explicitly)."""
+    the gradient scatter pinned explicitly).  ``overlap`` buckets the
+    gradient reduce-scatters and prefetch-gathers the params
+    (bitwise trajectory)."""
     shard = ((r".*", P(axis)),)
+    extra = _overlap_fields(overlap)
     return ShardingPlan(
-        name="zero3",
+        name="zero3+overlap" if extra else "zero3",
         param_rules=shard,
         opt_rules=shard,
         grad_rules=shard,
+        prefetch=bool(extra),
         description=f"params + opt state + grads sharded over {axis} "
-                    "(gather-on-use, reduce-scatter)")
+                    "(gather-on-use, reduce-scatter)",
+        **extra)
 
 
 def pipeline_plan(schedule: str, axis: str = PIPE_AXIS,
@@ -504,16 +719,24 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
             "oracle sweeps dp/zero1/zero2/fsdp/zero3 × remat against "
             "predicted per-chip bytes vs the HBM budget — "
             "analysis/oracle.py); pass a concrete plan or name here")
+    overlap = False
+    if name.endswith("+overlap"):
+        overlap = True
+        name = name[: -len("+overlap")]
     if name in ("dp", "data_parallel", "none", ""):
+        if overlap:
+            raise ValueError(
+                "dp has no collectives to overlap; bucket_bytes applies "
+                "to zero1/zero2/zero3/fsdp")
         return data_parallel()
     if name == "fsdp":
-        return fsdp()
+        return fsdp(overlap=overlap)
     if name == "zero1":
-        return zero1()
+        return zero1(overlap=overlap)
     if name == "zero2":
-        return zero2()
+        return zero2(overlap=overlap)
     if name == "zero3":
-        return zero3()
+        return zero3(overlap=overlap)
     raise ValueError(
         f"unknown sharding plan {value!r}; valid names: "
         f"{', '.join(PLAN_NAMES)} (tensor_parallel(...) takes a rule "

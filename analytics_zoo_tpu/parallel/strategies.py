@@ -89,8 +89,29 @@ def make_shard_map_train_step(model, loss_fn, optimizer, mesh=None,
         donate_argnums=(0, 1, 2), label="shard_map_step")
 
 
+def _ring_reduce_scatter(flat, n, axis_name=DATA_AXIS):
+    """Reduce-scatter spelled as an explicit ``ppermute`` ring: the flat
+    vector (size divisible by ``n``) is viewed as ``n`` blocks, partial
+    sums circulate the ring for ``n-1`` hops, and chip ``i`` ends holding
+    block ``i`` fully summed.  The summation is LEFT-ASSOCIATIVE and
+    sequential — a different reduction grouping from ``psum_scatter``'s
+    tree, so trajectories are ulp-recorded, not bitwise (same caveat as
+    zero1 vs dp)."""
+    m = flat.size // n
+    blocks = flat.reshape(n, m)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    acc = jax.lax.dynamic_index_in_dim(blocks, (idx + 1) % n, 0,
+                                       keepdims=False)
+    for r in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + jax.lax.dynamic_index_in_dim(
+            blocks, (idx + 1 + r) % n, 0, keepdims=False)
+    return acc
+
+
 def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
-                          grad_clip=None):
+                          grad_clip=None, bucket_bytes=None, ring=False):
     """Data-parallel step with a SHARDED optimizer (ZeRO-1 spelled out):
     gradients are ``psum_scatter`` (reduce-scatter) onto each chip's 1/n
     slice of the flattened parameter vector, the optimizer update runs on
@@ -100,21 +121,45 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     (all-reduce ≡ reduce-scatter + all-gather); memory and update compute
     drop by the data-axis size.
 
+    ``bucket_bytes`` turns the single whole-vector reduce-scatter into
+    CHUNKED reduce-scatters: gradient leaves are grouped into
+    ~bucket-sized contiguous flat-vector slices in backward-completion
+    (reverse-traversal) order, and each bucket's collective is issued as
+    its own op, chained by ``optimization_barrier`` tokens so the
+    scheduler can overlap bucket k+1's backward segment with bucket k's
+    scatter.  Per-element reduction grouping is unchanged, but the
+    per-chunk padding changes each chip's slice composition — a
+    different compiled program, so XLA fusion (fma contraction) may
+    drift the trajectory by ~1 ulp vs the unbucketed step; record it
+    like zero1 vs dp.  (The GSPMD spelling,
+    ``plan.zero1(overlap=True)`` through the estimator, keeps the exact
+    program and IS bitwise-pinned.)  ``ring=True``
+    replaces ``psum_scatter`` with the explicit
+    :func:`_ring_reduce_scatter` ``ppermute`` ring, whose left-assoc
+    summation is ulp-recorded like zero1 vs dp.
+
     Returns ``(step, init_opt_state)``: the optimizer state is a
     per-shard pytree, so it must be created by ``init_opt_state(params)``
     (and checkpointed as-is — it is a different layout from the plain
-    step's).
+    step's, and the bucketed layout differs again: per-chunk padding
+    changes each chip's slice composition, which is why the bucketed
+    variants compile/init under their own labels).
 
     Like :func:`make_shard_map_train_step`, this is now a thin wrapper
     over the partitioner's choke point: both the step AND
     ``init_opt_state`` compile through
     :func:`~analytics_zoo_tpu.parallel.plan.compile_step`.  (The GSPMD
     spelling of the same idea — and of full FSDP — is
-    ``plan.zero1()`` / ``plan.fsdp()`` through the estimator.)
+    ``plan.zero1()`` / ``plan.fsdp()`` through the estimator; its
+    bucketed spelling is ``plan.zero1(overlap=True)``.)
     """
     from jax.flatten_util import ravel_pytree
 
-    from analytics_zoo_tpu.parallel.plan import ShardingPlan, compile_step
+    from analytics_zoo_tpu.parallel.plan import (
+        ShardingPlan,
+        compile_step,
+        grad_bucket_indices,
+    )
     from analytics_zoo_tpu.pipeline.estimator.estimator import (
         _normalize_grad_clip,
     )
@@ -123,18 +168,43 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
     _clip = _normalize_grad_clip(grad_clip)
     mesh = mesh or get_zoo_context().mesh
     n = mesh.shape[DATA_AXIS]
+    if bucket_bytes is not None:
+        bucket_bytes = int(bucket_bytes)
+        if bucket_bytes < 1:
+            raise ValueError(
+                f"bucket_bytes must be a positive byte count, "
+                f"got {bucket_bytes!r}")
 
-    def _shard_of(flat):
-        """This chip's slice of the (padded) flat vector."""
-        pad = (-flat.size) % n
-        flat = jnp.pad(flat, (0, pad))
-        m = flat.size // n
+    def _bucket_slices(tree):
+        """Contiguous ``(lo, hi)`` flat-vector slices, one per gradient
+        bucket, in backward-completion (tail-first) order; a single
+        whole-vector slice when unbucketed."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        sizes = [int(leaf.size) for leaf in leaves]
+        offs = [0]
+        for s in sizes:
+            offs.append(offs[-1] + s)
+        if bucket_bytes is None:
+            return [(0, offs[-1])]
+        buckets = grad_bucket_indices(leaves, bucket_bytes)
+        # each bucket is a descending contiguous index run → one slice
+        return [(offs[b[-1]], offs[b[0]] + sizes[b[0]]) for b in buckets]
+
+    def _shard_of(flat, slices):
+        """This chip's slice of each (padded) chunk, concatenated in
+        bucket order — the unbucketed layout when ``slices`` is the
+        single whole-vector slice."""
         idx = jax.lax.axis_index(DATA_AXIS)
-        return jax.lax.dynamic_slice(flat, (idx * m,), (m,))
+        parts = []
+        for lo, hi in slices:
+            c = jnp.pad(flat[lo:hi], (0, (-(hi - lo)) % n))
+            m = c.size // n
+            parts.append(jax.lax.dynamic_slice(c, (idx * m,), (m,)))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
     def _local_init(params):
         flat, _ = ravel_pytree(params)
-        return optimizer.init(_shard_of(flat))
+        return optimizer.init(_shard_of(flat, _bucket_slices(params)))
 
     repl = P()
     # optimizer-state layout: 1-D leaves mirror the flat param shard
@@ -146,14 +216,17 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         lambda leaf: P(DATA_AXIS) if getattr(leaf, "ndim", 0) >= 1
         else repl, proto)
 
+    variant = ("_bucketed" if bucket_bytes is not None else "") + \
+              ("_ring" if ring else "")
     plan = ShardingPlan(name="zero1_explicit", mode="shard_map",
+                        bucket_bytes=bucket_bytes,
                         description="explicit reduce-scatter/all-gather "
                                     "ZeRO-1 on the padded flat vector")
 
     def init_opt_state(params):
         fn = compile_step(_local_init, plan, mesh, in_specs=(repl,),
                           out_specs=opt_specs,
-                          label="zero1_init_opt_state")
+                          label=f"zero1{variant}_init_opt_state")
         return fn(params)
 
     def local_step(params, opt_state, state, rng, batch):
@@ -175,12 +248,23 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         new_state = jax.lax.pmean(new_state, DATA_AXIS)
 
         flat_g, _ = ravel_pytree(grads)
-        size = flat_g.size
-        pad = (-size) % n
-        flat_g = jnp.pad(flat_g, (0, pad))
+        slices = _bucket_slices(grads)
         # reduce-scatter: each chip ends with the MEAN of its own slice
-        g_shard = jax.lax.psum_scatter(
-            flat_g, DATA_AXIS, scatter_dimension=0, tiled=True) / n
+        # (of each bucket's chunk, when bucketed — issued tail-first in
+        # backward-completion order, barrier-chained to pin the schedule)
+        shard_parts = []
+        token = None
+        for lo, hi in slices:
+            c = jnp.pad(flat_g[lo:hi], (0, (-(hi - lo)) % n))
+            if token is not None:
+                c, token = jax.lax.optimization_barrier((c, token))
+            red = (_ring_reduce_scatter(c, n) if ring else
+                   jax.lax.psum_scatter(
+                       c, DATA_AXIS, scatter_dimension=0, tiled=True)) / n
+            token = red
+            shard_parts.append(red)
+        g_shard = (shard_parts[0] if len(shard_parts) == 1
+                   else jnp.concatenate(shard_parts))
         if _clip is not None:
             if _clip[0] == "const":
                 g_shard = jnp.clip(g_shard, _clip[1], _clip[2])
@@ -189,11 +273,21 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
                 scale = jnp.minimum(1.0, _clip[1] / jnp.maximum(gn, 1e-12))
                 g_shard = g_shard * scale
         flat_p, unravel = ravel_pytree(params)
-        p_shard = _shard_of(flat_p)
+        p_shard = _shard_of(flat_p, slices)
         updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)
         p_shard = optax.apply_updates(p_shard, updates)
-        # all-gather the updated slices back into the full vector
-        full = jax.lax.all_gather(p_shard, DATA_AXIS, tiled=True)[:size]
+        # all-gather the updated slices back into the full vector —
+        # per chunk when bucketed, reassembled in forward (offset) order
+        fulls = []
+        off = 0
+        for lo, hi in slices:
+            m = ((hi - lo) + (-(hi - lo)) % n) // n
+            part = p_shard[off:off + m] if len(slices) > 1 else p_shard
+            off += m
+            fulls.append((lo, jax.lax.all_gather(
+                part, DATA_AXIS, tiled=True)[:hi - lo]))
+        full = (fulls[0][1] if len(fulls) == 1 else jnp.concatenate(
+            [f for _, f in sorted(fulls, key=lambda t: t[0])]))
         return unravel(full), opt_state, new_state, l
 
     batch_spec = P(DATA_AXIS)
@@ -201,7 +295,7 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
         local_step, plan, mesh,
         in_specs=(repl, opt_specs, repl, repl, batch_spec),
         out_specs=(repl, opt_specs, repl, repl),
-        donate_argnums=(0, 1, 2), label="zero1_step")
+        donate_argnums=(0, 1, 2), label=f"zero1{variant}_step")
     return step, init_opt_state
 
 

@@ -64,6 +64,7 @@ from analytics_zoo_tpu.metrics import (
     StragglerDetector,
     get_flight_recorder,
     get_health,
+    get_registry,
     maybe_start_from_env,
     record_device_memory,
     span,
@@ -273,6 +274,24 @@ def _gather_for_save(tree):
     return jax.tree_util.tree_map(fix, tree)
 
 
+def _async_checkpoint_enabled() -> bool:
+    """``ZOO_ASYNC_CHECKPOINT`` env gate, default ON.  ``0`` forces the
+    serialization+rename back onto the caller's thread (the pre-overlap
+    behavior) — the conservative fallback, and the baseline leg of
+    ``bench.py --overlap``'s checkpoint-stall comparison."""
+    raw = os.environ.get("ZOO_ASYNC_CHECKPOINT")
+    if raw is None:
+        return True
+    s = str(raw).strip().lower()
+    if s in ("", "1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(
+        f"ZOO_ASYNC_CHECKPOINT must be a boolean "
+        f"(1/0/true/false/yes/no/on/off), got {raw!r}")
+
+
 @dataclasses.dataclass
 class _Checkpointer:
     """Snapshot (params, opt_state, model state, step/epoch, iterator pos).
@@ -286,15 +305,40 @@ class _Checkpointer:
     next step's donation can't touch them), while D2H transfer, pickling
     and the atomic rename happen on a background thread.  At most one save
     is in flight; a newer save (and ``latest``/``list``) waits for it.
+
+    Latency-hiding plane (ISSUE 15): the caller-visible stall is recorded
+    per save into ``zoo_ckpt_stall_seconds``; the writer thread runs as
+    the ``checkpoint_writer`` health component and records ``ckpt``
+    flight events (start/complete/error); each completed snapshot
+    atomically updates a ``LATEST`` pointer file AFTER the snapshot's own
+    atomic rename, so a kill -9 at any point leaves the pointer naming
+    the previous COMPLETE snapshot.  ``ZOO_ASYNC_CHECKPOINT=0`` runs the
+    write inline (synchronous fallback) — the stall histogram then
+    measures the full gather+serialize+rename.
     """
 
     path: str
     over_write: bool = True
     keep: int = 3
 
+    LATEST = "LATEST"
+
     def __post_init__(self):
         self._pending: threading.Thread | None = None
         self._pending_err: BaseException | None = None
+        reg = get_registry()
+        self._stall_hist = reg.histogram(
+            "zoo_ckpt_stall_seconds",
+            "train-thread stall per checkpoint save: join of the "
+            "previous in-flight write + device-side snapshot dispatch "
+            "(the whole gather+serialize+rename when "
+            "ZOO_ASYNC_CHECKPOINT=0)")
+        self._write_hist = reg.histogram(
+            "zoo_ckpt_write_seconds",
+            "background D2H gather + serialization + atomic-rename time "
+            "per snapshot")
+        self._writes = reg.counter(
+            "zoo_ckpt_writes_total", "completed checkpoint snapshots")
 
     def _wait(self):
         if self._pending is not None:
@@ -315,6 +359,7 @@ class _Checkpointer:
         # (fsdp/zero1) are replicated SPMD FIRST — all processes
         # participate in that collective, THEN non-writers return —
         # so the writer's host gather sees every shard.
+        t0 = time.perf_counter()
         shard = _process_shard()
         if shard is not None:
             payload = _gather_for_save(payload)
@@ -329,13 +374,27 @@ class _Checkpointer:
             payload)
 
         def write():
+            health = get_health()
+            flight = get_flight_recorder()
+            t_w = time.perf_counter()
             try:
-                # device arrays → host; python scalars/strings (step
-                # counters, the plan's spec record) stay as-is
-                host = jax.tree_util.tree_map(
-                    lambda a: a if isinstance(a, (str, bytes, bool, int,
-                                                  float)) else np.asarray(a),
-                    snap)
+                health.heartbeat("checkpoint_writer")
+                flight.record("ckpt", phase="start", tag=str(tag),
+                              file=os.path.basename(fname))
+                # device arrays → host in ONE batched device_get (was:
+                # np.asarray per leaf — a serial D2H sync each); python
+                # scalars/strings (step counters, the plan's spec
+                # record) stay as-is
+                leaves, treedef = jax.tree_util.tree_flatten(snap)
+                dev = [i for i, a in enumerate(leaves)
+                       if isinstance(a, jax.Array)]
+                for i, v in zip(dev,
+                                jax.device_get([leaves[i] for i in dev])):
+                    leaves[i] = v
+                host = jax.tree_util.tree_unflatten(treedef, [
+                    a if isinstance(a, (str, bytes, bool, int, float,
+                                        np.ndarray)) else np.asarray(a)
+                    for a in leaves])
                 host["__ckpt_meta__"] = {
                     "format_version": self.FORMAT_VERSION,
                     "saved_unix": time.time(),
@@ -344,15 +403,61 @@ class _Checkpointer:
                 tmp = fname + ".tmp"
                 with open(tmp, "wb") as f:
                     pickle.dump(host, f)
+                    # fsync BEFORE the rename: os.replace alone makes
+                    # the name durable without the data — after a power
+                    # loss the pointer could name a truncated snapshot
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, fname)
+                # crash-safe "last complete" pointer: updated only AFTER
+                # the snapshot's own atomic rename
+                self._write_latest(os.path.basename(fname))
                 self._gc()
+                dt = time.perf_counter() - t_w
+                self._writes.inc()
+                self._write_hist.observe(dt)
+                health.set_status("checkpoint_writer", True)
+                flight.record("ckpt", phase="complete", tag=str(tag),
+                              seconds=round(dt, 6))
             except BaseException as e:  # surfaced on the next save/_wait
+                health.set_status("checkpoint_writer", False)
+                flight.record("ckpt", phase="error", tag=str(tag),
+                              error=repr(e))
                 self._pending_err = e
 
-        self._pending = threading.Thread(target=write, daemon=True,
-                                         name="zoo-ckpt")
-        self._pending.start()
+        if _async_checkpoint_enabled():
+            self._pending = threading.Thread(target=write, daemon=True,
+                                             name="zoo-ckpt")
+            self._pending.start()
+            # the caller-visible stall: previous-write join + snapshot
+            # dispatch; the serialization overlaps the next train steps
+            self._stall_hist.observe(time.perf_counter() - t0)
+        else:
+            write()
+            self._stall_hist.observe(time.perf_counter() - t0)
+            if self._pending_err is not None:
+                err, self._pending_err = self._pending_err, None
+                raise err
         return fname
+
+    def _write_latest(self, basename: str):
+        ptr = os.path.join(self.path, self.LATEST)
+        tmp = ptr + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(basename)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, ptr)
+        # fsync the DIRECTORY so both renames (snapshot + pointer) are
+        # durable, not just the file contents
+        try:
+            dfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # e.g. fs without directory fsync support
+            pass
 
     def _gc(self):
         # raw listing: _gc runs ON the writer thread, so it must not _wait
@@ -389,9 +494,33 @@ class _Checkpointer:
 
             multihost_utils.sync_global_devices("zoo-ckpt-latest")
         files = self.list()
-        if not files:
-            return None
-        with open(files[-1], "rb") as f:
+        # prefer the crash-safe LATEST pointer (always names the newest
+        # COMPLETE snapshot — a kill -9 mid-write never advanced it);
+        # fall back to mtime order for pre-pointer checkpoint dirs
+        fname = None
+        try:
+            with open(os.path.join(self.path, self.LATEST)) as f:
+                name = f.read().strip()
+            cand = os.path.join(self.path, name)
+            if name and os.path.exists(cand):
+                fname = cand
+        except OSError:
+            fname = None
+        if fname is None:
+            if not files:
+                return None
+            fname = files[-1]
+        elif files and files[-1] != fname:
+            # an out-of-band snapshot (dropped in by a restore workflow,
+            # never written through save()) can be newer than the pointer
+            # target; any file under its final ckpt-*.pkl name is complete
+            # (fsync-before-rename), so trusting the newer one is safe
+            try:
+                if os.path.getmtime(files[-1]) > os.path.getmtime(fname):
+                    fname = files[-1]
+            except OSError:
+                pass
+        with open(fname, "rb") as f:
             payload = safe_load(f)
         # schema check: refuse snapshots from a NEWER format (their layout
         # is unknown); pre-versioning (r03) snapshots carry no meta and
@@ -399,7 +528,7 @@ class _Checkpointer:
         meta = payload.pop("__ckpt_meta__", {"format_version": 0})
         if meta.get("format_version", 0) > self.FORMAT_VERSION:
             raise ValueError(
-                f"checkpoint {files[-1]} has format_version "
+                f"checkpoint {fname} has format_version "
                 f"{meta['format_version']} > supported "
                 f"{self.FORMAT_VERSION}; upgrade the framework to resume "
                 "from it")
@@ -665,6 +794,13 @@ class Estimator:
                 batch = device_transform(batch)
 
             def loss_of(p):
+                # fsdp gather prefetch (plan.prefetch): explicit
+                # double-buffered all-gathers, bucket k+1's gather
+                # barrier-chained behind bucket k so it issues while k
+                # computes; the vjp transposes each gather into the
+                # matching bucketed reduce-scatter.  No-op (returns p
+                # untouched) for plans without prefetch.
+                p = plan.prefetch_params(p, mesh)
                 # Params-in-compute mixed precision: master params stay f32
                 # (the differentiation variable); the cast is inside the
                 # graph so its vjp returns f32 grads.  Loss math is f32.

@@ -1,6 +1,6 @@
-"""zoolint fixture: host-sync — hot-path positives, a suppressed
-negative, and an unannotated (cold) function that never fires.
-Never imported; linted statically."""
+"""zoolint fixture: host-sync — hot-path positives (in-loop and
+straight-line), a suppressed negative, and an unannotated (cold)
+function that never fires.  Never imported; linted statically."""
 
 import jax
 import numpy as np
@@ -11,12 +11,18 @@ def hot_loop(batches, step_fn, params):
     loss = None
     for batch in batches:
         params, loss = step_fn(params, batch)
-        val = float(loss)  # POSITIVE
-        arr = np.asarray(loss)  # POSITIVE
-        loss.block_until_ready()  # POSITIVE
-        jax.device_get(loss)  # POSITIVE
-        n = int(arr.sum())  # POSITIVE
-    return params, val, n
+        val = float(loss)  # POSITIVE (in loop)
+        arr = np.asarray(loss)  # POSITIVE (in loop)
+        loss.block_until_ready()  # POSITIVE (in loop)
+        jax.device_get(loss)  # POSITIVE (in loop)
+        n = int(arr.sum())  # POSITIVE (in loop)
+        scalar = loss.item()  # POSITIVE (in loop, .item())
+    return params, val, n, scalar
+
+
+# zoolint: hot-path
+def hot_straightline(loss):
+    return float(loss)  # POSITIVE (hot path, not in a loop)
 
 
 # zoolint: hot-path

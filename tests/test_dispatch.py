@@ -117,6 +117,8 @@ class TestFusedTrajectoryEquality:
         # mid-epoch-2 snapshot (iteration 12 -> next_batch=4 of epoch 2)
         removed = 0
         for f in os.listdir(ckdir):
+            if not (f.startswith("ckpt-") and f.endswith(".pkl")):
+                continue  # the LATEST pointer / partial tmp files
             tag = int(f.split("-")[1].split(".")[0])
             if tag > 12:
                 os.remove(os.path.join(ckdir, f))

@@ -151,9 +151,27 @@ class TestHostSyncRule:
 
     def test_positives_only_inside_hot_path(self):
         active = _active(_lint_fixture(self.FX, "host-sync"))
-        assert len(active) == 5  # float/asarray/block/device_get/int
+        # float/asarray/block/device_get/int/.item() in the loop plus
+        # the straight-line float()
+        assert len(active) == 7
         cold = _line_of(self.FX, "not annotated hot-path")
         assert cold not in {f.line for f in active}
+
+    def test_item_call_detected(self):
+        active = _active(_lint_fixture(self.FX, "host-sync"))
+        item_line = _line_of(self.FX, ".item()")
+        hit = [f for f in active if f.line == item_line]
+        assert len(hit) == 1 and hit[0].data["call"] == ".item()"
+
+    def test_loop_context_changes_message(self):
+        active = _active(_lint_fixture(self.FX, "host-sync"))
+        by_line = {f.line: f for f in active}
+        in_loop = by_line[_line_of(self.FX, "POSITIVE (in loop)")]
+        assert in_loop.data.get("in_loop") is True
+        assert "next feed" in in_loop.message
+        straight = by_line[_line_of(self.FX, "not in a loop")]
+        assert "in_loop" not in straight.data
+        assert "next feed" not in straight.message
 
     def test_suppressed_negative(self):
         sup = _suppressed(_lint_fixture(self.FX, "host-sync"))
