@@ -286,6 +286,35 @@ class ConfigOracle:
             hbm_budget=budget, feasible=bool(feasible))
         return chosen["plan"], doc
 
+    def repick(self, param_bytes: int, opt_bytes: int, n_shards: int,
+               k_candidates: Sequence[int] = (1, 2, 4, 8),
+               features: Mapping | None = None,
+               hbm_budget: int | None = None,
+               batch_bytes: int = 0, activation_bytes: int = 0,
+               remat_options: Sequence[str | None] = (None, "full"),
+               ) -> dict:
+        """ONE full (plan, K, remat) re-pick for a NEW topology — the
+        elastic supervisor's generation-change hook (ISSUE 16).
+
+        A generation change (worker died / rejoined) changes
+        ``n_shards``; instead of re-tuning blind, the supervisor asks
+        for exactly one :meth:`choose_plan` sweep (plan x remat against
+        the HBM budget at the new shard count) plus one
+        :meth:`predict_k` (the fused-dispatch prior), so every rejoin
+        decision is a logged prediction the round's measured steps/sec
+        later scores via :meth:`record_outcome`.  Returns ``{"plan",
+        "k", "remat", "config", "doc"}``; ``config`` is the key to
+        report the outcome against."""
+        feats = features or {}
+        plan, doc = self.choose_plan(
+            param_bytes, opt_bytes, n_shards, hbm_budget=hbm_budget,
+            features=feats, batch_bytes=batch_bytes,
+            activation_bytes=activation_bytes,
+            remat_options=remat_options)
+        k = self.predict_k(feats, k_candidates)
+        return {"plan": plan, "k": int(k), "remat": doc["chosen_remat"],
+                "config": doc["chosen_config"], "doc": doc}
+
     # ------------------------------------------------------------------
     # the outcome half of the data loop
     # ------------------------------------------------------------------

@@ -283,6 +283,25 @@ class ZooConfig:
                                claimed-but-unserved records to the
                                surviving replicas (exactly-once via
                                lease expiry; serving/broker.py)
+      ZOO_ELASTIC              enable the elastic training runtime
+                               (default off): fit() joins the broker-
+                               backed membership ledger and yields at
+                               step barriers on generation changes
+                               (elastic/; docs/elastic-training.md)
+      ZOO_ELASTIC_LEASE_MS     membership lease (ms, default 3000): a
+                               training worker whose keepalive is
+                               silent this long is declared dead and
+                               the generation counter increments —
+                               shorter detects faults faster, longer
+                               tolerates GC/compile pauses
+      ZOO_ELASTIC_MIN_WORKERS  cohort floor (default 1): the supervisor
+                               holds training (no chief assignment)
+                               while fewer members are live
+      ZOO_ELASTIC_GRACE_MS     shutdown grace (ms, default 5000): bound
+                               on the SIGTERM-path flush of the async
+                               checkpoint writer before the flight
+                               dump, and on a worker's SIGTERM->SIGKILL
+                               escalation
 
     ``ZOO_PREFETCH_WORKERS`` / ``ZOO_PREFETCH_DEPTH`` /
     ``ZOO_STEPS_PER_DISPATCH`` are validated EAGERLY here: a
@@ -345,6 +364,14 @@ class ZooConfig:
     fleet_max_replicas: int | None = None
     fleet_interval: float | None = None
     fleet_lease_ms: int | None = None
+    # Elastic training runtime (elastic/): membership lease, cohort
+    # floor, and shutdown grace.  Env: ZOO_ELASTIC,
+    # ZOO_ELASTIC_LEASE_MS, ZOO_ELASTIC_MIN_WORKERS,
+    # ZOO_ELASTIC_GRACE_MS.
+    elastic: bool | None = None
+    elastic_lease_ms: int | None = None
+    elastic_min_workers: int | None = None
+    elastic_grace_ms: int | None = None
 
     def __post_init__(self):
         env = os.environ
@@ -495,6 +522,31 @@ class ZooConfig:
         self.fleet_lease_ms = resolve_int(
             self.fleet_lease_ms, "ZOO_FLEET_LEASE_MS", 10_000,
             minimum=100)
+
+        # Elastic-training tier (elastic/): validated eagerly so a bad
+        # knob fails at context init, never from inside a training
+        # worker mid-rejoin (the PR 7/8 contract).
+        def parse_elastic_bool(raw):
+            s = str(raw).strip().lower()
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("", "0", "false", "no", "off"):
+                return False
+            raise ValueError(
+                f"ZOO_ELASTIC must be a boolean "
+                f"(1/0/true/false/yes/no/on/off), got {raw!r}")
+
+        self.elastic = bool(resolve(
+            self.elastic, "ZOO_ELASTIC", False, cast=parse_elastic_bool))
+        self.elastic_lease_ms = resolve_int(
+            self.elastic_lease_ms, "ZOO_ELASTIC_LEASE_MS", 3_000,
+            minimum=100)
+        self.elastic_min_workers = resolve_int(
+            self.elastic_min_workers, "ZOO_ELASTIC_MIN_WORKERS", 1,
+            minimum=1)
+        self.elastic_grace_ms = resolve_int(
+            self.elastic_grace_ms, "ZOO_ELASTIC_GRACE_MS", 5_000,
+            minimum=0)
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
         if self.compile_cache is None:

@@ -1,0 +1,38 @@
+"""zooelastic: the elastic training runtime.
+
+Unattended pod-scale ``fit()``: a lease-based membership ledger on the
+serving broker's claim protocol (membership.py), a worker supervisor
+that respawns the dead and orchestrates oracle-guided rejoins at new
+world sizes (supervisor.py), and a deterministic chaos harness that
+proves it all under scripted ``kill -9`` / SIGTERM / stalls (chaos.py).
+See docs/elastic-training.md.
+"""
+
+from .chaos import ChaosEvent, ChaosSchedule
+from .membership import (
+    DEFAULT_PREFIX,
+    ElasticSession,
+    GenerationChange,
+    MemberHandle,
+    MembershipLedger,
+)
+from .supervisor import (
+    TrainSupervisor,
+    equal_shares,
+    rebalance_shares,
+    varz_doc,
+)
+
+__all__ = [
+    "ChaosEvent",
+    "ChaosSchedule",
+    "DEFAULT_PREFIX",
+    "ElasticSession",
+    "GenerationChange",
+    "MemberHandle",
+    "MembershipLedger",
+    "TrainSupervisor",
+    "equal_shares",
+    "rebalance_shares",
+    "varz_doc",
+]

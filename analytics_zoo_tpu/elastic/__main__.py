@@ -1,0 +1,9 @@
+"""``python -m analytics_zoo_tpu.elastic --worker ...``: one elastic
+training worker (see supervisor._worker_main)."""
+
+import sys
+
+from .supervisor import _worker_main
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(_worker_main(sys.argv[1:]))

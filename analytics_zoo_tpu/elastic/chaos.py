@@ -1,0 +1,114 @@
+"""zooelastic chaos: deterministic fault injection for unattended runs.
+
+A :class:`ChaosSchedule` scripts faults against worker ids at *step
+boundaries* of the training trajectory — not wall-clock — so a run is
+reproducible from its seed: the supervisor reads the chief's heartbeat
+step and fires every event whose ``at_step`` has been reached.
+
+Three fault kinds, covering the failure taxonomy the ISSUE's acceptance
+run must survive without a human:
+
+- ``kill``  — ``SIGKILL``: no cleanup runs, the membership lease
+  expires, survivors take over (the pod-preemption shape).
+- ``term``  — ``SIGTERM``: the worker's handler leaves the membership
+  gracefully after the flight recorder's pre-dump hooks flushed the
+  async checkpointer (the maintenance-drain shape).
+- ``stall`` — field ``stall_s`` written into the worker's control hash;
+  its :class:`~analytics_zoo_tpu.elastic.membership.ElasticSession`
+  consumes it as a one-shot sleep, which the straggler board then sees
+  as a genuine slow step (the slow-host shape).
+
+Schedules come from :meth:`ChaosSchedule.from_seed` (seeded RNG) or
+:meth:`ChaosSchedule.parse` (``"kill@12:w1,term@20:w2,stall@16:w3:1.5"``)
+so a bench artifact can state exactly what it injected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+__all__ = ["ChaosEvent", "ChaosSchedule", "ACTIONS"]
+
+ACTIONS = ("kill", "term", "stall")
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    at_step: int
+    action: str  # kill | term | stall
+    target: str  # worker id, e.g. "w1"
+    arg: float = 0.0  # stall seconds (stall only)
+    fired: bool = False
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"chaos action must be one of {ACTIONS}, got "
+                f"{self.action!r}")
+        self.at_step = int(self.at_step)
+        self.arg = float(self.arg)
+
+    def to_doc(self) -> dict:
+        return {"at_step": self.at_step, "action": self.action,
+                "target": self.target, "arg": self.arg,
+                "fired": self.fired}
+
+
+class ChaosSchedule:
+    """An ordered, one-shot script of :class:`ChaosEvent`.
+
+    The supervisor polls :meth:`due` with the chief's current step and
+    marks each event fired after executing it; :meth:`done` is true when
+    the script is exhausted."""
+
+    def __init__(self, events):
+        self.events = sorted(events, key=lambda e: (e.at_step, e.target))
+
+    @classmethod
+    def from_seed(cls, seed: int, workers, total_steps: int,
+                  n_events: int = 2, actions=ACTIONS,
+                  stall_s: float = 1.0) -> "ChaosSchedule":
+        """Deterministic schedule: ``n_events`` faults over distinct
+        targets, landing in the middle half of the run (``[total/4,
+        3*total/4]``) so every fault interrupts real progress instead of
+        warmup or the final step."""
+        workers = list(workers)
+        rng = random.Random(int(seed))
+        lo = max(1, total_steps // 4)
+        hi = max(lo + 1, (3 * total_steps) // 4)
+        targets = rng.sample(workers, k=min(int(n_events), len(workers)))
+        events = [
+            ChaosEvent(at_step=rng.randint(lo, hi),
+                       action=actions[i % len(actions)], target=t,
+                       arg=stall_s)
+            for i, t in enumerate(targets)
+        ]
+        return cls(events)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSchedule":
+        """``"kill@12:w1,term@20:w2,stall@16:w3:1.5"`` — the bench /
+        test notation (``action@step:target[:arg]``)."""
+        events = []
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            head, _, rest = part.partition("@")
+            bits = rest.split(":")
+            if len(bits) < 2:
+                raise ValueError(
+                    f"chaos event needs action@step:target, got {part!r}")
+            events.append(ChaosEvent(
+                at_step=int(bits[0]), action=head.strip(),
+                target=bits[1].strip(),
+                arg=float(bits[2]) if len(bits) > 2 else 0.0))
+        return cls(events)
+
+    def due(self, step: int) -> list:
+        return [e for e in self.events
+                if not e.fired and e.at_step <= int(step)]
+
+    def done(self) -> bool:
+        return all(e.fired for e in self.events)
+
+    def to_doc(self) -> list:
+        return [e.to_doc() for e in self.events]

@@ -1,0 +1,330 @@
+"""zooelastic membership: the lease-based worker ledger.
+
+Elastic training needs ONE fact agreed on by everybody: *who is in the
+cohort right now*.  This module derives that fact from the serving
+broker's exactly-once claim protocol (serving/broker.py
+``claim``/``extend``/``release`` — per-record leases) instead of
+inventing a second coordination service:
+
+- Each worker owns a single-record stream ``<prefix>-m-<worker_id>``
+  and CLAIMS its own record under ``ZOO_ELASTIC_LEASE_MS``; a daemon
+  keepalive thread extends the lease at a third of its period.
+  Liveness is exactly :meth:`~analytics_zoo_tpu.serving.broker.Broker.
+  lease_held`: a ``kill -9`` just stops the keepalive, and the member
+  drops out after one lease period with no cleanup code running.
+- The **generation doc** ``{"generation", "world", "members", "ts"}``
+  lives in broker hash ``<prefix>:generation`` (field ``doc``, json).
+  Its single writer is the supervisor's :meth:`MembershipLedger.scan`,
+  which bumps ``generation`` whenever the live-member set changes (any
+  join OR leave).  Every worker reads the doc at the estimator's step
+  barrier through :class:`ElasticSession` — the single source of truth
+  the ISSUE demands.
+
+The same prefix namespaces the runtime's other mailboxes (all plain
+broker hashes, documented here so the layout has one home):
+
+============================  ==============================================
+key                           contents
+============================  ==============================================
+``<prefix>-m-<wid>``          the member's single-record lease stream
+``<prefix>:roster:<wid>``     per-worker hash (owner/pid/ts) — one
+                              writer each, so joins never race
+``<prefix>:generation``       field ``doc``: the generation doc (json)
+``<prefix>:assign``           field ``doc``: supervisor's assignment doc
+``<prefix>:hb:<wid>``         worker heartbeat: step / step_s / ts / role
+``<prefix>:ctl:<wid>``        chaos control: field ``stall_s`` injects a stall
+``<prefix>:result``           field ``doc``: chief's round result (json)
+============================  ==============================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from analytics_zoo_tpu.serving.broker import connect_broker
+
+__all__ = [
+    "DEFAULT_PREFIX",
+    "ElasticSession",
+    "GenerationChange",
+    "MemberHandle",
+    "MembershipLedger",
+    "fget",
+]
+
+DEFAULT_PREFIX = "zoo-elastic"
+
+
+def fget(mapping, key, default=None):
+    """Broker-hash field access that tolerates the redis transport's
+    bytes keys/values (FileBroker/InMemoryBroker return str)."""
+    if not mapping:
+        return default
+    val = mapping.get(key, mapping.get(
+        key.encode() if isinstance(key, str) else key, default))
+    if isinstance(val, bytes):
+        val = val.decode()
+    return val
+
+
+class GenerationChange(Exception):
+    """Raised by the estimator's step barrier when the cluster
+    generation moved under a running ``fit()``.
+
+    This is control flow, not a failure: ``_train_with_retries`` lets it
+    through un-retried, the elastic worker's round loop catches it,
+    rejoins, and the next leg resumes from ``LATEST`` at the new world
+    size (``_elastic_yield`` made the snapshot durable before raising).
+    Carries the NEW generation doc as ``.doc``."""
+
+    def __init__(self, doc: dict):
+        self.doc = dict(doc)
+        super().__init__(
+            "generation -> %s (world %s)"
+            % (doc.get("generation"), doc.get("world")))
+
+
+class MembershipLedger:
+    """(generation, world size, member list) on broker leases.
+
+    Worker side: :meth:`join`.  Supervisor side (single writer of the
+    generation doc): :meth:`scan`.  Read side (everyone):
+    :meth:`members` / :meth:`generation_doc`.  Works over all three
+    brokers — the memory broker for units, ``dir:`` spools for
+    kill-resilient subprocess cohorts, redis for real clusters."""
+
+    def __init__(self, broker, prefix: str = DEFAULT_PREFIX,
+                 lease_ms: int | None = None):
+        self.broker = connect_broker(broker)
+        self.prefix = str(prefix)
+        if lease_ms is None:
+            lease_ms = int(os.environ.get("ZOO_ELASTIC_LEASE_MS", "3000"))
+        self.lease_ms = int(lease_ms)
+
+    # -- key layout -----------------------------------------------------
+    def member_stream(self, worker_id: str) -> str:
+        return f"{self.prefix}-m-{worker_id}"
+
+    # one roster hash PER worker (single writer each): a shared roster
+    # hash would be a cross-process read-modify-write race on brokers
+    # whose hset merges by read+rewrite (FileBroker) — concurrent joins
+    # would silently drop each other
+    @property
+    def roster_prefix(self) -> str:
+        return f"{self.prefix}:roster:"
+
+    def roster_key(self, worker_id: str) -> str:
+        return f"{self.roster_prefix}{worker_id}"
+
+    @property
+    def generation_key(self) -> str:
+        return f"{self.prefix}:generation"
+
+    @property
+    def assign_key(self) -> str:
+        return f"{self.prefix}:assign"
+
+    @property
+    def result_key(self) -> str:
+        return f"{self.prefix}:result"
+
+    def hb_key(self, worker_id: str) -> str:
+        return f"{self.prefix}:hb:{worker_id}"
+
+    def ctl_key(self, worker_id: str) -> str:
+        return f"{self.prefix}:ctl:{worker_id}"
+
+    # -- worker side ----------------------------------------------------
+    def join(self, worker_id: str,
+             timeout_ms: int | None = None) -> "MemberHandle":
+        """Claim the membership slot ``worker_id`` and start its
+        keepalive.  A respawn reuses its predecessor's slot: if the dead
+        incarnation's lease is still ticking (``kill -9`` leaves no
+        release), we wait it out — the claim succeeds the moment the
+        broker expires it, which is exactly the takeover story serving
+        replicas already live by."""
+        stream = self.member_stream(worker_id)
+        owner = "%s@%s-%d" % (worker_id, socket.gethostname(), os.getpid())
+        if timeout_ms is None:
+            timeout_ms = self.lease_ms * 2 + 1_000
+        deadline = time.monotonic() + timeout_ms / 1e3
+        if self.broker.xlen(stream) == 0:
+            self.broker.xadd(stream, {"worker": worker_id})
+        # a crashed join could have raced a second record in; one record
+        # per slot is the lease_held invariant
+        self.broker.xtrim(stream, 1)
+        while True:
+            got = self.broker.claim(stream, owner, 1, self.lease_ms)
+            if got:
+                rid = got[0][0]
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{worker_id}: membership slot still leased by a "
+                    f"previous incarnation after {timeout_ms}ms")
+            time.sleep(min(0.05, self.lease_ms / 4e3))
+        self.broker.hset(self.roster_key(worker_id), {
+            "owner": owner, "pid": str(os.getpid()),
+            "ts": "%.3f" % time.time()})
+        return MemberHandle(self, worker_id, owner, rid)
+
+    # -- read side ------------------------------------------------------
+    def members(self) -> list:
+        """Sorted worker ids whose membership lease is LIVE right now."""
+        pfx = self.roster_prefix
+        keys = (k.decode() if isinstance(k, bytes) else k
+                for k in self.broker.keys(pfx))
+        wids = sorted(k[len(pfx):] for k in keys)
+        return [w for w in wids
+                if self.broker.lease_held(self.member_stream(w))]
+
+    def generation_doc(self) -> dict | None:
+        raw = fget(self.broker.hgetall(self.generation_key), "doc")
+        return json.loads(raw) if raw else None
+
+    def assignment(self) -> dict | None:
+        raw = fget(self.broker.hgetall(self.assign_key), "doc")
+        return json.loads(raw) if raw else None
+
+    # -- supervisor side (the generation doc's single writer) -----------
+    def scan(self) -> tuple:
+        """Recompute live membership; bump the generation iff the member
+        set changed.  Returns ``(doc, changed)``.  Called only by the
+        supervisor — single-writer is what makes the counter a counter."""
+        live = self.members()
+        doc = self.generation_doc()
+        if doc is not None and doc.get("members") == live:
+            return doc, False
+        gen = 1 if doc is None else int(doc.get("generation", 0)) + 1
+        doc = {"generation": gen, "world": len(live), "members": live,
+               "ts": time.time()}
+        self.broker.hset(self.generation_key, {"doc": json.dumps(doc)})
+        return doc, True
+
+    def publish_assignment(self, doc: dict) -> None:
+        self.broker.hset(self.assign_key, {"doc": json.dumps(doc)})
+
+
+class MemberHandle:
+    """One live membership slot: the keepalive thread plus the graceful
+    exit.  Process death (any signal, any abruptness) degrades to lease
+    expiry — that is the whole point."""
+
+    def __init__(self, ledger: MembershipLedger, worker_id: str,
+                 owner: str, rid: str):
+        self.ledger = ledger
+        self.worker_id = worker_id
+        self.owner = owner
+        self.rid = rid
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._keepalive, daemon=True,
+            name=f"zoo-elastic-keepalive-{worker_id}")
+        self._thread.start()
+
+    def alive(self) -> bool:
+        return not self._stop.is_set()
+
+    def _keepalive(self):
+        period = max(0.01, self.ledger.lease_ms / 3e3)
+        stream = self.ledger.member_stream(self.worker_id)
+        while not self._stop.wait(period):
+            try:
+                self.ledger.broker.extend(
+                    stream, self.owner, [self.rid], self.ledger.lease_ms)
+            except Exception:
+                # a broker hiccup must not kill the worker; a lost
+                # extend at worst costs one lease period of membership
+                pass
+
+    def leave(self) -> None:
+        """Graceful departure: stop the keepalive and ACK the slot
+        record, so the NEXT supervisor scan sees the member gone instead
+        of waiting a full lease period for expiry (the SIGTERM path's
+        fast rejoin)."""
+        self._stop.set()
+        try:
+            self.ledger.broker.release(
+                self.ledger.member_stream(self.worker_id), self.owner,
+                [self.rid], done=True)
+        except Exception:
+            pass  # at worst the slot expires like a crash
+
+
+class ElasticSession:
+    """The worker-side handle threaded into ``fit(elastic=...)``.
+
+    ``estimator._train_loop`` calls :meth:`poll` once per optimizer
+    dispatch — the STEP BARRIER.  The call is rate-limited to
+    ``min_poll_s`` so the hot path pays a couple of hash reads at most a
+    few times a second, not per step.  On a generation bump it returns
+    the NEW doc (the estimator then snapshots and raises
+    :class:`GenerationChange`); otherwise ``None``.
+
+    Each rate-limit tick also:
+
+    - publishes the worker heartbeat ``<prefix>:hb:<wid>``
+      (``step``/``step_s``/``ts``/``role``) — the supervisor's
+      straggler board and the chaos schedule's ``at_step`` anchor both
+      read it;
+    - honours chaos stall injection: field ``stall_s`` of
+      ``<prefix>:ctl:<wid>`` sleeps that long once (consumed), which
+      shows up in ``step_s`` exactly like a real straggler would.
+    """
+
+    def __init__(self, broker, prefix: str = DEFAULT_PREFIX,
+                 generation: int = 0, worker_id: str | None = None,
+                 start_step: int = 0, min_poll_s: float = 0.2,
+                 throttle_s: float = 0.0):
+        self.ledger = MembershipLedger(broker, prefix=prefix)
+        self.generation = int(generation)
+        self.worker_id = worker_id
+        self.start_step = int(start_step)
+        self.min_poll_s = float(min_poll_s)
+        # per-step host-side sleep: stands in for a real model's step
+        # time in tests/benches so faults land at the step they target
+        self.throttle_s = float(throttle_s)
+        self._steps = 0
+        self._last_step_t: float | None = None
+        self._step_s = 0.0
+        self._last_poll = 0.0
+
+    def step(self) -> int:
+        """Global step as this session counts it (start offset + polls
+        seen — one poll per dispatch)."""
+        return self.start_step + self._steps
+
+    def poll(self) -> dict | None:
+        if self.throttle_s > 0:
+            time.sleep(self.throttle_s)
+        now = time.monotonic()
+        self._steps += 1
+        if self._last_step_t is not None:
+            self._step_s = now - self._last_step_t
+        self._last_step_t = now
+        if now - self._last_poll < self.min_poll_s:
+            return None
+        self._last_poll = now
+        b = self.ledger.broker
+        if self.worker_id is not None:
+            ctl_key = self.ledger.ctl_key(self.worker_id)
+            stall = fget(b.hgetall(ctl_key), "stall_s")
+            if stall:
+                b.delete(ctl_key)  # consume: a stall fires once
+                time.sleep(float(stall))
+                self._step_s += float(stall)
+                self._last_step_t = time.monotonic()
+            b.hset(self.ledger.hb_key(self.worker_id), {
+                "step": str(self.step()),
+                "step_s": "%.6f" % self._step_s,
+                "ts": "%.3f" % time.time(),
+                "role": "chief",
+            })
+        doc = self.ledger.generation_doc()
+        if doc is not None and int(doc.get("generation", 0)) > self.generation:
+            return doc
+        return None

@@ -1,0 +1,868 @@
+"""zooelastic supervisor: unattended pod-scale ``fit()``.
+
+:class:`TrainSupervisor` treats training workers the way
+:class:`~analytics_zoo_tpu.serving.fleet.FleetController` treats serving
+replicas: it spawns N worker subprocesses (``python -m
+analytics_zoo_tpu.elastic --worker`` against a cross-process broker
+spec), respawns the dead, and coordinates everything else through the
+membership ledger (elastic/membership.py) — no supervisor->worker RPC,
+just broker hashes.
+
+On any generation change (a worker died, a respawn rejoined) the
+supervisor orchestrates the rejoin:
+
+1. survivors yield at the next step barrier — the estimator
+   safe-snapshots via the async checkpointer and raises
+   :class:`~analytics_zoo_tpu.elastic.membership.GenerationChange`;
+2. the config oracle re-picks ``(plan, K, remat)`` for the NEW world
+   size with :meth:`~analytics_zoo_tpu.analysis.oracle.ConfigOracle.
+   repick` — exactly once per generation, never a blind re-tune, and the
+   round's measured throughput is fed back as the prediction's outcome;
+3. the new cohort resumes from ``LATEST`` at the new world size through
+   the partitioner's bit-exact resharding (the chief — lowest live
+   worker id — runs the SPMD fit on a mesh refolded by
+   :func:`~analytics_zoo_tpu.parallel.plan.fold_world_to_mesh`; the
+   other members heartbeat as hot spares).
+
+A :class:`~analytics_zoo_tpu.metrics.flight.StragglerBoard` over the
+worker heartbeats drives micro-batch rebalancing: a slow worker's share
+of the global batch shrinks by :func:`rebalance_shares` (the delta goes
+to the fast workers, the global batch — and with it the RNG-folded
+trajectory — is preserved exactly).
+
+Every decision lands three ways (the fleet/autotune convention): the
+``zoo_elastic_*`` metric family, an ``elastic`` flight-recorder event,
+and a bounded structured decision log served in the ``elastic`` section
+of ``/varz`` (rendered by ``tools/metrics_dump.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+
+from ..analysis.oracle import ConfigOracle
+from ..metrics import ElasticMetrics, StragglerBoard, get_flight_recorder
+from ..parallel.plan import fold_world_to_mesh
+from .chaos import ChaosSchedule
+from .membership import (
+    DEFAULT_PREFIX,
+    ElasticSession,
+    GenerationChange,
+    MembershipLedger,
+    fget,
+)
+
+__all__ = ["TrainSupervisor", "equal_shares", "rebalance_shares",
+           "varz_doc"]
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+# ---------------------------------------------------------------------------
+# Live-supervisor registry for /varz (metrics/http.py consults
+# sys.modules only — a scrape-only process never imports this module).
+# ---------------------------------------------------------------------------
+
+_active_lock = threading.Lock()
+_active: "weakref.WeakSet[TrainSupervisor]" = (  # guarded-by: _active_lock
+    weakref.WeakSet())
+
+
+def varz_doc() -> dict:
+    """The ``elastic`` section of ``/varz``: every live supervisor's
+    generation/membership state plus the merged decision log."""
+    with _active_lock:
+        sups = list(_active)
+    docs = [s.to_doc() for s in sups]
+    decisions = sorted((d for doc in docs for d in doc["decisions"]),
+                       key=lambda d: d["ts"])
+    return {"supervisors": docs, "decisions": decisions}
+
+
+# ---------------------------------------------------------------------------
+# Share arithmetic (pure — unit-tested directly)
+# ---------------------------------------------------------------------------
+
+
+def equal_shares(total: int, members) -> dict:
+    """Split ``total`` micro-batch records evenly over ``members``
+    (largest-remainder); always sums to ``total`` exactly."""
+    wids = sorted(members)
+    if not wids:
+        return {}
+    q, r = divmod(int(total), len(wids))
+    return {w: q + (1 if i < r else 0) for i, w in enumerate(wids)}
+
+
+def rebalance_shares(shares: dict, factors: dict,
+                     min_share: int = 1) -> dict:
+    """Shrink slow workers' micro-batch shares, grow fast workers'.
+
+    ``factors`` are per-worker slowdowns from
+    :meth:`~analytics_zoo_tpu.metrics.flight.StragglerBoard.factors`
+    (1.0 = fleet median).  Each worker's weight is ``share / factor`` —
+    capacity proportional to observed speed — and the GLOBAL batch
+    ``sum(shares)`` is preserved EXACTLY via largest-remainder rounding,
+    so the optimizer trajectory sees the same batches in the same order;
+    only who computes which slice changes."""
+    total = sum(int(v) for v in shares.values())
+    n = len(shares)
+    if n == 0 or total < min_share * n:
+        return dict(shares)
+    weights = {w: int(s) / max(float(factors.get(w, 1.0)), 1e-9)
+               for w, s in shares.items()}
+    wsum = sum(weights.values())
+    if wsum <= 0:
+        return dict(shares)
+    spread = total - min_share * n
+    exact = {w: spread * weights[w] / wsum for w in shares}
+    out = {w: min_share + int(exact[w]) for w in shares}
+    leftover = total - sum(out.values())
+    order = sorted(shares, key=lambda w: (exact[w] - int(exact[w]), w),
+                   reverse=True)
+    for w in order[:leftover]:
+        out[w] += 1
+    return out
+
+
+def _spec_param_bytes(spec: dict) -> int:
+    """float32 parameter bytes of the worker's two-Dense synthetic model
+    (the oracle repick's size input when no measured bytes exist)."""
+    i = int(spec.get("in_dim", 8))
+    h = int(spec.get("hidden", 16))
+    c = int(spec.get("classes", 4))
+    return 4 * (i * h + h + h * c + c)
+
+
+def _peek_latest(ckpt_dir: str) -> dict | None:
+    """Read (global_step, epoch) straight off the LATEST snapshot
+    without touching jax — the supervisor's steps-lost accounting and
+    the chief's resume-offset both use it."""
+    try:
+        with open(os.path.join(ckpt_dir, "LATEST")) as f:
+            name = f.read().strip()
+        with open(os.path.join(ckpt_dir, name), "rb") as f:
+            payload = pickle.load(f)
+        return {"global_step": int(payload["global_step"]),
+                "epoch": int(payload["epoch"])}
+    except (OSError, KeyError, ValueError, pickle.UnpicklingError,
+            EOFError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Worker handle
+# ---------------------------------------------------------------------------
+
+
+class _WorkerProc:
+    """One worker subprocess.  SIGTERM asks for the graceful leave (the
+    flight recorder dumps — with the async checkpointer flushed by the
+    pre-dump hook — then the worker's chained handler releases its
+    membership slot); SIGKILL after a grace period.  An external
+    ``kill -9`` is exactly the lease-expiry story."""
+
+    def __init__(self, wid: str, proc: subprocess.Popen):
+        self.wid = wid
+        self.proc = proc
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        if self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# The supervisor
+# ---------------------------------------------------------------------------
+
+
+class TrainSupervisor:
+    """Supervise N elastic training workers over one broker.
+
+    ``spec`` describes the training job the cohort runs (see
+    ``DEFAULT_SPEC``); it travels to the workers inside the assignment
+    doc, so a worker needs nothing but the broker spec and its id.  The
+    supervisor never holds its lock across broker or process calls (the
+    fleet controller's lock-order hygiene)."""
+
+    DEFAULT_SPEC = {
+        "seed": 3, "n": 256, "in_dim": 8, "hidden": 16, "classes": 4,
+        "batch_size": 32, "nb_epoch": 4, "plan": "fsdp", "k": 1,
+        "poll_s": 0.02, "hb_s": 0.05, "devices": None,
+    }
+
+    def __init__(self, broker_spec, spec: dict, workers: int = 4,
+                 prefix: str = DEFAULT_PREFIX,
+                 lease_ms: int | None = None,
+                 min_workers: int | None = None,
+                 grace_ms: int | None = None,
+                 interval: float = 0.1,
+                 chaos: ChaosSchedule | None = None,
+                 oracle: ConfigOracle | None = None,
+                 registry=None, log_capacity: int = 256,
+                 straggler_factor: float = 1.5,
+                 rebalance_cooldown_s: float = 2.0,
+                 respawn_delay_s: float = 0.0,
+                 cohort_wait_s: float = 20.0,
+                 worker_env: dict | None = None):
+        if not isinstance(broker_spec, str):
+            raise ValueError(
+                "TrainSupervisor needs a cross-process broker spec "
+                "(dir:<spool> or host:port) its subprocess workers can "
+                "re-connect from, not a live broker object")
+        if "ckpt_dir" not in spec:
+            raise ValueError("spec needs a ckpt_dir (the durable resume "
+                             "point every rejoin starts from)")
+        env = os.environ
+        self.broker_spec = broker_spec
+        self.spec = dict(self.DEFAULT_SPEC, **spec)
+        self.workers = int(workers)
+        self.prefix = prefix
+        self.lease_ms = int(lease_ms if lease_ms is not None
+                            else env.get("ZOO_ELASTIC_LEASE_MS", "3000"))
+        self.min_workers = int(
+            min_workers if min_workers is not None
+            else env.get("ZOO_ELASTIC_MIN_WORKERS", "1"))
+        self.grace_ms = int(grace_ms if grace_ms is not None
+                            else env.get("ZOO_ELASTIC_GRACE_MS", "5000"))
+        self.interval = float(interval)
+        self.chaos = chaos
+        self.oracle = oracle if oracle is not None else ConfigOracle()
+        self.straggler_factor = float(straggler_factor)
+        self.rebalance_cooldown_s = float(rebalance_cooldown_s)
+        self.respawn_delay_s = float(respawn_delay_s)
+        self.cohort_wait_s = float(cohort_wait_s)
+        self.worker_env = dict(worker_env or {})
+        self.ledger = MembershipLedger(broker_spec, prefix=prefix,
+                                       lease_ms=self.lease_ms)
+        self.metrics = ElasticMetrics(registry=registry)
+        self.board = StragglerBoard(window=64, min_steps=3)
+        self._flight = get_flight_recorder()
+
+        self._lock = threading.Lock()
+        self._procs: dict = {}  # guarded-by: _lock
+        self._decisions: deque = (  # guarded-by: _lock
+            deque(maxlen=int(log_capacity)))
+        self._repicks: list = []  # guarded-by: _lock
+        self._thread: threading.Thread | None = None  # guarded-by: _lock
+        self._stop_evt = threading.Event()
+        self._last_doc: dict | None = None  # guarded-by: _lock
+        self._assignment: dict | None = None  # guarded-by: _lock
+        self._pending_rejoin: dict | None = None  # guarded-by: _lock
+        self._result: dict | None = None  # guarded-by: _lock
+        self._outcomes_fed = 0  # guarded-by: _lock
+        self._respawn_at: dict = {}  # guarded-by: _lock
+        self._hb_seen: dict = {}  # guarded-by: _lock
+        self._last_rebalance = 0.0  # guarded-by: _lock
+        self._t0 = time.monotonic()
+        with _active_lock:
+            _active.add(self)
+
+    @classmethod
+    def from_config(cls, cfg, broker_spec, spec, **kwargs):
+        """Build from a :class:`~analytics_zoo_tpu.common.engine.
+        ZooConfig` (the eagerly-validated ``ZOO_ELASTIC_*`` env tier)."""
+        kwargs.setdefault("lease_ms", cfg.elastic_lease_ms)
+        kwargs.setdefault("min_workers", cfg.elastic_min_workers)
+        kwargs.setdefault("grace_ms", cfg.elastic_grace_ms)
+        return cls(broker_spec, spec, **kwargs)
+
+    # ------------------------------------------------------------------
+    # worker lifecycle
+    # ------------------------------------------------------------------
+    def _spawn(self, wid: str) -> _WorkerProc:
+        cmd = [sys.executable, "-m", "analytics_zoo_tpu.elastic",
+               "--worker", "--broker", self.broker_spec,
+               "--id", wid, "--prefix", self.prefix,
+               "--lease-ms", str(self.lease_ms)]
+        env = dict(os.environ)
+        # workers must import THIS package regardless of the
+        # supervisor's cwd or an uninstalled source tree
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env.update(self.worker_env)
+        proc = _WorkerProc(wid, subprocess.Popen(cmd, env=env))
+        with self._lock:
+            self._procs[wid] = proc
+        self.metrics.respawns.inc()
+        return proc
+
+    def worker_ids(self) -> list:
+        return [f"w{i}" for i in range(self.workers)]
+
+    def pids(self) -> dict:
+        with self._lock:
+            return {wid: p.pid for wid, p in self._procs.items()}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "TrainSupervisor":
+        for wid in self.worker_ids():
+            with self._lock:
+                have = wid in self._procs
+            if not have:
+                self._spawn(wid)
+        self._stop_evt.clear()
+        self._t0 = time.monotonic()  # cohort_wait_s runs from START
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="zoo-elastic")
+            t = self._thread
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        while True:
+            with self._lock:
+                wid, proc = (self._procs.popitem() if self._procs
+                             else (None, None))
+            if proc is None:
+                break
+            proc.stop(grace_s=max(1.0, self.grace_ms / 1e3))
+
+    def run(self, timeout_s: float = 120.0) -> dict | None:
+        """Start, block until the cohort posts its round result (the
+        chief finished the full ``nb_epoch`` target) or ``timeout_s``,
+        stop, and return the result doc (None on timeout).  The
+        unattended entry the bench and the acceptance test drive."""
+        self.start()
+        deadline = time.monotonic() + float(timeout_s)
+        try:
+            while time.monotonic() < deadline:
+                if self.result() is not None:
+                    # close the loop ourselves: the control thread may
+                    # be stopped before its next tick would harvest
+                    self._harvest_result()
+                    break
+                time.sleep(self.interval)
+        finally:
+            self.stop()
+        return self.result()
+
+    def result(self) -> dict | None:
+        with self._lock:
+            if self._result is not None:
+                return dict(self._result)
+        raw = fget(self.ledger.broker.hgetall(self.ledger.result_key),
+                   "doc")
+        doc = json.loads(raw) if raw else None
+        if doc is not None and doc.get("done"):
+            with self._lock:
+                self._result = doc
+            return dict(doc)
+        return None
+
+    def _run(self):
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self._tick()
+            except Exception as e:
+                # the supervisor must never take the cohort down; a
+                # policy bug shows in the flight ring, not a crash
+                self._flight.record_exception(e, where="elastic")
+
+    # ------------------------------------------------------------------
+    # one control window
+    # ------------------------------------------------------------------
+    def _tick(self):
+        with self._lock:
+            finished = bool(self._outcomes_fed)
+        if finished:
+            # the round is complete and its outcome fed back — stop
+            # orchestrating the dissolving cohort (run() is about to
+            # stop us anyway)
+            return
+        self._supervise()
+        self._fire_chaos()
+        doc, changed = self.ledger.scan()
+        if changed:
+            self._on_generation(doc)
+        self._observe_rejoin(doc)
+        self._feed_straggler(doc)
+        self._harvest_result()
+
+    def _supervise(self):
+        """Drop dead workers and respawn them into their old slot (the
+        respawn re-claims the slot stream the moment the dead lease
+        expires — membership heals without identity churn)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [(wid, p) for wid, p in self._procs.items()
+                    if not p.alive()]
+            for wid, _ in dead:
+                del self._procs[wid]
+                self._respawn_at.setdefault(
+                    wid, now + self.respawn_delay_s)
+            due = [wid for wid, t in self._respawn_at.items() if t <= now]
+        for wid, p in dead:
+            self.metrics.worker_deaths.inc()
+            self._record_decision("death", "process_exit", worker=wid,
+                                  pid=p.pid)
+        for wid in due:
+            if self._stop_evt.is_set():
+                return
+            with self._lock:
+                self._respawn_at.pop(wid, None)
+            self._spawn(wid)
+            self._record_decision("respawn", "supervision", worker=wid)
+
+    def _chief_step(self) -> int:
+        with self._lock:
+            assign = self._assignment
+        if not assign:
+            return 0
+        hb = self.ledger.broker.hgetall(
+            self.ledger.hb_key(assign["chief"]))
+        try:
+            return int(fget(hb, "step", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _fire_chaos(self):
+        if self.chaos is None or self.chaos.done():
+            return
+        step = self._chief_step()
+        for ev in self.chaos.due(step):
+            ev.fired = True
+            with self._lock:
+                proc = self._procs.get(ev.target)
+            if ev.action == "stall":
+                self.ledger.broker.hset(
+                    self.ledger.ctl_key(ev.target),
+                    {"stall_s": str(ev.arg)})
+            elif proc is not None and proc.alive():
+                sig = (signal.SIGKILL if ev.action == "kill"
+                       else signal.SIGTERM)
+                os.kill(proc.pid, sig)
+            self._record_decision(
+                "chaos", ev.action, worker=ev.target, at_step=ev.at_step,
+                fired_step=step, arg=ev.arg)
+
+    def _on_generation(self, doc: dict):
+        gen, world = int(doc["generation"]), int(doc["world"])
+        members = list(doc["members"])
+        with self._lock:
+            prev = self._last_doc
+            self._last_doc = doc
+        prev_world = int(prev["world"]) if prev else 0
+        reason = ("join" if world > prev_world
+                  else "leave" if world < prev_world else "churn")
+        self.metrics.generation.set(gen)
+        self.metrics.world_size.set(world)
+        # steps lost to this fault = chief progress past the last
+        # durable snapshot (they are REPLAYED from LATEST, not dropped —
+        # the trajectory stays exact; the bench reports the replay cost)
+        last_step = self._chief_step()
+        peek = _peek_latest(self.spec["ckpt_dir"])
+        steps_lost = max(0, last_step - (peek["global_step"] if peek
+                                         else 0)) if reason == "leave" \
+            else 0
+        if steps_lost:
+            self.metrics.steps_lost.inc(steps_lost)
+        if world < self.min_workers:
+            self._record_decision(
+                "hold", "below_min_workers", generation=gen, world=world,
+                min_workers=self.min_workers)
+            return
+        with self._lock:
+            first = self._assignment is None
+        if first and world < self.workers \
+                and time.monotonic() - self._t0 < self.cohort_wait_s:
+            # cohort still forming: don't compile the first leg at a
+            # partial world only to yield it seconds later when the
+            # stragglers of the INITIAL spawn join (a fault mid-run is
+            # different — then we rejoin with whoever survives)
+            self._record_decision(
+                "hold", "cohort_forming", generation=gen, world=world,
+                target=self.workers)
+            return
+        mesh = fold_world_to_mesh(
+            world, devices=self.spec.get("devices"))
+        # exactly ONE oracle re-pick per generation change: plan + K +
+        # remat from the roofline model at the NEW shard count, logged
+        # as a prediction whose outcome is the round's measured
+        # steps/sec (_harvest_result)
+        pb = _spec_param_bytes(self.spec)
+        pick = self.oracle.repick(pb, 2 * pb, n_shards=mesh)
+        with self._lock:
+            self._repicks.append({"generation": gen, "world": world,
+                                  "mesh": mesh, "pick": {
+                                      "plan": pick["plan"],
+                                      "k": pick["k"],
+                                      "remat": pick["remat"]}})
+        # the spec may PIN plan/K (bit-exact trajectory tests); the
+        # re-pick still runs and is logged — pinning is a spec choice,
+        # not a skipped decision
+        plan = self.spec.get("plan") or pick["plan"]
+        k = int(self.spec.get("k") or pick["k"])
+        assign = {
+            "generation": gen, "world": world, "mesh": mesh,
+            "chief": members[0], "members": members, "plan": plan,
+            "k": k, "remat": pick["remat"],
+            "shares": equal_shares(self.spec["batch_size"], members),
+            "spec": self.spec, "assign_seq": 0,
+        }
+        self.ledger.publish_assignment(assign)
+        with self._lock:
+            self._assignment = assign
+            self._pending_rejoin = {
+                "generation": gen, "t0": time.monotonic(),
+                "wall_t0": time.time(), "chief": members[0],
+                "from_step": last_step}
+        self.metrics.rejoins.labels(reason=reason).inc()
+        self._record_decision(
+            "rejoin", reason, generation=gen, old_world=prev_world,
+            world=world, mesh=mesh, chief=members[0], plan=plan, k=k,
+            remat=pick["remat"], steps_lost=steps_lost)
+
+    def _observe_rejoin(self, doc: dict):
+        with self._lock:
+            pending = self._pending_rejoin
+        if not pending:
+            return
+        hb = self.ledger.broker.hgetall(
+            self.ledger.hb_key(pending["chief"]))
+        try:
+            ts = float(fget(hb, "ts", 0.0))
+            step = int(fget(hb, "step", 0))
+        except (TypeError, ValueError):
+            return
+        if ts > pending["wall_t0"] and step > 0:
+            secs = time.monotonic() - pending["t0"]
+            self.metrics.rejoin_seconds.observe(secs)
+            self._record_decision(
+                "rejoined", "chief_stepping",
+                generation=pending["generation"],
+                seconds=round(secs, 3), resumed_step=step)
+            with self._lock:
+                self._pending_rejoin = None
+
+    def _feed_straggler(self, doc: dict):
+        """Heartbeat step times -> StragglerBoard -> share rebalance.
+
+        Only same-workload peers are comparable, so the board ingests
+        the SPARE heartbeats (identical nominal loop period); the
+        chief's SPMD step time feeds the estimator's own
+        StragglerDetector instead."""
+        members = list(doc.get("members", []))
+        for wid in members:
+            hb = self.ledger.broker.hgetall(self.ledger.hb_key(wid))
+            if fget(hb, "role") != "spare":
+                continue
+            ts = fget(hb, "ts")
+            with self._lock:
+                seen = self._hb_seen.get(wid)
+                self._hb_seen[wid] = ts
+            if ts is None or ts == seen:
+                continue  # not a fresh sample
+            try:
+                self.board.observe(wid, float(fget(hb, "step_s", 0.0)))
+            except (TypeError, ValueError):
+                pass
+        factors = {w: f for w, f in self.board.factors().items()
+                   if w in members}
+        if not factors or max(factors.values()) < self.straggler_factor:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_rebalance < self.rebalance_cooldown_s:
+                return
+            assign = self._assignment
+        if not assign or sorted(assign["shares"]) != sorted(members):
+            return
+        new = rebalance_shares(assign["shares"], factors)
+        if new == assign["shares"]:
+            return
+        slowest = max(factors, key=factors.get)
+        assign = dict(assign, shares=new,
+                      assign_seq=int(assign["assign_seq"]) + 1)
+        self.ledger.publish_assignment(assign)
+        with self._lock:
+            self._assignment = assign
+            self._last_rebalance = now
+        self.metrics.rebalances.inc()
+        self._record_decision(
+            "rebalance", "straggler", worker=slowest,
+            factor=round(factors[slowest], 3), shares=new,
+            global_batch=sum(new.values()))
+
+    def _harvest_result(self):
+        doc = self.result()
+        if doc is None:
+            return
+        with self._lock:
+            if self._outcomes_fed:
+                return
+            self._outcomes_fed = 1
+            repicks = list(self._repicks)
+        # close the prediction->outcome loop on the LAST re-pick (the
+        # config the finishing leg actually ran under)
+        if repicks and doc.get("steps_per_sec"):
+            last = repicks[-1]
+            cfg = (self.spec.get("plan") or last["pick"]["plan"],
+                   last["pick"]["remat"])
+            try:
+                self.oracle.record_outcome(
+                    cfg, float(doc["steps_per_sec"]), consumer="elastic")
+            except Exception:
+                logger.exception("elastic: outcome feedback failed")
+        self._record_decision(
+            "done", "round_complete", generation=doc.get("generation"),
+            final_step=doc.get("final_step"),
+            steps_per_sec=round(float(doc.get("steps_per_sec", 0.0)), 3))
+
+    def _record_decision(self, action, reason, **fields):
+        with self._lock:
+            self._decisions.append(dict(
+                {"ts": time.time(), "action": action, "reason": reason},
+                **fields))
+        self._flight.record("elastic", event=action, reason=reason,
+                            **fields)
+
+    # ------------------------------------------------------------------
+    # introspection (/varz, metrics_dump, benches)
+    # ------------------------------------------------------------------
+    def decision_log(self) -> list:
+        with self._lock:
+            return list(self._decisions)
+
+    def repick_log(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self._repicks]
+
+    def current(self) -> dict:
+        with self._lock:
+            doc = self._last_doc or {}
+            assign = self._assignment or {}
+            procs = {wid: {"pid": p.pid, "alive": p.alive()}
+                     for wid, p in self._procs.items()}
+            repicks = len(self._repicks)
+        return {
+            "generation": doc.get("generation", 0),
+            "world": doc.get("world", 0),
+            "members": doc.get("members", []),
+            "chief": assign.get("chief"),
+            "mesh": assign.get("mesh"),
+            "plan": assign.get("plan"),
+            "k": assign.get("k"),
+            "shares": assign.get("shares", {}),
+            "target_workers": self.workers,
+            "min_workers": self.min_workers,
+            "workers": procs,
+            "repicks": repicks,
+        }
+
+    def to_doc(self) -> dict:
+        return {"current": self.current(),
+                "decisions": self.decision_log(),
+                "repicks": self.repick_log()}
+
+
+# ---------------------------------------------------------------------------
+# Subprocess worker entry point:
+#   python -m analytics_zoo_tpu.elastic --worker --broker dir:... --id w0
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(argv) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="analytics_zoo_tpu.elastic",
+        description="run ONE elastic training worker against a shared "
+                    "broker")
+    p.add_argument("--worker", action="store_true", required=True)
+    p.add_argument("--broker", required=True,
+                   help="cross-process broker spec (dir:<spool>, "
+                        "host:port)")
+    p.add_argument("--id", required=True, help="membership slot, e.g. w0")
+    p.add_argument("--prefix", default=DEFAULT_PREFIX)
+    p.add_argument("--lease-ms", type=int, default=None)
+    a = p.parse_args(argv)
+
+    ledger = MembershipLedger(a.broker, prefix=a.prefix,
+                              lease_ms=a.lease_ms)
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        stop.set()
+        raise SystemExit(0)
+
+    # Handler ordering is the SIGTERM story: our handler goes in FIRST,
+    # then flight.install() chains OVER it — so a SIGTERM runs the
+    # pre-dump hooks (async checkpointer flushed, final ``ckpt`` event
+    # recorded), writes the flight dump, THEN unwinds through on_term's
+    # SystemExit into the finally below, which releases the membership
+    # slot for a fast (no lease-expiry) rejoin of the survivors.
+    signal.signal(signal.SIGTERM, on_term)
+    flight = get_flight_recorder().install()
+    handle = ledger.join(a.id)
+    flight.record("elastic", event="join", worker=a.id, pid=os.getpid())
+    try:
+        _round_loop(ledger, a.id, stop, flight)
+    finally:
+        flight.record("elastic", event="leave", worker=a.id)
+        handle.leave()
+    return 0
+
+
+def _round_loop(ledger: MembershipLedger, wid: str, stop, flight):
+    """Assignment-driven worker rounds: chief runs the actual SPMD fit
+    leg; everyone else heartbeats as a hot spare until the next
+    generation."""
+    while not stop.is_set():
+        assign = ledger.assignment()
+        if assign is None:
+            time.sleep(0.05)
+            continue
+        if assign.get("chief") == wid:
+            if _chief_leg(ledger, wid, assign, flight):
+                return  # round complete: result posted
+            _wait_past_generation(ledger, int(assign["generation"]), stop)
+        else:
+            _spare_leg(ledger, wid, assign, stop)
+
+
+def _wait_past_generation(ledger, gen: int, stop):
+    while not stop.is_set():
+        a = ledger.assignment()
+        if a is not None and int(a["generation"]) > gen:
+            return
+        time.sleep(0.05)
+
+
+def _spare_leg(ledger: MembershipLedger, wid: str, assign: dict, stop):
+    """Hot spare: keep the membership lease warm (the MemberHandle
+    thread does that) and publish heartbeats the supervisor's straggler
+    board can compare — all spares run the same nominal loop period, so
+    an injected (or real) stall shows as a genuine slowdown factor."""
+    spec = assign.get("spec", {})
+    period = float(spec.get("hb_s", 0.05))
+    gen = int(assign["generation"])
+    step = 0
+    while not stop.is_set():
+        t0 = time.monotonic()
+        a = ledger.assignment()
+        if a is None or int(a["generation"]) != gen \
+                or a.get("chief") == wid:
+            return
+        stall = fget(ledger.broker.hgetall(ledger.ctl_key(wid)),
+                     "stall_s")
+        if stall:
+            ledger.broker.delete(ledger.ctl_key(wid))
+            time.sleep(float(stall))
+        time.sleep(period)
+        step += 1
+        ledger.broker.hset(ledger.hb_key(wid), {
+            "step": str(step),
+            "step_s": "%.6f" % (time.monotonic() - t0),
+            "ts": "%.3f" % time.time(),
+            "role": "spare",
+        })
+
+
+def _chief_leg(ledger: MembershipLedger, wid: str, assign: dict,
+               flight) -> bool:
+    """One training leg at this assignment's (mesh, plan, K): resume
+    from LATEST through the partitioner, fit until done or the next
+    GenerationChange.  Returns True when the nb_epoch target is reached
+    (result posted)."""
+    # keras-stack imports deferred to the one role that traces/compiles
+    import numpy as np
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    spec = dict(assign["spec"])
+    gen = int(assign["generation"])
+    mesh = int(assign["mesh"])
+    os.environ["ZOO_STEPS_PER_DISPATCH"] = str(int(assign.get("k", 1)))
+    zoo.init_zoo_context(seed=int(spec["seed"]),
+                         mesh_shape={"data": mesh})
+    m = Sequential()
+    m.add(Dense(int(spec["hidden"]), activation="relu",
+                input_shape=(int(spec["in_dim"]),)))
+    m.add(Dense(int(spec["classes"]), activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.set_checkpoint(spec["ckpt_dir"])
+    rng = np.random.default_rng(int(spec["seed"]))
+    x = rng.standard_normal(
+        (int(spec["n"]), int(spec["in_dim"]))).astype(np.float32)
+    y = rng.integers(0, int(spec["classes"]),
+                     size=(int(spec["n"]),)).astype(np.int32)
+    peek = _peek_latest(spec["ckpt_dir"])
+    start_step = peek["global_step"] if peek else 0
+    session = ElasticSession(
+        ledger.broker, prefix=ledger.prefix, generation=gen,
+        worker_id=wid, start_step=start_step,
+        min_poll_s=float(spec.get("poll_s", 0.02)),
+        throttle_s=float(spec.get("throttle_s", 0.0)))
+    flight.record("elastic", event="leg", worker=wid, generation=gen,
+                  mesh=mesh, plan=assign.get("plan"),
+                  start_step=start_step)
+    t0 = time.monotonic()
+    try:
+        m.fit(x, y, batch_size=int(spec["batch_size"]),
+              nb_epoch=int(spec["nb_epoch"]), plan=assign.get("plan"),
+              elastic=session)
+    except GenerationChange as gc:
+        flight.record("elastic", event="yielded", worker=wid,
+                      old_generation=gen,
+                      generation=gc.doc.get("generation"))
+        return False
+    est = m._estimator
+    elapsed = max(time.monotonic() - t0, 1e-9)
+    result = {
+        "done": 1, "generation": gen, "worker": wid,
+        "final_step": int(est.global_step), "epoch": int(est.epoch),
+        # loss is None for a zero-dispatch epoch: a resume that lands
+        # exactly on an epoch boundary (next_batch == n_batches) replays
+        # nothing before the boundary sync
+        "history": [{"epoch": int(h["epoch"]),
+                     "loss": None if h["loss"] is None
+                     else float(h["loss"])}
+                    for h in est.history],
+        "steps_per_sec": (est.global_step - start_step) / elapsed,
+        "ts": time.time(),
+    }
+    ledger.broker.hset(ledger.result_key, {"doc": json.dumps(result)})
+    flight.record("elastic", event="done", worker=wid, generation=gen,
+                  final_step=result["final_step"])
+    return True
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(_worker_main(sys.argv[1:]))

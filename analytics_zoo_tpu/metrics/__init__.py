@@ -35,8 +35,10 @@ from analytics_zoo_tpu.metrics.exporters import (
 )
 from analytics_zoo_tpu.metrics.flight import (
     FlightRecorder,
+    StragglerBoard,
     StragglerDetector,
     get_flight_recorder,
+    register_predump_hook,
     set_flight_recorder,
 )
 from analytics_zoo_tpu.metrics.health import (
@@ -67,6 +69,7 @@ from analytics_zoo_tpu.metrics.registry import (
 from analytics_zoo_tpu.metrics.runtime import (
     AutotuneMetrics,
     DataPipelineMetrics,
+    ElasticMetrics,
     FleetMetrics,
     OracleMetrics,
     ServingMetrics,
@@ -89,10 +92,11 @@ __all__ = [
     "sanitize_metric_name", "sanitize_label_name",
     "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
     "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
-    "record_device_memory",
+    "ElasticMetrics", "record_device_memory",
     "MetricsServer", "maybe_start_from_env",
     "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
     "HealthRegistry", "get_health", "set_health",
-    "FlightRecorder", "StragglerDetector", "get_flight_recorder",
-    "set_flight_recorder",
+    "FlightRecorder", "StragglerDetector", "StragglerBoard",
+    "get_flight_recorder", "set_flight_recorder",
+    "register_predump_hook",
 ]

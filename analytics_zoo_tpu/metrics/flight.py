@@ -30,8 +30,42 @@ import os
 import threading
 import time
 
-__all__ = ["FlightRecorder", "StragglerDetector", "get_flight_recorder",
-           "set_flight_recorder"]
+__all__ = ["FlightRecorder", "StragglerDetector", "StragglerBoard",
+           "get_flight_recorder", "set_flight_recorder",
+           "register_predump_hook"]
+
+
+# ---------------------------------------------------------------------------
+# Pre-dump hooks: subsystems with in-flight background work (the async
+# checkpoint writer) register a flush here so a SIGTERM/exit/crash dump
+# contains their FINAL event (e.g. the ``ckpt`` complete/error record)
+# instead of racing the writer thread to process death.  Hooks must be
+# bounded (join with timeout) and exception-safe; a dying process never
+# dies harder over a hook.
+# ---------------------------------------------------------------------------
+
+_predump_lock = threading.Lock()
+_predump_hooks: list = []  # guarded-by: _predump_lock
+
+
+def register_predump_hook(fn) -> None:
+    """Run ``fn()`` before any flight dump is written (idempotent per
+    function object).  Used by the async checkpointer so the shutdown
+    ordering is: flush pending snapshot -> record its ``ckpt`` event ->
+    write the flight dump -> exit."""
+    with _predump_lock:
+        if fn not in _predump_hooks:
+            _predump_hooks.append(fn)
+
+
+def _run_predump_hooks() -> None:
+    with _predump_lock:
+        hooks = list(_predump_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - dump path must never raise
+            pass
 
 
 class FlightRecorder:
@@ -106,6 +140,11 @@ class FlightRecorder:
             if reason in self._dumped_reasons:
                 return None
             self._dumped_reasons.add(reason)
+        # Shutdown ordering fix: flush registered background writers
+        # BEFORE snapshotting the ring, so their final events (the async
+        # checkpointer's ``ckpt`` complete) are IN this dump.  Runs
+        # outside ``_lock`` — hooks record events themselves.
+        _run_predump_hooks()
         path = os.path.join(self.dump_dir,
                             f"flight-{os.getpid()}-{reason}.json")
         try:
@@ -217,6 +256,82 @@ class StragglerDetector:
         if len(vals) < self.min_steps:
             return False
         return seconds > self.k * self._median(vals)
+
+
+class StragglerBoard:
+    """Per-WORKER rolling-p50 slowdown factors (the elastic-training
+    rebalance signal; ISSUE 16).
+
+    :class:`StragglerDetector` answers "was THIS step a straggler";
+    the board answers "which worker is persistently slow, and by how
+    much" — ``observe(worker, step_s)`` feeds one worker's step time
+    and returns that worker's slowdown factor: its rolling p50 over the
+    median of every worker's rolling p50 (the fleet baseline).  1.0
+    means on-pace; ``k`` means k x slower than the typical worker.  The
+    supervisor's micro-batch rebalancer consumes :meth:`factors`
+    instead of reaching into flight internals.
+
+    Per-worker p50s (not pooled samples) keep the baseline robust to
+    uneven reporting rates: a chatty fast worker cannot drown out a
+    silent slow one.  ``min_steps`` suppresses factors until a worker
+    has enough history (warmup steps would otherwise flag everyone).
+    """
+
+    def __init__(self, window: int = 128, min_steps: int = 5):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.min_steps = int(min_steps)
+        self._windows: dict = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def _p50s_locked(self) -> dict:
+        # zoolint: disable=guarded-by -- _locked suffix: callers hold _lock across this call
+        return {w: StragglerDetector._median(sorted(win))
+                for w, win in self._windows.items()
+                if len(win) >= self.min_steps}
+
+    def observe(self, worker: str, step_s: float) -> float:
+        """Record one step duration for ``worker``; returns the
+        worker's current slowdown factor (1.0 while history is thin)."""
+        with self._lock:
+            win = self._windows.get(worker)
+            if win is None:
+                win = self._windows[worker] = collections.deque(
+                    maxlen=self.window)
+            win.append(float(step_s))
+        return self.slowdown(worker)
+
+    def fleet_p50(self) -> float:
+        """Median of the per-worker rolling p50s (0.0 with no data)."""
+        with self._lock:
+            p50s = self._p50s_locked()
+        return StragglerDetector._median(sorted(p50s.values()))
+
+    def slowdown(self, worker: str) -> float:
+        with self._lock:
+            p50s = self._p50s_locked()
+        base = StragglerDetector._median(sorted(p50s.values()))
+        mine = p50s.get(worker)
+        if mine is None or base <= 0.0:
+            return 1.0
+        return mine / base
+
+    def factors(self) -> dict:
+        """``{worker: slowdown_factor}`` for every worker with enough
+        history — the rebalancer's one input."""
+        with self._lock:
+            p50s = self._p50s_locked()
+        base = StragglerDetector._median(sorted(p50s.values()))
+        if base <= 0.0:
+            return {w: 1.0 for w in p50s}
+        return {w: p / base for w, p in p50s.items()}
+
+    def forget(self, worker: str) -> None:
+        """Drop a departed worker's window so its history cannot skew
+        the fleet baseline after it left the membership."""
+        with self._lock:
+            self._windows.pop(worker, None)
 
 
 # ---------------------------------------------------------------------------

@@ -189,6 +189,12 @@ class MetricsServer:
         oracle = sys.modules.get("analytics_zoo_tpu.analysis.oracle")
         if oracle is not None:
             doc["oracle"] = oracle.varz_doc()
+        # Elastic panel (elastic/supervisor.py): generation/world/member
+        # state + the rejoin decision log — same sys.modules-only
+        # contract.
+        elastic = sys.modules.get("analytics_zoo_tpu.elastic.supervisor")
+        if elastic is not None:
+            doc["elastic"] = elastic.varz_doc()
         if self.aggregator is not None:
             agg = self.aggregator.merged(include_driver=False)
             doc["aggregate"] = {"sources": agg["sources"],

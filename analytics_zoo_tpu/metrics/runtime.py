@@ -31,7 +31,7 @@ from analytics_zoo_tpu.metrics.registry import (
 
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
            "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
-           "record_device_memory"]
+           "ElasticMetrics", "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -305,6 +305,56 @@ class FleetMetrics:
             "zoo_fleet_batch_flushes_total",
             "continuous-batching bucket flushes, by reason "
             "(full / budget / drain)", labelnames=("reason",))
+
+
+class ElasticMetrics:
+    """Elastic training-runtime telemetry (``zoo_elastic_*``,
+    elastic/supervisor.py + membership.py).
+
+    The generation/world pair is the membership ledger's visible state:
+    generation increments on ANY join/leave, world size is the live
+    member count the next training cohort runs at.  ``rejoins_total``
+    (labeled by reason — worker_death / worker_join / below_min) is the
+    supervisor's activity rate, the elastic analogue of
+    ``zoo_fleet_decisions_total``.  ``steps_lost_total`` is the
+    fault-tolerance cost signal: steps replayed from the last durable
+    snapshot after an uncheckpointed death — zero while faults land on
+    checkpoint boundaries.  ``rejoin_seconds`` is the gap from a
+    generation change to the new cohort's first training step; it is
+    the number the lease (``ZOO_ELASTIC_LEASE_MS``) trades against
+    false-positive deaths."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.generation = reg.gauge(
+            "zoo_elastic_generation",
+            "membership generation (increments on any join/leave)")
+        self.world_size = reg.gauge(
+            "zoo_elastic_world_size",
+            "live training-worker count of the current generation")
+        self.rejoins = reg.counter(
+            "zoo_elastic_rejoins_total",
+            "generation changes orchestrated by the supervisor, "
+            "by reason", labelnames=("reason",))
+        self.worker_deaths = reg.counter(
+            "zoo_elastic_worker_deaths_total",
+            "workers found dead (expired lease or dead process) by the "
+            "supervisor's scan")
+        self.respawns = reg.counter(
+            "zoo_elastic_respawns_total",
+            "worker processes respawned by the supervisor")
+        self.steps_lost = reg.counter(
+            "zoo_elastic_steps_lost_total",
+            "training steps replayed from the latest snapshot after an "
+            "uncheckpointed fault")
+        self.rebalances = reg.counter(
+            "zoo_elastic_rebalances_total",
+            "straggler-driven micro-batch share rebalances")
+        self.rejoin_seconds = reg.histogram(
+            "zoo_elastic_rejoin_seconds",
+            "wall time from generation change to the new cohort's "
+            "first step")
 
 
 def record_device_memory(registry: MetricsRegistry | None = None) -> int:

@@ -70,7 +70,7 @@ __all__ = [
     "per_chip_bytes", "live_bytes", "record_mem_gauges",
     "serialize_specs", "deserialize_specs",
     "PLAN_NAMES", "DEFAULT_BUCKET_BYTES", "default_bucket_bytes",
-    "grad_bucket_indices",
+    "grad_bucket_indices", "fold_world_to_mesh",
 ]
 
 #: names ``ZOO_SHARDING_PLAN`` / ``resolve_plan`` accept (tensor
@@ -95,6 +95,26 @@ REMAT_POLICIES = ("full", "dots", "attn")
 DEFAULT_BUCKET_BYTES = 4 << 20
 
 _REPLICATE_ALL = ((r".*", P()),)
+
+
+def fold_world_to_mesh(world: int, devices: int | None = None) -> int:
+    """Largest usable data-axis extent for an elastic cohort of
+    ``world`` workers: the biggest power of two <= min(world, devices).
+
+    An elastic generation change can leave ANY world size (lose one of
+    four workers -> 3), but mesh extents must divide the device count
+    (``_infer_mesh_shape``) and real pod topologies only expose
+    power-of-two slices — so the cohort folds down to the largest
+    feasible slice and the spare workers stand by as hot spares until
+    the next generation.  The checkpoint stores global logical arrays,
+    so folding 4 -> 2 -> 4 reshards bit-exactly through the plan's
+    placement (tests/test_elastic_resume.py)."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if devices is None:
+        devices = len(jax.devices())
+    cap = min(int(world), max(int(devices), 1))
+    return 1 << (cap.bit_length() - 1)
 
 
 def default_bucket_bytes() -> int:

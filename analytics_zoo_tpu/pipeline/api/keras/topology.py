@@ -152,7 +152,7 @@ class KerasNet(_ContainerBase):
 
     def fit(self, x, y=None, batch_size=32, nb_epoch=10,
             validation_data=None, distributed=True, sample_weight=None,
-            autotune=None, plan=None):
+            autotune=None, plan=None, elastic=None):
         """Train (reference ``fit`` Topology.scala:418-431 →
         InternalDistriOptimizer.train Topology.scala:1076-1259).
 
@@ -166,7 +166,13 @@ class KerasNet(_ContainerBase):
         :class:`~analytics_zoo_tpu.parallel.plan.ShardingPlan` or a
         canned name ("dp"/"zero1"/"fsdp"); ``None`` defers to
         ``ZOO_SHARDING_PLAN``.  Loss trajectory is placement-invariant
-        (see docs/parallelism.md)."""
+        (see docs/parallelism.md).
+
+        ``elastic``: an :class:`~analytics_zoo_tpu.elastic.membership.
+        ElasticSession` turns this fit into one elastic training leg —
+        it yields with :class:`~analytics_zoo_tpu.elastic.membership.
+        GenerationChange` (after a durable snapshot) when the worker
+        membership changes (see docs/elastic-training.md)."""
         from analytics_zoo_tpu.feature.dataset import FeatureSet
 
         train_set = FeatureSet.of(x, y, sample_weight=sample_weight)
@@ -177,6 +183,7 @@ class KerasNet(_ContainerBase):
         self._estimator.train(
             train_set, batch_size=batch_size, nb_epoch=nb_epoch,
             validation_set=val_set, autotune=autotune, plan=plan,
+            elastic=elastic,
         )
         self._sync_nested()
         return self

@@ -38,6 +38,7 @@ from analytics_zoo_tpu.common.safe_pickle import (
 import queue
 import threading
 import time
+import weakref
 from functools import partial
 from typing import Any, Sequence
 
@@ -67,6 +68,7 @@ from analytics_zoo_tpu.metrics import (
     get_registry,
     maybe_start_from_env,
     record_device_memory,
+    register_predump_hook,
     span,
 )
 
@@ -292,6 +294,43 @@ def _async_checkpoint_enabled() -> bool:
         f"(1/0/true/false/yes/no/on/off), got {raw!r}")
 
 
+# ---------------------------------------------------------------------------
+# Shutdown-ordering fix (ISSUE 16): the SIGTERM flight-dump handler
+# (metrics/flight.py, PR 2) and the async checkpoint writer thread
+# (PR 14) used to race at process death — the dump could be written
+# while the daemon writer was mid-pickle, so the postmortem's final
+# ``ckpt`` event said "start" with no complete/error, and the writer
+# died silently with the process.  Every live _Checkpointer registers
+# here; the flight recorder runs the flush (bounded by
+# ZOO_ELASTIC_GRACE_MS) BEFORE snapshotting the ring, so a SIGTERM dump
+# records the snapshot as flushed-or-failed, never as a mystery.
+# ---------------------------------------------------------------------------
+
+_live_ckpt_lock = threading.Lock()
+# keyed by id(): _Checkpointer is a dataclass (eq, no hash), so a
+# WeakSet cannot hold it
+_live_checkpointers: "weakref.WeakValueDictionary" = (  # guarded-by: _live_ckpt_lock
+    weakref.WeakValueDictionary())
+
+
+def _dump_flush_grace_s() -> float:
+    """Lenient runtime read of ZOO_ELASTIC_GRACE_MS (the eager
+    validation lives in ZooConfig; this path runs inside a dying
+    process and must never raise)."""
+    try:
+        return max(0.0, int(os.environ.get("ZOO_ELASTIC_GRACE_MS",
+                                           "5000")) / 1e3)
+    except (TypeError, ValueError):
+        return 5.0
+
+
+def _flush_checkpointers_for_dump() -> None:
+    with _live_ckpt_lock:
+        cks = list(_live_checkpointers.values())
+    for c in cks:
+        c._flush_for_dump()
+
+
 @dataclasses.dataclass
 class _Checkpointer:
     """Snapshot (params, opt_state, model state, step/epoch, iterator pos).
@@ -339,6 +378,18 @@ class _Checkpointer:
             "per snapshot")
         self._writes = reg.counter(
             "zoo_ckpt_writes_total", "completed checkpoint snapshots")
+        with _live_ckpt_lock:
+            _live_checkpointers[id(self)] = self
+        register_predump_hook(_flush_checkpointers_for_dump)
+
+    def _flush_for_dump(self):
+        """Bounded join of the in-flight async write so a flight dump
+        (SIGTERM/exit/crash) contains this snapshot's final ``ckpt``
+        complete/error event.  Never raises, never unbounded: a wedged
+        writer only delays the dump by the grace window."""
+        t = self._pending
+        if t is not None and t.is_alive():
+            t.join(timeout=_dump_flush_grace_s())
 
     def _wait(self):
         if self._pending is not None:
@@ -947,7 +998,7 @@ class Estimator:
               validation_set: FeatureSet | None = None,
               validation_trigger: ZooTrigger | None = None,
               seed: int | None = None,
-              autotune=None, plan=None):
+              autotune=None, plan=None, elastic=None):
         """``plan``: a :class:`~analytics_zoo_tpu.parallel.plan.
         ShardingPlan` (or canned-plan name — "dp"/"zero1"/"zero2"/
         "fsdp"/"zero3") laying out params, optimizer state, grads and
@@ -973,7 +1024,19 @@ class Estimator:
         dispatch boundaries — loss trajectory bit-identical throughout.
         Pass an :class:`~analytics_zoo_tpu.feature.autotune.
         AutotuneController` instance to share/tune one across fits;
-        ``False`` forces it off regardless of the env."""
+        ``False`` forces it off regardless of the env.
+
+        ``elastic``: an :class:`~analytics_zoo_tpu.elastic.membership.
+        ElasticSession` — the fit becomes one elastic training LEG: at
+        every dispatch boundary the session's membership generation is
+        polled, and on a change the loop snapshots through the async
+        checkpointer (iterator position included), flushes, and raises
+        :class:`~analytics_zoo_tpu.elastic.membership.
+        GenerationChange` carrying the new (generation, world, members)
+        doc — the caller (the elastic worker round loop) rejoins at the
+        new world size and resumes from LATEST through the
+        partitioner's bit-exact resharding.  ``None`` (default) trains
+        exactly as before.  See docs/elastic-training.md."""
         ctx = self.ctx
         dp = ctx.data_parallel_size
         if batch_size % dp != 0:
@@ -1147,7 +1210,8 @@ class Estimator:
                 params, opt_state, state, step_fn, fused_fn, k, dev_tf,
                 plan, controller, train_set, batch_size, seed,
                 start_epoch, start_batch, end_trigger, checkpoint_trigger,
-                validation_set, validation_trigger, retry_times, repl)
+                validation_set, validation_trigger, retry_times, repl,
+                elastic)
         finally:
             if attached_set is not None:
                 # undo the fit-scoped attachment on the CALLER's set
@@ -1173,7 +1237,11 @@ class Estimator:
                             train_set, batch_size, seed, start_epoch,
                             start_batch, end_trigger, checkpoint_trigger,
                             validation_set, validation_trigger,
-                            retry_times, repl):
+                            retry_times, repl, elastic=None):
+        # GenerationChange is control flow, not a failure: it must reach
+        # the elastic worker's round loop, never the retry path below.
+        from analytics_zoo_tpu.elastic.membership import GenerationChange
+
         retries = 0
         while True:
             try:
@@ -1182,10 +1250,11 @@ class Estimator:
                     dev_tf, plan, controller,
                     train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger,
-                    validation_set, validation_trigger,
+                    validation_set, validation_trigger, elastic,
                 )
                 break
-            except (KeyboardInterrupt, ValueError, TypeError):
+            except (KeyboardInterrupt, ValueError, TypeError,
+                    GenerationChange):
                 raise
             except Exception as e:
                 # retry-from-checkpoint loop (Topology.scala:1171-1253)
@@ -1227,7 +1296,7 @@ class Estimator:
                     steps_per_dispatch, dev_tf, plan, controller,
                     train_set, batch_size, seed, start_epoch, start_batch,
                     end_trigger, checkpoint_trigger, validation_set,
-                    validation_trigger):
+                    validation_trigger, elastic=None):
         ctx = self.ctx
         cfg = ctx.config
         k = steps_per_dispatch
@@ -1436,6 +1505,17 @@ class Estimator:
                             per_step_s=round(step_s / nk, 6),
                             rolling_p50_s=round(
                                 straggler.rolling_p50(), 6))
+                    if elastic is not None:
+                        # The STEP BARRIER (ISSUE 16): the membership
+                        # ledger's (generation, world, members) doc is
+                        # the single source of truth, read once per
+                        # dispatch; a generation change snapshots at
+                        # this exact boundary and yields the fit.
+                        newdoc = elastic.poll()
+                        if newdoc is not None:
+                            self._elastic_yield(
+                                newdoc, params, opt_state, state,
+                                tstate, epoch, bi, seed, flight)
             finally:
                 feeder.stop()
                 if prof_active:
@@ -1547,6 +1627,37 @@ class Estimator:
                      plan=getattr(self, "_plan_record", None)),
             )
         return params, opt_state, state
+
+    def _elastic_yield(self, newdoc, params, opt_state, state, tstate,
+                       epoch, next_batch, seed, flight):
+        """Safe-snapshot at the step barrier and yield the fit to the
+        elastic runtime (resume-at-new-world-size entry, ISSUE 16).
+
+        The snapshot carries the exact iterator position
+        (epoch/next_batch) and the plan record, so the successor leg —
+        same process at a refolded mesh, or a fresh cohort — resumes
+        mid-epoch from LATEST through the partitioner with the batch
+        schedule (and so the RNG-folded trajectory) unchanged.  The
+        flush before the raise makes the snapshot DURABLE before any
+        worker acts on the new generation."""
+        from analytics_zoo_tpu.elastic.membership import GenerationChange
+
+        if self._ckpt is not None:
+            opt_flat = jax.tree_util.tree_leaves(opt_state)
+            self._ckpt.save(
+                f"{tstate.iteration}",
+                dict(params=params, state=state, opt_flat=opt_flat,
+                     global_step=tstate.iteration, epoch=epoch,
+                     next_batch=next_batch, seed=seed,
+                     plan=getattr(self, "_plan_record", None)),
+            )
+            self._ckpt._wait()
+        flight.record(
+            "elastic", event="yield", step=tstate.iteration,
+            generation=newdoc.get("generation"),
+            world=newdoc.get("world"))
+        self.epoch = epoch
+        raise GenerationChange(newdoc)
 
     # ------------------------------------------------------------------
     # pure-device step timing (the bench decomposition hook)

@@ -82,6 +82,16 @@ class Broker:
         0."""
         return 0
 
+    def lease_held(self, stream: str) -> bool:
+        """True iff ``stream`` is non-empty and every record in it is
+        under a LIVE lease — the membership-liveness predicate
+        (elastic/membership.py): a worker's single-record member stream
+        reports ``True`` while its keepalive extends the claim, and
+        flips to ``False`` the instant the lease expires (dead) or the
+        record is acked away (clean leave).  Derived from the claim
+        protocol, so it holds on all brokers without new state."""
+        return self.xlen(stream) > 0 and self.unclaimed(stream) == 0
+
     def xlen(self, stream: str) -> int:
         raise NotImplementedError
 

@@ -2205,6 +2205,139 @@ def _data_pipeline_main(argv):
     print(json.dumps(data_pipeline_bench(**kwargs)))
 
 
+# ---------------------------------------------------------------------------
+# --elastic: unattended chaos recovery bench (elastic/; ISSUE 16).  One
+# 4-worker TrainSupervisor run over a dir: broker loses TWO workers mid-
+# run — one to kill -9 (lease expiry), one to SIGTERM (graceful leave) —
+# and regains both via respawn.  Reported: rejoin wall-time per
+# generation change, steps replayed per fault, the full generation/
+# decision timeline, and the trajectory's max |Δ| of final parameters
+# against an uninterrupted in-process run of the SAME spec (the
+# resume-from-LATEST + bit-exact-resharding contract; expect 0.0).
+# Emits BENCH_ELASTIC_r14.json so recovery cost is pinned, not asserted.
+# ---------------------------------------------------------------------------
+
+
+def elastic_bench(quick: bool = False,
+                  out_path: str | None = None) -> dict:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from analytics_zoo_tpu.elastic import ChaosSchedule, TrainSupervisor
+
+    work = tempfile.mkdtemp(prefix="zoo-elastic-bench-")
+    try:
+        ck = os.path.join(work, "ckpt")
+        spec = dict(ckpt_dir=ck, nb_epoch=4 if quick else 6,
+                    plan="fsdp", k=1, throttle_s=0.08)
+        total_steps = (256 // 32) * spec["nb_epoch"]
+        chaos = ChaosSchedule.parse(
+            f"kill@{total_steps // 3}:w1,term@{total_steps // 2}:w2")
+        sup = TrainSupervisor(
+            "dir:" + os.path.join(work, "spool"), spec, workers=4,
+            lease_ms=800, min_workers=1, interval=0.1, chaos=chaos)
+        t0 = time.time()
+        res = sup.run(timeout_s=420)
+        if res is None:
+            raise RuntimeError(
+                "elastic bench: cohort never posted its result; "
+                "decisions=%r" % sup.decision_log())
+
+        log = sup.decision_log()
+        timeline = [dict(d, t=round(d["ts"] - t0, 3)) for d in log]
+        for d in timeline:
+            d.pop("ts")
+        rejoin_s = [d["seconds"] for d in log
+                    if d["action"] == "rejoined"]
+        steps_lost = [
+            {"generation": d["generation"], "steps_replayed":
+             d["steps_lost"]}
+            for d in log
+            if d["action"] == "rejoin" and d["reason"] == "leave"]
+
+        # uninterrupted oracle: same spec, straight through in-process
+        import pickle
+
+        import jax
+
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+        full = dict(TrainSupervisor.DEFAULT_SPEC, **spec)
+        zoo.init_zoo_context(seed=full["seed"], mesh_shape={
+            "data": min(4, len(jax.devices()))})
+        m = Sequential()
+        m.add(Dense(full["hidden"], activation="relu",
+                    input_shape=(full["in_dim"],)))
+        m.add(Dense(full["classes"], activation="softmax"))
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+        rng = np.random.default_rng(full["seed"])
+        x = rng.standard_normal(
+            (full["n"], full["in_dim"])).astype(np.float32)
+        y = rng.integers(0, full["classes"],
+                         size=(full["n"],)).astype(np.int32)
+        m.fit(x, y, batch_size=full["batch_size"],
+              nb_epoch=full["nb_epoch"], plan=full["plan"])
+
+        with open(os.path.join(ck, "LATEST")) as f:
+            name = f.read().strip()
+        with open(os.path.join(ck, name), "rb") as f:
+            payload = pickle.load(f)
+        diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+                 for a, b in zip(
+                     jax.tree_util.tree_leaves(payload["params"]),
+                     jax.tree_util.tree_leaves(m.params))]
+        traj_max_diff = max(diffs) if diffs else float("nan")
+
+        doc = {
+            "metric": "elastic_chaos_recovery",
+            "unit": "max |Δ| of final params vs uninterrupted run",
+            "platform": "cpu",
+            "quick": bool(quick),
+            "value": traj_max_diff,
+            "workers": 4,
+            "chaos": chaos.to_doc(),
+            "final_step": res["final_step"],
+            "steps_per_sec": round(res["steps_per_sec"], 3),
+            "generations": res["generation"],
+            "rejoin_seconds": [round(s, 3) for s in rejoin_s],
+            "steps_replayed_per_fault": steps_lost,
+            "repicks": sup.repick_log(),
+            "timeline": timeline,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    doc["host_fingerprint"] = host_fingerprint()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_ELASTIC_r14.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _elastic_main(argv):
+    # the workers and the in-process oracle leg both need the forced
+    # 8-device CPU mesh (the supervisor folds world sizes onto it)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(elastic_bench(**kwargs)))
+
+
 if __name__ == "__main__":
     if "--partition" in sys.argv:
         _partition_main(sys.argv[1:])
@@ -2220,6 +2353,8 @@ if __name__ == "__main__":
         _oracle_main(sys.argv[1:])
     elif "--overlap" in sys.argv:
         _overlap_main(sys.argv[1:])
+    elif "--elastic" in sys.argv:
+        _elastic_main(sys.argv[1:])
     elif "--dispatch-child" in sys.argv:
         _dispatch_child_main(sys.argv[1:])
     elif "--dispatch" in sys.argv:

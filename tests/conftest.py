@@ -62,6 +62,7 @@ QUICK_FILES = {
     "test_telemetry.py",  # ~9s incl. two actor spawns
     "test_fleet.py",  # serving fleet: claim protocol, autoscaler, kill -9
     "test_overlap.py",  # latency-hiding plane + --overlap bench guard
+    "test_elastic.py",  # elastic runtime: membership, chaos, supervisor
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier
 }

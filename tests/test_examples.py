@@ -344,13 +344,33 @@ def test_pytorch_predict_example():
 
 
 def test_tfnet_predict_example():
+    # Fresh interpreter for the same reason as
+    # test_pytorch_predict_example: the tf-in-pure_callback SPMD program
+    # wedges the 8-participant all-reduce rendezvous (latent jax-0.4 CPU
+    # callback+collective deadlock; it has hung full-suite runs).  The
+    # wedge is probabilistic in ANY process once the callback program is
+    # 8-way sharded (~1 in 5 even in a fresh interpreter), so the
+    # subprocess runs on a single device — no collective, no rendezvous
+    # to wedge — which keeps the zoo-vs-tf parity assertion this example
+    # is actually about.
     import pytest
+    import subprocess
 
     pytest.importorskip("tensorflow")
-    from examples.tfnet.predict import run
-
-    err, agree = run(n=16)
-    assert err < 1e-4 and agree == 1.0
+    code = (
+        "import os, sys; sys.path.insert(0, os.getcwd());"
+        "from examples.tfnet.predict import run;"
+        "err, agree = run(n=16);"
+        "assert err < 1e-4 and agree == 1.0, (err, agree);"
+        "print('TFNET_PREDICT_OK')"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # single device: the sharded path wedges
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "TFNET_PREDICT_OK" in r.stdout
 
 
 def test_gan_eval_example_restores_checkpoint():

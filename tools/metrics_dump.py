@@ -228,6 +228,53 @@ def render_oracle(doc, prefix="", out=None):
                  f"{('-' if err is None else f'{err:.3f}'):>9}")
 
 
+def render_elastic(doc, prefix="", out=None):
+    """Elastic panel for the ``elastic`` section a live ``/varz``
+    carries when a TrainSupervisor ran (elastic/supervisor.py): each
+    supervisor's generation/world/cohort state, the member table with
+    per-worker micro-batch shares, then one row per rejoin decision
+    (time, action, generation, world, reason).  Skipped when the
+    snapshot has no elastic section or ``--prefix`` filters it out."""
+    import datetime
+
+    elastic = doc.get("elastic")
+    if not elastic or (prefix and not "zoo_elastic".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for sup in elastic.get("supervisors", []):
+        cur = sup.get("current", {})
+        emit("\nelastic: generation={generation} "
+             "world={world}/{target_workers} (min={min_workers}) "
+             "mesh={mesh} plan={plan} k={k} chief={chief} "
+             "repicks={repicks}".format(
+                 **{k: cur.get(k) for k in
+                    ("generation", "world", "target_workers",
+                     "min_workers", "mesh", "plan", "k", "chief",
+                     "repicks")}))
+        members = cur.get("members", [])
+        if members:
+            shares = cur.get("shares", {})
+            workers = cur.get("workers", {})
+            emit(f"  {'member':<8}{'share':>6}  {'pid':>8}  alive")
+            for w in members:
+                info = workers.get(w, {})
+                emit(f"  {w:<8}{str(shares.get(w, '-')):>6}  "
+                     f"{str(info.get('pid', '-')):>8}  "
+                     f"{info.get('alive', '-')}")
+    decisions = elastic.get("decisions", [])
+    if decisions:
+        emit(f"\n{'time':<14}{'action':<10}{'gen':<5}{'world':<7}"
+             f"{'worker':<8}reason")
+        for d in decisions:
+            t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            emit(f"{t:<14}{d['action']:<10}"
+                 f"{str(d.get('generation', '-')):<5}"
+                 f"{str(d.get('world', '-')):<7}"
+                 f"{str(d.get('worker', '-')):<8}"
+                 f"{d['reason']}")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("path", nargs="?", help="JSONL metrics file")
@@ -286,6 +333,7 @@ def main():
     render_autotune(last, prefix=a.prefix)
     render_fleet(last, prefix=a.prefix)
     render_oracle(last, prefix=a.prefix)
+    render_elastic(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
