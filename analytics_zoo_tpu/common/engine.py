@@ -302,6 +302,29 @@ class ZooConfig:
                                checkpoint writer before the flight
                                dump, and on a worker's SIGTERM->SIGKILL
                                escalation
+      ZOO_SCRAPE_TARGETS       static scrape list for the zoowatch
+                               federation tier (metrics/scrape.py):
+                               comma/space-separated host:port, URL, or
+                               name=url entries; a VarzScraper built
+                               without explicit targets adopts them
+      ZOO_SCRAPE_INTERVAL      scrape cadence seconds (default 1.0,
+                               floor 0.05)
+      ZOO_SCRAPE_STALE_AFTER   a target silent this many seconds is
+                               stale: its health verdict flips and the
+                               aggregator labels its samples
+                               ``stale="true"`` (default 10.0)
+      ZOO_SLO_OBJECTIVE        default SLO objective for the burn-rate
+                               engine (metrics/slo.py): fraction of
+                               good events promised, in (0, 1)
+                               (default 0.99)
+      ZOO_SLO_SHORT_WINDOW     burn-rate fast window seconds (default
+                               30): both windows must burn above the
+                               threshold for an alert to fire
+      ZOO_SLO_LONG_WINDOW      burn-rate slow window seconds (default
+                               300); must exceed the short window
+      ZOO_SLO_BURN_THRESHOLD   burn-rate multiple that fires an alert
+                               (default 1.0 = burning budget exactly
+                               at the objective's sustainable rate)
 
     ``ZOO_PREFETCH_WORKERS`` / ``ZOO_PREFETCH_DEPTH`` /
     ``ZOO_STEPS_PER_DISPATCH`` are validated EAGERLY here: a
@@ -372,6 +395,19 @@ class ZooConfig:
     elastic_lease_ms: int | None = None
     elastic_min_workers: int | None = None
     elastic_grace_ms: int | None = None
+    # Zoowatch federation tier (metrics/scrape.py, metrics/slo.py):
+    # static scrape targets, cadence, staleness threshold, and the
+    # burn-rate engine's default objective/windows.  Env:
+    # ZOO_SCRAPE_TARGETS, ZOO_SCRAPE_INTERVAL, ZOO_SCRAPE_STALE_AFTER,
+    # ZOO_SLO_OBJECTIVE, ZOO_SLO_SHORT/LONG_WINDOW,
+    # ZOO_SLO_BURN_THRESHOLD.
+    scrape_targets: str | None = None
+    scrape_interval: float | None = None
+    scrape_stale_after: float | None = None
+    slo_objective: float | None = None
+    slo_short_window: float | None = None
+    slo_long_window: float | None = None
+    slo_burn_threshold: float | None = None
 
     def __post_init__(self):
         env = os.environ
@@ -547,6 +583,43 @@ class ZooConfig:
         self.elastic_grace_ms = resolve_int(
             self.elastic_grace_ms, "ZOO_ELASTIC_GRACE_MS", 5_000,
             minimum=0)
+
+        # Zoowatch federation tier (metrics/scrape.py, metrics/slo.py):
+        # same eager-validation contract — a typo'd objective fails at
+        # context init, never from the first burn-rate evaluation.
+        self.scrape_targets = resolve(
+            self.scrape_targets, "ZOO_SCRAPE_TARGETS", None, cast=str)
+        self.scrape_interval = resolve_float(
+            self.scrape_interval, "ZOO_SCRAPE_INTERVAL", 1.0,
+            minimum=0.05)
+        self.scrape_stale_after = resolve_float(
+            self.scrape_stale_after, "ZOO_SCRAPE_STALE_AFTER", 10.0,
+            minimum=0.05)
+        self.slo_objective = resolve_float(
+            self.slo_objective, "ZOO_SLO_OBJECTIVE", 0.99, minimum=0.0)
+        if not 0.0 < self.slo_objective < 1.0:
+            raise ValueError(
+                f"ZOO_SLO_OBJECTIVE must be in (0, 1) — the fraction "
+                f"of good events promised — got {self.slo_objective}")
+        self.slo_short_window = resolve_float(
+            self.slo_short_window, "ZOO_SLO_SHORT_WINDOW", 30.0,
+            minimum=0.1)
+        self.slo_long_window = resolve_float(
+            self.slo_long_window, "ZOO_SLO_LONG_WINDOW", 300.0,
+            minimum=0.1)
+        if self.slo_long_window <= self.slo_short_window:
+            raise ValueError(
+                f"ZOO_SLO_LONG_WINDOW ({self.slo_long_window}) must be "
+                f"> ZOO_SLO_SHORT_WINDOW ({self.slo_short_window}) — "
+                f"multi-window burn-rate alerting needs a slow window "
+                f"to confirm the fast one")
+        self.slo_burn_threshold = resolve_float(
+            self.slo_burn_threshold, "ZOO_SLO_BURN_THRESHOLD", 1.0,
+            minimum=0.0)
+        if self.slo_burn_threshold <= 0:
+            raise ValueError(
+                f"ZOO_SLO_BURN_THRESHOLD must be > 0, "
+                f"got {self.slo_burn_threshold}")
         if self.profile_dir is None:
             self.profile_dir = env.get("ZOO_PROFILE_DIR") or None
         if self.compile_cache is None:

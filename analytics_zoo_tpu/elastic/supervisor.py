@@ -38,6 +38,7 @@ of ``/varz`` (rendered by ``tools/metrics_dump.py``).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
@@ -51,7 +52,14 @@ import weakref
 from collections import deque
 
 from ..analysis.oracle import ConfigOracle
-from ..metrics import ElasticMetrics, StragglerBoard, get_flight_recorder
+from ..metrics import (
+    ElasticMetrics,
+    SloEngine,
+    SloSpec,
+    StragglerBoard,
+    TimeSeriesStore,
+    get_flight_recorder,
+)
 from ..parallel.plan import fold_world_to_mesh
 from .chaos import ChaosSchedule
 from .membership import (
@@ -226,7 +234,9 @@ class TrainSupervisor:
                  rebalance_cooldown_s: float = 2.0,
                  respawn_delay_s: float = 0.0,
                  cohort_wait_s: float = 20.0,
-                 worker_env: dict | None = None):
+                 worker_env: dict | None = None,
+                 hb_slo: SloSpec | None = None,
+                 hb_slo_kill: bool = True):
         if not isinstance(broker_spec, str):
             raise ValueError(
                 "TrainSupervisor needs a cross-process broker spec "
@@ -260,6 +270,24 @@ class TrainSupervisor:
         self.metrics = ElasticMetrics(registry=registry)
         self.board = StragglerBoard(window=64, min_steps=3)
         self._flight = get_flight_recorder()
+        # Heartbeat SLO (ISSUE 17): per-worker hb AGE series feed a
+        # private burn-rate engine.  The lease detects a dead
+        # keepalive; this detects the inverse failure — a worker whose
+        # lease keepalive thread lives while the training loop is
+        # wedged (hb hash stops moving).  A firing alert on a SPARE is
+        # actionable (SIGTERM -> normal respawn path); the chief only
+        # gets a logged verdict — its first heartbeat legitimately
+        # waits out compilation.
+        hb_thr = max(0.5, self.lease_ms / 1e3)
+        self.hb_slo = hb_slo if hb_slo is not None else SloSpec(
+            "worker_heartbeat", "zoo_elastic_hb_age_seconds",
+            threshold=hb_thr, objective=0.5, kind="ceiling",
+            short_window=4.0 * hb_thr, long_window=8.0 * hb_thr,
+            description="per-worker heartbeat freshness "
+                        "(wedged-worker detector)")
+        self.hb_slo_kill = bool(hb_slo_kill)
+        self._hb_store = TimeSeriesStore(capacity=256)
+        self._hb_engine = SloEngine(self._hb_store, registry=registry)
 
         self._lock = threading.Lock()
         self._procs: dict = {}  # guarded-by: _lock
@@ -275,6 +303,7 @@ class TrainSupervisor:
         self._outcomes_fed = 0  # guarded-by: _lock
         self._respawn_at: dict = {}  # guarded-by: _lock
         self._hb_seen: dict = {}  # guarded-by: _lock
+        self._hb_alerted: dict = {}  # guarded-by: _lock
         self._last_rebalance = 0.0  # guarded-by: _lock
         self._t0 = time.monotonic()
         with _active_lock:
@@ -411,6 +440,7 @@ class TrainSupervisor:
             self._on_generation(doc)
         self._observe_rejoin(doc)
         self._feed_straggler(doc)
+        self._check_heartbeat_slo(doc)
         self._harvest_result()
 
     def _supervise(self):
@@ -618,6 +648,57 @@ class TrainSupervisor:
             factor=round(factors[slowest], 3), shares=new,
             global_batch=sum(new.values()))
 
+    def _check_heartbeat_slo(self, doc: dict):
+        """Feed per-worker heartbeat AGE into the burn-rate engine and
+        consume firing verdicts (ISSUE 17).
+
+        Workers that have never heartbeat contribute nothing (cohort
+        startup must not burn budget); a firing alert on a live SPARE
+        is converted into a SIGTERM (reason ``hb_slo``) so the normal
+        death/respawn path replaces the wedged process — the chief and
+        already-dead workers only get the logged verdict."""
+        members = list(doc.get("members", []))
+        now_wall = time.time()
+        roles = {}
+        for wid in members:
+            hb = self.ledger.broker.hgetall(self.ledger.hb_key(wid))
+            try:
+                ts = float(fget(hb, "ts", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                ts = 0.0
+            if ts <= 0.0:
+                continue  # never heartbeat yet — not a freshness fact
+            roles[wid] = fget(hb, "role")
+            self._hb_store.observe(
+                "zoo_elastic_hb_age_seconds", max(0.0, now_wall - ts),
+                labels={"worker": wid})
+            name = f"worker_heartbeat:{wid}"
+            if name not in {s.name for s in self._hb_engine.specs()}:
+                self._hb_engine.add_spec(dataclasses.replace(
+                    self.hb_slo, name=name,
+                    labels=(("worker", wid),)))
+        for alert in self._hb_engine.evaluate():
+            wid = alert["slo"].split(":", 1)[-1]
+            with self._lock:
+                # one decision per firing EPISODE, not per tick
+                if self._hb_alerted.get(wid) == alert["since"]:
+                    continue
+                self._hb_alerted[wid] = alert["since"]
+                proc = self._procs.get(wid)
+            kill = (self.hb_slo_kill and roles.get(wid) == "spare"
+                    and proc is not None and proc.alive())
+            self._record_decision(
+                "hb_slo", "heartbeat_burn", worker=wid,
+                short_burn=alert["short_burn"],
+                long_burn=alert["long_burn"],
+                threshold=alert["threshold"],
+                verdict="kill" if kill else "log")
+            if kill:
+                try:
+                    os.kill(proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass  # lost the race with an organic death
+
     def _harvest_result(self):
         doc = self.result()
         if doc is None:
@@ -730,6 +811,15 @@ def _worker_main(argv) -> int:
     flight = get_flight_recorder().install()
     handle = ledger.join(a.id)
     flight.record("elastic", event="join", worker=a.id, pid=os.getpid())
+    # Federation discovery (ISSUE 17): a worker whose env opted into a
+    # metrics server (ZOO_METRICS_PORT, typically via the supervisor's
+    # worker_env) advertises the bound /telemetryz URL in its hb hash —
+    # scrape.elastic_varz_targets() turns those into scrape targets.
+    from analytics_zoo_tpu.metrics.http import maybe_start_from_env
+
+    _msrv = maybe_start_from_env()
+    if _msrv is not None:
+        ledger.broker.hset(ledger.hb_key(a.id), {"varz": _msrv.url})
     try:
         _round_loop(ledger, a.id, stop, flight)
     finally:

@@ -20,6 +20,13 @@ recorder dumped to ``ZOO_FLIGHT_DIR`` on exit/SIGTERM/crash.  Remote
 actor and worker processes ship snapshots to the driver over the
 ``__zoo_telemetry__`` control frame (``ActorContext.metrics()``).
 
+The federation plane (ISSUE 17): :class:`VarzScraper` pulls every
+host's ``/telemetryz`` into a :class:`TelemetryAggregator` + a
+:class:`TimeSeriesStore` of windowed history, and an :class:`SloEngine`
+evaluates declarative :class:`SloSpec` objectives into multi-window
+burn-rate alerts served at ``/alertz`` — the layer the federated
+``SloScaler`` and the elastic supervisor's heartbeat verdicts read.
+
 See ``docs/observability.md`` for the API tour and metric catalogue.
 """
 
@@ -72,9 +79,23 @@ from analytics_zoo_tpu.metrics.runtime import (
     ElasticMetrics,
     FleetMetrics,
     OracleMetrics,
+    ScrapeMetrics,
     ServingMetrics,
+    SloMetrics,
     StepMetrics,
     record_device_memory,
+)
+from analytics_zoo_tpu.metrics.scrape import (
+    VarzScraper,
+    fleet_varz_targets,
+)
+from analytics_zoo_tpu.metrics.slo import (
+    SloEngine,
+    SloSpec,
+    default_slos,
+)
+from analytics_zoo_tpu.metrics.timeseries import (
+    TimeSeriesStore,
 )
 from analytics_zoo_tpu.metrics.tracing import (
     Tracer,
@@ -92,7 +113,10 @@ __all__ = [
     "sanitize_metric_name", "sanitize_label_name",
     "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
     "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
-    "ElasticMetrics", "record_device_memory",
+    "ElasticMetrics", "ScrapeMetrics", "SloMetrics",
+    "record_device_memory",
+    "TimeSeriesStore", "SloSpec", "SloEngine", "default_slos",
+    "VarzScraper", "fleet_varz_targets",
     "MetricsServer", "maybe_start_from_env",
     "TelemetryAggregator", "telemetry_snapshot", "merge_samples",
     "HealthRegistry", "get_health", "set_health",

@@ -85,10 +85,15 @@ class FlightRecorder:
 
     # -- recording ------------------------------------------------------
     def record(self, kind: str, **fields) -> dict | None:
-        """Append one event; returns it (None when disabled)."""
+        """Append one event; returns it (None when disabled).
+
+        Events carry a ``(mono, ts)`` clock pair (ISSUE 17):
+        CLOCK_MONOTONIC is shared across processes of one boot, so
+        ``tools/flight_merge.py`` can align dumps from many processes
+        on one timeline without trusting each process's wall clock."""
         if not self.enabled:
             return None
-        ev = {"ts": time.time(), "kind": kind}
+        ev = {"ts": time.time(), "mono": time.monotonic(), "kind": kind}
         ev.update(fields)
         with self._lock:
             if len(self._events) == self.capacity:
@@ -121,10 +126,15 @@ class FlightRecorder:
 
     # -- dumping --------------------------------------------------------
     def to_doc(self, reason: str = "live") -> dict:
+        # (monotonic, epoch) captured back-to-back: the merge tool's
+        # per-process offset estimate even for docs whose events
+        # predate the per-event `mono` field
         return {
             "reason": reason,
             "pid": os.getpid(),
             "dumped_unix": time.time(),
+            "clock_anchor": {"epoch": time.time(),
+                             "monotonic": time.monotonic()},
             "dropped_events": self.dropped,
             "events": self.events(),
         }

@@ -18,6 +18,15 @@ humans with curl:
                       fresh, 503 with the stale components otherwise
                       (health.py rollup) — the readiness-probe contract.
 ``/flightz``          the flight recorder ring as JSON (flight.py).
+``/telemetryz``       the MERGEABLE snapshot (``merge.telemetry_
+                      snapshot``): histogram samples keep their bucket
+                      vectors, so a federation scraper (metrics/
+                      scrape.py) can ingest them into a
+                      ``TelemetryAggregator``/``TimeSeriesStore`` —
+                      /varz histograms are lossy summaries.
+``/alertz``           SLO burn-rate alert state across every live
+                      ``SloEngine`` (metrics/slo.py): firing + latest
+                      verdict per spec.  Empty doc when no engine runs.
 ====================  ====================================================
 
 ``port=0`` binds an ephemeral port (tests read :attr:`MetricsServer.port`
@@ -107,6 +116,8 @@ class MetricsServer:
             "/trace": self._trace,
             "/healthz": self._healthz,
             "/flightz": self._flightz,
+            "/telemetryz": self._telemetryz,
+            "/alertz": self._alertz,
             "/": self._index,
         }
 
@@ -195,6 +206,15 @@ class MetricsServer:
         elastic = sys.modules.get("analytics_zoo_tpu.elastic.supervisor")
         if elastic is not None:
             doc["elastic"] = elastic.varz_doc()
+        # SLO panel (metrics/slo.py): specs + alert state + the
+        # firing/resolved decision log — same sys.modules-only contract.
+        slo = sys.modules.get("analytics_zoo_tpu.metrics.slo")
+        if slo is not None:
+            doc["slo"] = slo.varz_doc()
+        # Scraper panel (metrics/scrape.py): per-target fetch/staleness.
+        scrape = sys.modules.get("analytics_zoo_tpu.metrics.scrape")
+        if scrape is not None:
+            doc["scrape"] = scrape.varz_doc()
         if self.aggregator is not None:
             agg = self.aggregator.merged(include_driver=False)
             doc["aggregate"] = {"sources": agg["sources"],
@@ -213,6 +233,26 @@ class MetricsServer:
     def _flightz(self):
         return 200, "application/json", json.dumps(
             self._flt().to_doc(reason="live"))
+
+    def _telemetryz(self):
+        from analytics_zoo_tpu.metrics.merge import telemetry_snapshot
+
+        return 200, "application/json", json.dumps(
+            telemetry_snapshot(self._reg(), health=self._hlt()))
+
+    def _alertz(self):
+        # sys.modules-only, like the /varz panels: serving /alertz on a
+        # process with no SLO engine must not import the module.
+        import sys
+        import time
+
+        slo = sys.modules.get("analytics_zoo_tpu.metrics.slo")
+        if slo is None:
+            doc = {"ts": time.time(), "engines": 0, "firing": [],
+                   "alerts": []}
+        else:
+            doc = slo.alertz_doc()
+        return 200, "application/json", json.dumps(doc)
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "MetricsServer":
@@ -290,5 +330,5 @@ def maybe_start_from_env(aggregator=None) -> MetricsServer | None:
         _env_server = srv
         logging.getLogger("analytics_zoo_tpu").info(
             "metrics server on %s (/metrics /varz /trace /healthz "
-            "/flightz)", srv.url)
+            "/flightz /telemetryz /alertz)", srv.url)
         return srv

@@ -40,6 +40,17 @@ REPLACES its series, which a fold-into-counters design cannot express.
 cluster totals; ``prometheus_text()`` renders the per-source series in
 exposition format for ``/metrics`` (scrapers sum; humans read totals
 from ``/varz``).
+
+Staleness (ISSUE 17): a source that stops re-ingesting is never
+evicted — its last snapshot stays in the rollup so a dead host remains
+VISIBLE — but once its last ingest is older than ``stale_after``
+seconds it is flagged: ``sources()`` reports ``stale: true`` +
+``age_seconds``, and ``labeled_samples()`` adds a ``stale="true"``
+label to its series so dashboards and the federation scraper can
+filter it without losing it.  Totals keep stale contributions (a dead
+host's counters are its true last-known work; dropping them would make
+pod totals dip on every death) — the per-source flags carry the
+verdict.
 """
 
 from __future__ import annotations
@@ -152,8 +163,14 @@ class TelemetryAggregator:
     previous snapshot instead of double-counting it.
     """
 
-    def __init__(self, registry: MetricsRegistry | None = None):
+    #: default seconds-without-ingest before a source is flagged stale
+    DEFAULT_STALE_AFTER = 15.0
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 stale_after: float | None = None):
         self._registry = registry
+        self.stale_after = (float(stale_after) if stale_after is not None
+                            else self.DEFAULT_STALE_AFTER)
         self._lock = threading.Lock()
         # key -> (source_labels, snapshot, ingest_time)
         self._sources: dict[tuple, tuple[dict, dict, float]] = {}  # guarded-by: _lock
@@ -170,6 +187,7 @@ class TelemetryAggregator:
         return key
 
     def sources(self) -> dict:
+        now = time.time()
         with self._lock:
             items = list(self._sources.items())
         return {
@@ -180,19 +198,31 @@ class TelemetryAggregator:
                 "pid": snap.get("pid"),
                 "healthy": (snap.get("health") or {}).get("healthy"),
                 "ingested": ingested,
+                "age_seconds": round(now - ingested, 3),
+                "stale": (now - ingested) > self.stale_after,
             }
             for key, (labels, snap, ingested) in items
         }
 
+    def stale_sources(self) -> list[str]:
+        """Rendered keys of sources past the stale threshold."""
+        return [k for k, v in self.sources().items() if v["stale"]]
+
     def labeled_samples(self) -> list[dict]:
-        """Every source's samples with its source labels merged in."""
+        """Every source's samples with its source labels merged in; a
+        source past ``stale_after`` additionally gets ``stale="true"``
+        (visible-but-flagged — never evicted)."""
+        now = time.time()
         with self._lock:
             items = list(self._sources.values())
         out = []
-        for labels, snap, _ in items:
+        for labels, snap, ingested in items:
+            stale = (now - ingested) > self.stale_after
             for s in snap.get("samples", []):
                 ls = dict(s.get("labels") or {})
                 ls.update(labels)
+                if stale:
+                    ls["stale"] = "true"
                 out.append({**s, "labels": ls})
         return out
 

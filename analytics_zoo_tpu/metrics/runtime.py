@@ -31,7 +31,8 @@ from analytics_zoo_tpu.metrics.registry import (
 
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
            "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
-           "ElasticMetrics", "record_device_memory"]
+           "ElasticMetrics", "ScrapeMetrics", "SloMetrics",
+           "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
 # the 30s tail — a 30s TRAIN step is not a resolution we need, and
@@ -305,6 +306,14 @@ class FleetMetrics:
             "zoo_fleet_batch_flushes_total",
             "continuous-batching bucket flushes, by reason "
             "(full / budget / drain)", labelnames=("reason",))
+        # federation tier (ISSUE 17): host dimension alongside replicas
+        self.hosts = reg.gauge(
+            "zoo_fleet_hosts",
+            "live scrape-fresh hosts contributing federated signals")
+        self.hosts_target = reg.gauge(
+            "zoo_fleet_hosts_target",
+            "scaler's host target from replicas-per-host packing "
+            "(advisory — an external provisioner acts on it)")
 
 
 class ElasticMetrics:
@@ -355,6 +364,71 @@ class ElasticMetrics:
             "zoo_elastic_rejoin_seconds",
             "wall time from generation change to the new cohort's "
             "first step")
+
+
+class ScrapeMetrics:
+    """Federation-scraper telemetry (``zoo_scrape_*``,
+    metrics/scrape.py).
+
+    ``staleness_seconds`` is the load-bearing gauge: seconds since the
+    last successful pull from each target.  A dead host's counters stop
+    moving but its LAST values persist in the aggregator (flagged
+    ``stale`` — merge.py), so staleness is the only signal that
+    distinguishes "quiet host" from "vanished host"; the default
+    heartbeat SLO watches exactly this family."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.targets = reg.gauge(
+            "zoo_scrape_targets",
+            "targets currently in the scrape set (static + discovered)")
+        self.fetches = reg.counter(
+            "zoo_scrape_fetches_total",
+            "successful telemetry pulls, by target",
+            labelnames=("target",))
+        self.errors = reg.counter(
+            "zoo_scrape_errors_total",
+            "failed telemetry pulls (connect/timeout/decode), by target",
+            labelnames=("target",))
+        self.staleness = reg.gauge(
+            "zoo_scrape_staleness_seconds",
+            "seconds since the last successful pull, by target",
+            labelnames=("target",))
+        self.fetch_seconds = reg.histogram(
+            "zoo_scrape_fetch_seconds",
+            "wall time of one target pull (GET + decode + ingest)")
+
+
+class SloMetrics:
+    """Burn-rate engine telemetry (``zoo_slo_*``, metrics/slo.py).
+
+    ``burn_rate`` is windowed (label ``window`` = short/long): 1.0
+    means the error budget burns exactly at the sustainable rate; an
+    alert needs BOTH windows above the spec's threshold, so a brief
+    spike (short high, long low) and old news (long high, short low)
+    both stay quiet.  ``alert_active`` is the current verdict per SLO;
+    ``alerts_total`` counts firing transitions."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.burn_rate = reg.gauge(
+            "zoo_slo_burn_rate",
+            "error-budget burn rate per SLO and window "
+            "(1.0 = burning exactly at budget)",
+            labelnames=("slo", "window"))
+        self.alert_active = reg.gauge(
+            "zoo_slo_alert_active",
+            "1 while the multi-window burn alert fires, by SLO",
+            labelnames=("slo",))
+        self.alerts = reg.counter(
+            "zoo_slo_alerts_total",
+            "alert firing transitions (quiet -> firing), by SLO",
+            labelnames=("slo",))
+        self.evaluations = reg.counter(
+            "zoo_slo_evaluations_total",
+            "engine evaluation ticks across all specs")
 
 
 def record_device_memory(registry: MetricsRegistry | None = None) -> int:

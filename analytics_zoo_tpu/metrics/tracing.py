@@ -75,8 +75,14 @@ class Tracer:
         # first eviction (constructing a Tracer must not force the
         # process-global registry into existence)
         self._drop_counter = None
-        # perf_counter origin so ts fields are small positive microseconds
+        # perf_counter origin so ts fields are small positive
+        # microseconds — plus a (monotonic, epoch) anchor captured at
+        # the SAME instant, so tools/flight_merge.py can place this
+        # process's µs timeline on the cluster-wide wall clock (each
+        # process's trace clock alone is only self-consistent)
         self._t0 = time.perf_counter()
+        self._t0_monotonic = time.monotonic()
+        self._t0_epoch = time.time()
 
     # -- recording ------------------------------------------------------
     def now_us(self) -> float:
@@ -120,13 +126,23 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def clock_anchor(self) -> dict:
+        """The trace-origin instant on three clocks: ``ts=0`` µs of
+        this trace corresponds to ``epoch`` wall time and ``monotonic``
+        (CLOCK_MONOTONIC — shared by all processes of one boot, so
+        same-host merges can sidestep wall-clock skew entirely)."""
+        return {"epoch": self._t0_epoch,
+                "monotonic": self._t0_monotonic,
+                "pid": os.getpid()}
+
     def to_chrome_trace(self) -> dict:
         """The ``chrome://tracing`` JSON object format."""
         doc = {
             "traceEvents": self.events(),
             "displayTimeUnit": "ms",
             "metadata": {"producer": "analytics_zoo_tpu.metrics.tracing",
-                         "dropped_events": self.dropped},
+                         "dropped_events": self.dropped,
+                         "clock_anchor": self.clock_anchor()},
         }
         return doc
 

@@ -151,7 +151,8 @@ class FleetController:
                  interval: float = 1.0, mode: str = "thread",
                  serve_log: str | None = None, broker_spec=None,
                  registry=None, log_capacity: int = 256,
-                 replica_extra_args=()):
+                 replica_extra_args=(), signal_source=None,
+                 replica_metrics: bool = False):
         if mode not in ("thread", "process"):
             raise ValueError(f"mode must be thread|process, got {mode!r}")
         self.helper = helper
@@ -170,6 +171,15 @@ class FleetController:
                 "mode='process' needs a cross-process broker spec "
                 "(dir:<spool> or host:port), not a live broker object")
         self.replica_extra_args = tuple(replica_extra_args)
+        # Federation tier (ISSUE 17): when a signal source is attached
+        # (FederatedSignalSource over a VarzScraper-fed store) the
+        # scaler runs ONLY on the scraped cross-host view — the local
+        # registry window is not consulted — and the decision gains a
+        # host-count output.  Process replicas then need
+        # ``replica_metrics=True`` so each exports /telemetryz and
+        # publishes its URL for scraper discovery.
+        self.signal_source = signal_source
+        self.replica_metrics = bool(replica_metrics)
         self.metrics = FleetMetrics(registry=registry)
         # scaler signal sources: the SAME registry children the serving
         # replicas record into (thread mode) — family names resolve to
@@ -188,6 +198,8 @@ class FleetController:
         self._records_base: float | None = None  # guarded-by: _lock
         self._window_t0: float | None = None  # guarded-by: _lock
         self._prev_depth: int | None = None  # guarded-by: _lock
+        self._hosts: int | None = None  # guarded-by: _lock
+        self._hosts_target: int | None = None  # guarded-by: _lock
         self._thread: threading.Thread | None = None  # guarded-by: _lock
         self._stop_evt = threading.Event()
         self._flight = get_flight_recorder()
@@ -239,6 +251,10 @@ class FleetController:
                 cmd += ["--model", str(self.helper.model_path)]
             if self.serve_log:
                 cmd += ["--serve-log", self.serve_log]
+            if self.replica_metrics:
+                # ephemeral port; the replica publishes its bound URL
+                # on the broker (VARZ_KEY_PREFIX) for scraper discovery
+                cmd += ["--metrics-port", "0"]
             cmd += list(self.replica_extra_args)
             rep = _ProcessReplica(owner, subprocess.Popen(cmd))
         with self._lock:
@@ -312,6 +328,8 @@ class FleetController:
     # one control window
     # ------------------------------------------------------------------
     def _gather_window(self) -> FleetSignals:
+        if self.signal_source is not None:
+            return self._gather_federated()
         now = time.monotonic()
         with self._lock:
             p_base = self._predict_base
@@ -351,6 +369,19 @@ class FleetController:
                                memory_ratio=sig.memory_ratio)
         return sig
 
+    def _gather_federated(self) -> FleetSignals:
+        """Federated window: the LOCAL registry is not consulted — the
+        signal source reads the scraped per-host series (ISSUE 17).
+        The window spans the elapsed time since the previous tick, so
+        the store's delta covers exactly one control interval."""
+        now = time.monotonic()
+        with self._lock:
+            t0 = self._window_t0
+            self._window_t0 = now
+        window_s = max(self.interval,
+                       (now - t0) if t0 is not None else self.interval)
+        return self.signal_source.gather(window_s)
+
     def _supervise(self) -> int:
         """Drop dead replicas (their leases expire to survivors) and
         respawn to target; returns live count."""
@@ -385,16 +416,27 @@ class FleetController:
         self.metrics.queue_depth.set(sig.queue_depth)
         if est > self.scaler.slo_p99_ms / 1e3:
             self.metrics.slo_violations.inc()
-        target, reason = self.scaler.decide(n, sig)
+        hosts = hosts_target = None
+        if self.signal_source is not None:
+            hosts = max(1, int(self.signal_source.host_count()))
+            target, hosts_target, reason = self.scaler.decide_fleet(
+                n, hosts, sig)
+            self.metrics.hosts.set(hosts)
+            self.metrics.hosts_target.set(hosts_target)
+        else:
+            target, reason = self.scaler.decide(n, sig)
         with self._lock:
             self._target = target
             self._last_signals = sig
+            self._hosts = hosts
+            self._hosts_target = hosts_target
         self.metrics.replicas_target.set(target)
         if target == n:
             return
         action = "up" if target > n else "down"
         self._record_decision(action, n, target, reason, est,
-                              sig.queue_depth)
+                              sig.queue_depth, hosts=hosts,
+                              hosts_target=hosts_target)
         while n < target and not self._stop_evt.is_set():
             self._spawn()
             n += 1
@@ -403,18 +445,24 @@ class FleetController:
             n -= 1
 
     def _record_decision(self, action, old, new, reason, est_p99_s,
-                         queue_depth):
+                         queue_depth, hosts=None, hosts_target=None):
         est_ms = None if est_p99_s is None or est_p99_s != est_p99_s \
             or est_p99_s == float("inf") else round(est_p99_s * 1e3, 3)
+        row = {"ts": time.time(), "action": action, "old": old,
+               "new": new, "reason": reason, "est_p99_ms": est_ms,
+               "queue_depth": queue_depth}
+        if hosts is not None:
+            row["hosts"] = hosts
+            row["hosts_target"] = hosts_target
         with self._lock:
-            self._decisions.append({
-                "ts": time.time(), "action": action, "old": old,
-                "new": new, "reason": reason, "est_p99_ms": est_ms,
-                "queue_depth": queue_depth})
+            self._decisions.append(row)
         self.metrics.decisions.labels(action=action, reason=reason).inc()
         self._flight.record("fleet_scale", action=action, old=old,
                             new=new, reason=reason, est_p99_ms=est_ms,
-                            queue_depth=queue_depth)
+                            queue_depth=queue_depth,
+                            **({"hosts": hosts,
+                                "hosts_target": hosts_target}
+                               if hosts is not None else {}))
 
     # ------------------------------------------------------------------
     # introspection (/varz, metrics_dump, benches)
@@ -431,6 +479,9 @@ class FleetController:
                 "target": self._target,
                 "owners": [r.owner for r in self._replicas],
                 "mode": self.mode,
+                "federated": self.signal_source is not None,
+                "hosts": self._hosts,
+                "hosts_target": self._hosts_target,
                 "slo_p99_ms": self.scaler.slo_p99_ms,
                 "min_replicas": self.scaler.min_replicas,
                 "max_replicas": self.scaler.max_replicas,
@@ -493,6 +544,10 @@ def _replica_main(argv) -> int:
     p.add_argument("--serve-log", default=None)
     p.add_argument("--idle-timeout", type=float, default=None)
     p.add_argument("--max-records", type=int, default=None)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="start a /telemetryz server on this port (0 = "
+                        "ephemeral) and publish its URL on the broker "
+                        "for federation-scraper discovery")
     a = p.parse_args(argv)
 
     owner = a.owner or "%s-%d" % (socket.gethostname(), os.getpid())
@@ -506,8 +561,34 @@ def _replica_main(argv) -> int:
     model = None if a.model else _SyntheticModel(a.synthetic_sleep_ms)
     srv = ClusterServing(helper=helper, model=model, owner=owner,
                          serve_log=a.serve_log)
+    metrics_srv, varz_db = None, None
+    if a.metrics_port is not None:
+        # federated replica: export this process's registry at
+        # /telemetryz and register the bound URL under the discovery
+        # key — the controller-side VarzScraper finds it there.  A bind
+        # failure degrades to an undiscoverable (but serving) replica.
+        from analytics_zoo_tpu.metrics.http import MetricsServer
+        from analytics_zoo_tpu.metrics.scrape import VARZ_KEY_PREFIX
+
+        try:
+            metrics_srv = MetricsServer(port=a.metrics_port).start()
+            varz_db = connect_broker(a.broker)
+            varz_db.hset(VARZ_KEY_PREFIX + owner,
+                         {"url": metrics_srv.url, "ts": str(time.time())})
+        except OSError:
+            metrics_srv = None
     signal.signal(signal.SIGTERM, lambda *_: srv.stop())
-    srv.run(max_records=a.max_records, idle_timeout=a.idle_timeout)
+    try:
+        srv.run(max_records=a.max_records, idle_timeout=a.idle_timeout)
+    finally:
+        if varz_db is not None:
+            try:
+                varz_db.delete(VARZ_KEY_PREFIX + owner)
+            except Exception:
+                pass  # a dying replica just leaves a stale key; the
+                # scraper's staleness verdict handles it
+        if metrics_srv is not None:
+            metrics_srv.stop()
     return 0
 
 

@@ -26,6 +26,16 @@ burns the SLO, over-provisioning only burns idle replicas).  Broker
 memory pressure is an immediate violation regardless of latency: by the
 time ``memory_ratio`` reaches the server's trim threshold the fleet is
 DROPPING records.
+
+Federation tier (ISSUE 17 — the ROADMAP's planet-scale item (a)): in a
+multi-host fleet the controller's local registry only sees replicas it
+spawned in-process; :class:`FederatedSignalSource` builds the SAME
+``FleetSignals`` window from a :class:`~analytics_zoo_tpu.metrics.
+timeseries.TimeSeriesStore` that a :class:`~analytics_zoo_tpu.metrics.
+scrape.VarzScraper` fills from every replica's /telemetryz — so the
+policy is unchanged while the signals become cluster-wide.  The pure
+policy gains a second output: :meth:`SloScaler.decide_fleet` converts
+the replica target into a host target via replicas-per-host packing.
 """
 
 from __future__ import annotations
@@ -33,7 +43,8 @@ from __future__ import annotations
 import dataclasses
 import math
 
-__all__ = ["FleetSignals", "SloScaler", "DEFAULT_SLO_P99_MS"]
+__all__ = ["FleetSignals", "SloScaler", "FederatedSignalSource",
+           "DEFAULT_SLO_P99_MS"]
 
 # Default p99 SLO target (ms): generous enough that a single warm
 # replica meets it on the bench synthetics, tight enough that a load
@@ -136,3 +147,73 @@ class SloScaler:
         self._up_streak = 0
         self._down_streak = 0
         return replicas, ""
+
+    # ------------------------------------------------------------------
+    def decide_fleet(self, replicas: int, hosts: int, sig: FleetSignals,
+                     replicas_per_host: int | None = None,
+                     max_hosts: int | None = None,
+                     ) -> tuple[int, int, str]:
+        """``(target_replicas, target_hosts, reason)`` — the federated
+        two-level decision.  Replica policy is :meth:`decide` verbatim;
+        the host target is the packing consequence: enough hosts to
+        hold the replica target at ``replicas_per_host`` (defaulting to
+        the CURRENT observed packing ``ceil(replicas / hosts)``), never
+        below 1, capped at ``max_hosts`` when given.  Still pure — the
+        controller (or an external provisioner reading /varz) owns
+        actually adding hosts."""
+        target, reason = self.decide(replicas, sig)
+        hosts = max(1, int(hosts))
+        rph = (int(replicas_per_host) if replicas_per_host
+               else max(1, math.ceil(max(1, replicas) / hosts)))
+        target_hosts = max(1, math.ceil(target / rph))
+        if max_hosts is not None:
+            target_hosts = min(target_hosts, int(max_hosts))
+        return target, target_hosts, reason
+
+
+class FederatedSignalSource:
+    """One scaler window assembled from SCRAPED per-host series.
+
+    Reads the :class:`TimeSeriesStore` a :class:`VarzScraper` feeds
+    (per-replica ``zoo_serving_predict_seconds`` /
+    ``zoo_serving_records_total`` series, labeled by target) and the
+    broker's queue state, producing the same :class:`FleetSignals` the
+    local-registry path builds — the controller swaps sources, the
+    policy never knows.  ``host_count()`` is the federation's second
+    dimension: distinct FRESH targets currently contributing series
+    (the scraper's staleness verdict keeps dead hosts out)."""
+
+    def __init__(self, store, broker, stream: str,
+                 scraper=None,
+                 predict_family: str = "zoo_serving_predict_seconds",
+                 records_family: str = "zoo_serving_records_total"):
+        self.store = store
+        self.broker = broker
+        self.stream = stream
+        self.scraper = scraper
+        self.predict_family = predict_family
+        self.records_family = records_family
+
+    def gather(self, window_s: float) -> FleetSignals:
+        """Fleet-wide window: p99 over the cross-host bucket merge,
+        service rate as the sum of per-host counter rates, queue depth
+        and memory ratio from the broker (shared state — already
+        fleet-wide)."""
+        summ = self.store.window_summary(self.predict_family, window_s)
+        rate = self.store.rate(self.records_family, window_s)
+        return FleetSignals(
+            predict_p99_s=summ["p99"],
+            window_count=summ["count"],
+            service_rate=rate,
+            queue_depth=self.broker.unclaimed(self.stream),
+            memory_ratio=self.broker.memory_ratio(),
+        )
+
+    def host_count(self) -> int:
+        """Live targets per the scraper's merged health verdict; falls
+        back to counting distinct stored predict-series sources when no
+        scraper is attached."""
+        if self.scraper is not None:
+            hz = self.scraper.healthz()
+            return sum(1 for t in hz["targets"].values() if t["healthy"])
+        return len(self.store.label_sets(self.predict_family))

@@ -2338,6 +2338,347 @@ def _elastic_main(argv):
     print(json.dumps(elastic_bench(**kwargs)))
 
 
+# ---------------------------------------------------------------------------
+# --federated: the zoowatch federation plane e2e (ISSUE 17).  Two legs:
+#   1. federated_scaler_bench — a PROCESS-mode fleet whose replicas each
+#      export /telemetryz on an ephemeral port; a VarzScraper discovers
+#      them via the broker, feeds a TimeSeriesStore + SloEngine, and the
+#      SloScaler runs ONLY on that federated view (the local registry is
+#      never consulted) through a 10x offered-load step.  The story: the
+#      burn-rate alert at /alertz fires BEFORE the estimated sojourn
+#      hard-violates the serving SLO — the SLO spec's threshold is the
+#      per-dispatch latency budget (batches filling up is the leading
+#      indicator of saturation), so the multi-window burn crosses while
+#      the client-visible p99 is still inside the SLO.
+#   2. chaos_explainability_bench — a ChaosSchedule elastic run whose
+#      per-process flight dumps are merged by tools/flight_merge.py onto
+#      one wall-clock timeline; every generation change and respawn must
+#      appear next to its cause event.
+# Emits BENCH_FED_r15.json so both stories are pinned, not asserted.
+# ---------------------------------------------------------------------------
+
+
+def federated_scaler_bench(quick: bool = False) -> dict:
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from analytics_zoo_tpu.metrics import (
+        MetricsServer, SloEngine, SloSpec, TimeSeriesStore,
+        VarzScraper, fleet_varz_targets)
+    from analytics_zoo_tpu.serving import (
+        ClusterServingHelper, InputQueue, OutputQueue)
+    from analytics_zoo_tpu.serving.broker import connect_broker
+    from analytics_zoo_tpu.serving.fleet import FleetController
+    from analytics_zoo_tpu.serving.scaler import (
+        FederatedSignalSource, SloScaler)
+
+    service_ms = 20.0          # one replica saturates at ~50 rec/s
+    slo_p99_ms = 400.0         # the HARD serving SLO (sojourn estimate)
+    dispatch_budget_s = 0.08   # SLO-spec threshold: per-dispatch budget
+    light_rps, heavy_rps = 8.0, 80.0  # the 10x step
+    light_s = 3.0 if quick else 5.0
+    heavy_s = 10.0 if quick else 18.0
+
+    work = tempfile.mkdtemp(prefix="zoo-fed-bench-")
+    spool = os.path.join(work, "spool")
+    broker_spec = "dir:" + spool
+    db = connect_broker(broker_spec)
+    store = TimeSeriesStore(capacity=1024)
+    spec = SloSpec(
+        "predict_latency", "zoo_serving_predict_seconds",
+        threshold=dispatch_budget_s, objective=0.95,
+        short_window=1.5, long_window=6.0, burn_threshold=1.0,
+        description="per-dispatch latency budget (early-warning tier "
+                    "under the %.0fms sojourn SLO)" % slo_p99_ms)
+    engine = SloEngine(store, [spec])
+    scraper = VarzScraper(
+        store=store, engine=engine, interval=0.2, timeout=5.0,
+        discover=fleet_varz_targets(db))
+    srv = MetricsServer(port=0).start()  # the /alertz the bench polls
+    fed = FederatedSignalSource(store, db, "image_stream",
+                                scraper=scraper)
+    ctrl = FleetController(
+        ClusterServingHelper(
+            model_path=None, batch_size=8, batch_budget_ms=10.0,
+            lease_ms=5_000, log_dir=os.path.join(work, "logs")),
+        broker_spec,
+        scaler=SloScaler(slo_p99_ms=slo_p99_ms, min_replicas=1,
+                         max_replicas=3, up_windows=2,
+                         down_windows=10_000),
+        interval=0.4, mode="process", signal_source=fed,
+        replica_metrics=True,
+        replica_extra_args=("--synthetic-sleep-ms", str(service_ms)))
+
+    t_wall0 = time.time()
+    marks = {"alert": None, "hard_violation": None, "scale_up": None}
+    timeline = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            now = time.time()
+            cur = ctrl.current()
+            win = cur["window"]
+            # the sojourn estimate the scaler acts on, recomputed from
+            # the federated window: predict p99 + backlog drain time
+            est_ms = win["predict_p99_ms"]
+            if win["queue_depth"]:
+                est_ms = est_ms + (
+                    win["queue_depth"] / win["service_rate"] * 1e3
+                    if win["service_rate"] > 0 else float("inf"))
+            if marks["hard_violation"] is None and est_ms > slo_p99_ms:
+                marks["hard_violation"] = now
+            if marks["scale_up"] is None:
+                ups = [d for d in ctrl.decision_log()
+                       if d["action"] == "up"]
+                if ups:
+                    marks["scale_up"] = ups[0]["ts"]
+            if marks["alert"] is None:
+                try:
+                    with urllib.request.urlopen(
+                            srv.url + "/alertz", timeout=2) as r:
+                        if _json.load(r).get("firing"):
+                            marks["alert"] = now
+                except (OSError, ValueError):
+                    pass
+            timeline.append({
+                "t_s": round(now - t_wall0, 2),
+                "replicas": cur["replicas"], "hosts": cur["hosts"],
+                "est_p99_ms": (None if est_ms == float("inf")
+                               else round(est_ms, 1)),
+            })
+            time.sleep(0.1)
+
+    served = {}
+    outq = OutputQueue(broker=db)
+
+    def collector():
+        while not stop.is_set():
+            served.update(outq.dequeue())
+            time.sleep(0.01)
+
+    scraper.start()
+    ctrl.start()
+    seq = 0
+    try:
+        # wait for discovery: the scraper must see the first replica's
+        # /telemetryz before load starts (the federated view is the
+        # ONLY view the scaler has)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            hz = scraper.healthz()
+            if hz["healthy"] and hz["targets"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                "scraper never discovered a replica: %r"
+                % scraper.healthz())
+        threading.Thread(target=sampler, daemon=True).start()
+        threading.Thread(target=collector, daemon=True).start()
+        inq = InputQueue(broker=db)
+        rec = np.zeros((8,), np.float32)
+        for rate, duration in ((light_rps, light_s),
+                               (heavy_rps, heavy_s)):
+            t_phase = time.perf_counter()
+            while time.perf_counter() - t_phase < duration:
+                inq.enqueue(f"q{seq}", rec)
+                seq += 1
+                time.sleep(1.0 / rate)
+        deadline = time.time() + 240
+        while len(served) < seq and time.time() < deadline:
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        ctrl.stop()
+        scraper.stop()
+        srv.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+    cur = ctrl.current()
+    hz = scraper.healthz()
+    rel = lambda ts: None if ts is None else round(ts - t_wall0, 2)  # noqa: E731
+    alert, hard = marks["alert"], marks["hard_violation"]
+    return {
+        "service_ms_per_record": service_ms,
+        "slo_p99_ms": slo_p99_ms,
+        "dispatch_budget_ms": dispatch_budget_s * 1e3,
+        "load_step": {"light_rps": light_rps, "heavy_rps": heavy_rps,
+                      "factor": heavy_rps / light_rps},
+        "federated": cur["federated"],
+        "enqueued": seq, "served": len(served),
+        "alert_t_s": rel(alert),
+        "hard_violation_t_s": rel(hard),
+        "scale_up_t_s": rel(marks["scale_up"]),
+        "alert_before_hard_violation": (
+            alert is not None and (hard is None or alert <= hard)),
+        "scaled_up": any(d["action"] == "up"
+                         for d in ctrl.decision_log()),
+        "max_replicas_seen": max(
+            [t["replicas"] for t in timeline] + [1]),
+        "hosts_seen": sorted({t["hosts"] for t in timeline
+                              if t["hosts"] is not None}),
+        "slo_spec": spec.to_doc(),
+        "scrape_targets_final": len(hz["targets"]),
+        "decisions": [
+            {k: d.get(k) for k in ("action", "old", "new", "reason",
+                                   "est_p99_ms", "queue_depth",
+                                   "hosts", "hosts_target")}
+            for d in ctrl.decision_log()],
+        "alerts": engine.alerts(),
+        "timeline": timeline[:: 2 if quick else 1],
+    }
+
+
+def chaos_explainability_bench(quick: bool = False,
+                               keep_artifacts_in: str | None = None) \
+        -> dict:
+    import shutil
+    import tempfile
+
+    from analytics_zoo_tpu.elastic import ChaosSchedule, TrainSupervisor
+    from analytics_zoo_tpu.metrics import get_flight_recorder
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import flight_merge
+    finally:
+        sys.path.pop(0)
+
+    work = tempfile.mkdtemp(prefix="zoo-fed-chaos-")
+    flight_dir = os.path.join(work, "flight")
+    try:
+        spec = dict(ckpt_dir=os.path.join(work, "ckpt"),
+                    nb_epoch=3 if quick else 4, plan="dp", k=1,
+                    throttle_s=0.08)
+        total_steps = (256 // 32) * spec["nb_epoch"]
+        chaos = ChaosSchedule.parse(f"kill@{total_steps // 2}:w1")
+        sup = TrainSupervisor(
+            "dir:" + os.path.join(work, "spool"), spec, workers=3,
+            lease_ms=800, min_workers=1, interval=0.1, chaos=chaos,
+            worker_env={"ZOO_FLIGHT_DIR": flight_dir})
+        run_start = time.time()
+        res = sup.run(timeout_s=420)
+        if res is None:
+            raise RuntimeError(
+                "chaos run never finished; decisions=%r"
+                % sup.decision_log())
+        # the supervisor's own ring is the third process-perspective
+        # (workers dumped theirs on exit/SIGTERM; the SIGKILLed
+        # incarnation could not — its death is explained by the
+        # supervisor's chaos event instead).  Written directly so the
+        # global recorder's dump-dir/once-per-reason state is untouched.
+        os.makedirs(flight_dir, exist_ok=True)
+        sup_doc = get_flight_recorder().to_doc("bench")
+        # the process-global ring may hold elastic events from EARLIER
+        # runs in this interpreter (other benches, earlier tests) whose
+        # worker dumps are not in this run's flight_dir — they would
+        # show up as uncaused effects.  Keep only this run's events.
+        sup_doc["events"] = [e for e in sup_doc["events"]
+                             if e.get("ts", 0.0) >= run_start]
+        with open(os.path.join(
+                flight_dir, f"flight-{os.getpid()}-bench.json"),
+                "w") as f:
+            json.dump(sup_doc, f)
+
+        docs = flight_merge.load_inputs([flight_dir])
+        merged = flight_merge.merge_flight_docs(docs)
+        narrative = flight_merge.narrative_lines(merged)
+        out_trace = os.path.join(
+            keep_artifacts_in or os.path.dirname(
+                os.path.abspath(__file__)),
+            "BENCH_FED_r15_chaos_trace.json")
+        flight_merge.write_outputs(merged, out=out_trace)
+
+        elastic = [e for e in merged["timeline"]
+                   if e.get("kind") == "elastic"]
+        rejoins = [e for e in elastic if e.get("event") == "rejoin"]
+        respawns = [e for e in elastic if e.get("event") == "respawn"]
+        chaos_evs = [e for e in elastic if e.get("event") == "chaos"]
+
+        def cause_of(effect):
+            """Nearest earlier event that explains `effect` — the
+            chaos kill, a worker leave/join, or a respawn."""
+            causes = [e for e in elastic
+                      if e["t"] <= effect["t"] and e is not effect
+                      and e.get("event") in ("chaos", "leave", "join",
+                                             "respawn")]
+            return causes[-1] if causes else None
+
+        explained = [
+            {"event": e.get("event"), "t_s": round(
+                e["t"] - merged["timeline"][0]["t"], 3),
+             "generation": e.get("generation"),
+             "cause": (cause_of(e) or {}).get("event"),
+             "cause_src": (cause_of(e) or {}).get("src")}
+            for e in rejoins + respawns]
+        return {
+            "workers": 3,
+            "chaos": chaos.to_doc(),
+            "final_step": res["final_step"],
+            "flight_dumps_merged": merged["sources"],
+            "timeline_events": len(merged["timeline"]),
+            "skew": merged["skew"],
+            "skew_beyond_tolerance": [
+                s for s, v in merged["skew"].items()
+                if v["beyond_tolerance"]],
+            "generation_changes": len(rejoins),
+            "respawns": len(respawns),
+            "chaos_events_seen": len(chaos_evs),
+            "all_effects_have_causes": all(
+                r["cause"] is not None for r in explained),
+            "explained": explained,
+            "narrative_head": narrative[:40],
+            "merged_trace_artifact": out_trace,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def federated_bench(quick: bool = False,
+                    out_path: str | None = None) -> dict:
+    doc = {
+        "metric": "federated_slo_alert_lead_and_chaos_explainability",
+        "unit": "alert fires before hard SLO violation (bool)",
+        "platform": "cpu",
+        "quick": bool(quick),
+        "scaler": federated_scaler_bench(quick=quick),
+        "explainability": chaos_explainability_bench(quick=quick),
+    }
+    doc["value"] = doc["scaler"]["alert_before_hard_violation"]
+    doc["host_fingerprint"] = host_fingerprint()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_FED_r15.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _federated_main(argv):
+    # control-plane bench: subprocess replicas + elastic workers need
+    # the forced 8-device CPU mesh, same as the elastic bench
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(federated_bench(**kwargs)))
+
+
 if __name__ == "__main__":
     if "--partition" in sys.argv:
         _partition_main(sys.argv[1:])
@@ -2355,6 +2696,8 @@ if __name__ == "__main__":
         _overlap_main(sys.argv[1:])
     elif "--elastic" in sys.argv:
         _elastic_main(sys.argv[1:])
+    elif "--federated" in sys.argv:
+        _federated_main(sys.argv[1:])
     elif "--dispatch-child" in sys.argv:
         _dispatch_child_main(sys.argv[1:])
     elif "--dispatch" in sys.argv:

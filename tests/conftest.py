@@ -63,6 +63,7 @@ QUICK_FILES = {
     "test_fleet.py",  # serving fleet: claim protocol, autoscaler, kill -9
     "test_overlap.py",  # latency-hiding plane + --overlap bench guard
     "test_elastic.py",  # elastic runtime: membership, chaos, supervisor
+    "test_zoowatch.py",  # federation plane: scrape/SLO + two e2e guards
     # test_actors.py left OUT since the spawn switch: interpreter
     # startup per actor puts the file at ~5 min — nightly tier
 }

@@ -515,3 +515,35 @@ def test_chaos_acceptance_kill9_and_sigterm_unattended(tmp_path):
     for h in res["history"][1:]:
         np.testing.assert_allclose(
             h["loss"], full_losses[h["epoch"] - 1], rtol=1e-6)
+
+
+def test_chaos_supervisor_over_redis_broker(tmp_path):
+    """The chaos/supervision path over a REAL RedisBroker (the
+    cross-host deployment shape): the membership ledger, assignment
+    docs, chaos kill, respawn and the posted result all travel through
+    redis instead of a shared filesystem.  The membership tests above
+    already parametrize over redis via the ``broker`` fixture; this
+    covers the full supervisor loop.  A unique prefix isolates the run
+    on a shared server."""
+    spec = os.environ.get("ZOO_TEST_REDIS")
+    if not spec:
+        pytest.skip("set ZOO_TEST_REDIS=host:port to run redis "
+                    "supervisor/chaos tests")
+    prefix = f"t-chaos-{os.getpid()}-{int(time.time())}"
+    sup = TrainSupervisor(
+        spec, dict(ckpt_dir=str(tmp_path / "ckpt"), nb_epoch=3,
+                   plan="dp", k=1, throttle_s=0.08),
+        workers=3, prefix=prefix, lease_ms=800, min_workers=1,
+        interval=0.1, chaos=ChaosSchedule.parse("kill@10:w1"))
+    res = sup.run(timeout_s=420)
+
+    assert res is not None and res["done"] == 1, sup.decision_log()
+    steps_per_epoch = sup.spec["n"] // sup.spec["batch_size"]
+    assert res["final_step"] == steps_per_epoch * sup.spec["nb_epoch"]
+    by_action = {}
+    for d in sup.decision_log():
+        by_action.setdefault(d["action"], []).append(d)
+    assert by_action["chaos"][0]["reason"] == "kill"
+    assert len(by_action["respawn"]) >= 1
+    assert any(d["reason"] == "leave" for d in by_action["rejoin"])
+    assert any(d["reason"] == "join" for d in by_action["rejoin"])

@@ -41,11 +41,19 @@ analysis/oracle.py: `zoo_oracle_predictions_total{consumer}`,
 `zoo_oracle_measured_steps_per_sec{config}` /
 `zoo_oracle_rel_error{config}` per scored config, and
 `zoo_oracle_fit_samples` — the residual model's training-set size, 0
-while the oracle is analytic-only).  When the scraped ``/varz`` carries
-a structured decision log (``autotune`` / ``fleet`` / ``oracle``
-sections), it is additionally rendered as a table — time, knob/action,
-old → new, reason; predicted vs measured per config — above the metric
-rows.
+while the oracle is analytic-only), `zoo_scrape` (the zoowatch
+federation tier, metrics/scrape.py: `zoo_scrape_targets`,
+per-target `zoo_scrape_fetches_total` / `zoo_scrape_errors_total` /
+`zoo_scrape_staleness_seconds`, and the `zoo_scrape_fetch_seconds`
+pull-latency histogram), and `zoo_slo` (the burn-rate engine,
+metrics/slo.py: `zoo_slo_burn_rate{slo,window}` for the short/long
+alert windows, `zoo_slo_alert_active{slo}`, `zoo_slo_alerts_total`
+and `zoo_slo_evaluations_total`).  When the scraped ``/varz`` carries
+a structured decision log (``autotune`` / ``fleet`` / ``oracle`` /
+``elastic`` / ``scrape`` / ``slo`` sections), it is additionally
+rendered as a table — time, knob/action, old → new, reason; predicted
+vs measured per config; per-target scrape health; firing SLO alerts
+with their short/long burn rates — above the metric rows.
 
 Usage:
   python tools/metrics_dump.py METRICS.jsonl [--prefix zoo_serving]
@@ -54,6 +62,7 @@ Usage:
   python tools/metrics_dump.py METRICS.jsonl --prometheus   # re-render
   python tools/metrics_dump.py --url http://host:9090/varz
   python tools/metrics_dump.py --url host:9090   # /varz implied
+  python tools/metrics_dump.py --url host:9090 --watch 2   # live panel
 """
 
 import argparse
@@ -275,24 +284,89 @@ def render_elastic(doc, prefix="", out=None):
                  f"{d['reason']}")
 
 
-def main():
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("path", nargs="?", help="JSONL metrics file")
-    p.add_argument("--url", default=None,
-                   help="scrape a live /varz endpoint instead of "
-                        "reading a file (http://host:port[/varz] or "
-                        "host:port)")
-    p.add_argument("--prefix", default="",
-                   help="only metrics whose name starts with this")
-    p.add_argument("--prometheus", action="store_true",
-                   help="ignored for histograms' full buckets (JSONL "
-                        "carries summaries); prints name=value lines "
-                        "instead of the table")
-    a = p.parse_args()
+def render_scrape(doc, prefix="", out=None):
+    """Federation panel for the ``scrape`` section a live ``/varz``
+    carries when a VarzScraper ran (metrics/scrape.py): one row per
+    scraped target — health, staleness age, fetch/error counts, last
+    error.  Skipped when the snapshot has no scrape section or
+    ``--prefix`` filters it out."""
+    scrapers = doc.get("scrape")
+    if not scrapers or (prefix and not "zoo_scrape".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for s in scrapers:
+        emit("\nscrape: healthy={healthy} interval={interval}s "
+             "stale_after={stale_after}s".format(
+                 **{k: s.get(k) for k in
+                    ("healthy", "interval", "stale_after")}))
+        targets = s.get("targets", {})
+        if targets:
+            emit(f"  {'target':<12}{'ok':<6}{'age':>8}{'fetches':>9}"
+                 f"{'errors':>8}  last_error")
+            for name in sorted(targets):
+                t = targets[name]
+                age = t.get("age_seconds")
+                emit(f"  {name:<12}{str(t.get('healthy')):<6}"
+                     f"{('-' if age is None else f'{age:.1f}s'):>8}"
+                     f"{t.get('fetches', 0):>9}{t.get('errors', 0):>8}"
+                     f"  {t.get('last_error') or '-'}")
 
-    if bool(a.path) == bool(a.url):
-        p.error("exactly one of PATH or --url is required")
-    docs = fetch(a.url) if a.url else load(a.path)
+
+def render_slo(doc, prefix="", out=None):
+    """SLO/alert panel for the ``slo`` section a live ``/varz`` carries
+    when an SloEngine ran (metrics/slo.py): each engine's specs with
+    their objectives and windows, any alerts with short/long burn rates
+    (firing alerts marked ``*``), then one row per decision-log entry.
+    Skipped when the snapshot has no slo section or ``--prefix``
+    filters it out."""
+    import datetime
+
+    engines = doc.get("slo")
+    if not engines or (prefix and not "zoo_slo".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for eng in engines:
+        specs = eng.get("specs", [])
+        if specs:
+            emit(f"\nslo: {'name':<24}{'family':<34}{'objective':>10}"
+                 f"{'threshold':>11}{'windows':>12}")
+            for sp in specs:
+                win = (f"{sp.get('short_window'):g}/"
+                       f"{sp.get('long_window'):g}s")
+                emit(f"     {sp.get('name', '?'):<24}"
+                     f"{sp.get('family', '?'):<34}"
+                     f"{sp.get('objective'):>10g}"
+                     f"{sp.get('threshold'):>11g}{win:>12}")
+        alerts = eng.get("alerts", [])
+        if alerts:
+            emit(f"\n  {'alert':<25}{'burn short':>11}{'burn long':>11}"
+                 f"{'thresh':>8}  since")
+            for a in alerts:
+                mark = "*" if a.get("firing") else " "
+                since = a.get("since")
+                t = "-" if not since else \
+                    datetime.datetime.fromtimestamp(since).strftime(
+                        "%H:%M:%S")
+                emit(f"  {mark}{a.get('slo', '?'):<24}"
+                     f"{a.get('short_burn', 0):>11.2f}"
+                     f"{a.get('long_burn', 0):>11.2f}"
+                     f"{a.get('burn_threshold', 0):>8g}  {t}")
+        decisions = eng.get("decisions", [])
+        if decisions:
+            emit(f"\n  {'time':<14}{'slo':<25}{'state':<10}"
+                 f"{'burn s/l':<16}")
+            for d in decisions:
+                t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                    "%H:%M:%S.%f")[:-3]
+                burns = (f"{d.get('short_burn', 0):.2f}/"
+                         f"{d.get('long_burn', 0):.2f}")
+                emit(f"  {t:<14}{d.get('slo', '?'):<25}"
+                     f"{d.get('state', '?'):<10}{burns:<16}")
+
+
+def render(docs, a):
+    """One full render pass over a snapshot list — the body shared by
+    the one-shot path and the ``--watch`` loop."""
     first, last = docs[0], docs[-1]
     first_vals = {_key(s): s for s in first.get("samples", [])}
     dt = max(last.get("ts", 0) - first.get("ts", 0), 0.0)
@@ -334,6 +408,8 @@ def main():
     render_fleet(last, prefix=a.prefix)
     render_oracle(last, prefix=a.prefix)
     render_elastic(last, prefix=a.prefix)
+    render_scrape(last, prefix=a.prefix)
+    render_slo(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
@@ -347,6 +423,62 @@ def main():
               f"{'delta':>12}{'rate':>12}")
         for key, kind, v, delta, rate in val_rows:
             print(f"{key:<52}{kind:>9}{v:>14}{delta:>12}{rate:>12}")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", nargs="?", help="JSONL metrics file")
+    p.add_argument("--url", default=None,
+                   help="scrape a live /varz endpoint instead of "
+                        "reading a file (http://host:port[/varz] or "
+                        "host:port)")
+    p.add_argument("--prefix", default="",
+                   help="only metrics whose name starts with this")
+    p.add_argument("--prometheus", action="store_true",
+                   help="ignored for histograms' full buckets (JSONL "
+                        "carries summaries); prints name=value lines "
+                        "instead of the table")
+    p.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                   help="re-fetch and re-render every SECONDS (live "
+                        "panel; Ctrl-C to stop).  In --url mode each "
+                        "refresh keeps the previous scrape as the "
+                        "baseline, so counter deltas/rates become live")
+    a = p.parse_args()
+
+    if bool(a.path) == bool(a.url):
+        p.error("exactly one of PATH or --url is required")
+    if a.watch is not None and a.watch <= 0:
+        p.error("--watch needs a positive interval")
+    if a.watch is not None and a.prometheus:
+        p.error("--watch and --prometheus do not combine")
+
+    docs = fetch(a.url) if a.url else load(a.path)
+    if a.watch is None:
+        render(docs, a)
+        return
+
+    import time
+    prev = docs[-1]
+    try:
+        while True:
+            # clear + home, like watch(1), so the panel repaints in
+            # place; harmless when stdout is not a terminal
+            if sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            render(docs, a)
+            sys.stdout.flush()
+            time.sleep(a.watch)
+            try:
+                fresh = fetch(a.url) if a.url else load(a.path)
+            except SystemExit as e:
+                # a restarting endpoint shouldn't kill the panel
+                print(f"(refresh failed: {e})", file=sys.stderr)
+                continue
+            # live baseline: previous scrape first, newest last
+            docs = [prev, fresh[-1]] if a.url else fresh
+            prev = fresh[-1]
+    except KeyboardInterrupt:
+        pass
 
 
 if __name__ == "__main__":
