@@ -51,6 +51,8 @@ __all__ = [
     "plan_exposed_fraction", "EXPOSED_FRACTIONS",
     "predict_chip_bytes", "plan_collective_bytes", "PLAN_MEMORY_FACTORS",
     "REMAT_ACTIVATION_FACTORS", "REMAT_FLOPS_FACTORS",
+    "DTYPE_PEAK_FACTORS", "plan_dtype", "dtype_peaks",
+    "histogram_compute_dtype",
     "ResidualModel", "load_report_rows", "load_bench_rows",
     "load_tune_log_rows", "training_rows",
 ]
@@ -206,6 +208,70 @@ def normalize_features(features: Mapping) -> dict:
 EXPOSED_FRACTIONS = {"serial": 1.0, "overlap": 0.25}
 
 
+#: Per-dtype ceiling factors relative to the f32 row of a
+#: :class:`PeakTable` — the precision plane's roofline terms:
+#: ``flops`` multiplies the matmul ceiling (TPU MXUs run bf16 at ~2× the
+#: f32 rate and int8 at ~2× bf16; the CPU backend shows no such win, but
+#: the RANKING the oracle needs is the TPU one — the CPU-tier benches
+#: assert bytes/feature deltas, not throughput), ``bytes`` is the
+#: element-size ratio (what a compute-copy collective or activation
+#: weighs against its f32 twin).
+DTYPE_PEAK_FACTORS = {
+    None: {"flops": 1.0, "bytes": 1.0},
+    "f32": {"flops": 1.0, "bytes": 1.0},
+    "bf16": {"flops": 2.0, "bytes": 0.5},
+    "f16": {"flops": 2.0, "bytes": 0.5},
+    "int8": {"flops": 4.0, "bytes": 0.25},
+}
+
+
+def _dtype_factors(dtype: str | None) -> dict:
+    try:
+        return DTYPE_PEAK_FACTORS[dtype]
+    except KeyError:
+        raise ValueError(
+            f"unknown compute dtype {dtype!r}; valid: "
+            f"{', '.join(str(k) for k in DTYPE_PEAK_FACTORS)}") from None
+
+
+def plan_dtype(plan: str | None) -> str | None:
+    """Compute-dtype segment of a plan/config name (``"fsdp+bf16"`` →
+    ``"bf16"``; :func:`~analytics_zoo_tpu.parallel.plan.with_dtype`
+    naming), ``None`` when the name declares no precision variant."""
+    if plan is None:
+        return None
+    for seg in str(plan).split("+")[1:]:
+        if seg in ("bf16", "f16", "int8"):
+            return seg
+    return None
+
+
+def dtype_peaks(peaks: PeakTable, dtype: str | None) -> PeakTable:
+    """A :class:`PeakTable` with the matmul ceiling scaled for a compute
+    dtype (:data:`DTYPE_PEAK_FACTORS` — bf16 doubles the f32 rate, int8
+    doubles it again); ``None``/``"f32"`` return ``peaks`` unchanged."""
+    f = _dtype_factors(dtype)["flops"]
+    if f == 1.0:
+        return peaks
+    return dataclasses.replace(peaks, flops=peaks.flops * f,
+                               source=f"{peaks.source}+{dtype}")
+
+
+def histogram_compute_dtype(dtype_histogram: Mapping | None) -> str | None:
+    """Dominant floating compute dtype of a zoo-hlo-report/2
+    ``dtype_histogram`` — the MEASURED confirmation that a dtype policy
+    actually lowered (a bf16_mixed program's histogram shifts from f32-
+    to bf16-majority), and the dtype the roofline ceilings should use
+    when predicting from that program's features."""
+    if not dtype_histogram:
+        return None
+    floats = {k: int(v) for k, v in dtype_histogram.items()
+              if k in ("f32", "bf16", "f16") and v}
+    if not floats:
+        return None
+    return max(floats, key=lambda k: (floats[k], k))
+
+
 def plan_exposed_fraction(plan: str | None) -> float:
     """Exposed-collective fraction for a plan NAME: ``+overlap`` plans
     (bucketed grad scatter / gather prefetch) hide all but the tail
@@ -223,7 +289,9 @@ def plan_exposed_fraction(plan: str | None) -> float:
 def predict_step_seconds(features: Mapping, k: int = 1,
                          peaks: PeakTable | None = None,
                          plan: str | None = None,
-                         exposed_fraction: float | None = None) -> float:
+                         exposed_fraction: float | None = None,
+                         dtype: str | None = None,
+                         dtype_histogram: Mapping | None = None) -> float:
     """Overlap-aware roofline per-STEP wall seconds at
     ``steps_per_dispatch=k``:
     ``max(compute, memory, overlappable_collectives)
@@ -237,8 +305,20 @@ def predict_step_seconds(features: Mapping, k: int = 1,
     feature when the HLO actually contains async start/done pairs, or
     the plan name (:func:`plan_exposed_fraction` — serial plans expose
     1.0, which reproduces the pre-overlap additive model EXACTLY).  The
-    overhead term is what K amortizes."""
+    overhead term is what K amortizes.
+
+    The matmul ceiling is DTYPE-DEPENDENT (:func:`dtype_peaks`): the
+    compute dtype comes from the ``dtype`` argument, else the program's
+    measured ``dtype_histogram`` (zoo-hlo-report/2,
+    :func:`histogram_compute_dtype`), else the plan name's precision
+    segment (``"fsdp+bf16"``).  The byte features are NOT rescaled —
+    they were extracted from the lowered program, which already counts
+    its tensors at their true widths."""
     peaks = peaks if peaks is not None else resolve_peaks()
+    if dtype is None:
+        dtype = histogram_compute_dtype(dtype_histogram) \
+            or plan_dtype(plan)
+    peaks = dtype_peaks(peaks, dtype)
     f = normalize_features(features)
     compute_s = f["matmul_flops"] / max(peaks.flops, 1.0)
     memory_s = f["bytes_accessed"] / max(peaks.hbm_bytes_per_s, 1.0)
@@ -260,11 +340,15 @@ def predict_step_seconds(features: Mapping, k: int = 1,
 def predict_steps_per_sec(features: Mapping, k: int = 1,
                           peaks: PeakTable | None = None,
                           plan: str | None = None,
-                          exposed_fraction: float | None = None) -> float:
+                          exposed_fraction: float | None = None,
+                          dtype: str | None = None,
+                          dtype_histogram: Mapping | None = None) -> float:
     """Inverse of :func:`predict_step_seconds`."""
     return 1.0 / max(
         predict_step_seconds(features, k=k, peaks=peaks, plan=plan,
-                             exposed_fraction=exposed_fraction), 1e-12)
+                             exposed_fraction=exposed_fraction,
+                             dtype=dtype,
+                             dtype_histogram=dtype_histogram), 1e-12)
 
 
 # ---------------------------------------------------------------------------
@@ -325,12 +409,22 @@ def _plan_key(plan: str) -> str:
 def predict_chip_bytes(param_bytes: int, opt_bytes: int, plan: str,
                        n_shards: int, batch_bytes: int = 0,
                        activation_bytes: int = 0,
-                       remat: str | None = None) -> int:
+                       remat: str | None = None,
+                       dtype: str | None = None) -> int:
     """Predicted per-chip resident bytes under ``plan`` on an
     ``n_shards``-way mesh axis: the persistent param+opt footprint the
     sharding plan controls, plus the per-chip batch slice and — when an
     ``activation_bytes`` estimate is given — the activation residue the
-    ``remat`` policy leaves live (:data:`REMAT_ACTIVATION_FACTORS`)."""
+    ``remat`` policy leaves live (:data:`REMAT_ACTIVATION_FACTORS`).
+
+    ``dtype`` (or the plan name's precision segment) scales the
+    ACTIVATION term only: under the precision plane's accumulation
+    contract the stored params and optimizer state are f32 masters
+    whatever the compute dtype, so their footprint is dtype-independent
+    — the activations (and the transient compute copies they imply) are
+    what bf16 halves."""
+    if dtype is None:
+        dtype = plan_dtype(plan)
     try:
         pf, of = PLAN_MEMORY_FACTORS[_plan_key(plan)]
     except KeyError:
@@ -347,12 +441,21 @@ def predict_chip_bytes(param_bytes: int, opt_bytes: int, plan: str,
     n = max(int(n_shards), 1)
     pf = pf if pf is not None else 1.0 / n
     of = of if of is not None else 1.0 / n
+    af *= _dtype_factors(dtype)["bytes"]
     return int(param_bytes * pf + opt_bytes * of
                + batch_bytes / n + activation_bytes * af)
 
 
+#: the portion of a plan's collective coefficient that moves COMPUTE
+#: copies (param all-gathers, forward+backward) rather than gradients —
+#: under the f32-accumulation contract only this portion shrinks with
+#: the compute dtype; gradient reduce-scatters / all-reduces stay f32.
+_GATHER_COEFF = {"fsdp": 2.0, "zero3": 2.0}
+
+
 def plan_collective_bytes(param_bytes: int, plan: str,
-                          n_shards: int) -> int:
+                          n_shards: int,
+                          dtype: str | None = None) -> int:
     """Per-STEP interconnect bytes a plan moves for ``param_bytes`` of
     weights on an ``n_shards``-way axis (ring-collective accounting,
     2·P·(n-1)/n per all-reduce equivalent):
@@ -374,19 +477,33 @@ def plan_collective_bytes(param_bytes: int, plan: str,
 
     These coefficients exist to RANK plans (fewest collectives first at
     equal feasibility), not to predict absolute seconds; the residual
-    model absorbs the constants once outcomes accumulate."""
+    model absorbs the constants once outcomes accumulate.
+
+    ``dtype`` (or the plan name's precision segment) applies the
+    accumulation contract: the param-GATHER portion of fsdp/zero3
+    traffic (:data:`_GATHER_COEFF` — the all-gathers move compute
+    copies) scales by the dtype's element-size ratio, while the
+    gradient reduce-scatter / all-reduce portion stays f32 — so
+    ``fsdp+bf16`` predicts 2/3 of fsdp's bytes, the measurable
+    collective-bytes reduction the precision bench pins."""
+    if dtype is None:
+        dtype = plan_dtype(plan)
     n = max(int(n_shards), 1)
     if n <= 1:
         return 0
     ring = param_bytes * (n - 1) / n
     coeff = {"dp": 2.0, "zero1": 2.5, "zero2": 2.6, "fsdp": 3.0,
              "zero3": 3.1, "pipeline": 2.0, "tp": 2.0}
+    key = _plan_key(plan)
     try:
-        return int(coeff[_plan_key(plan)] * ring)
+        total = coeff[key]
     except KeyError:
         raise ValueError(
             f"unknown plan {plan!r}; valid: "
             f"{', '.join(sorted(coeff))}") from None
+    gather = _GATHER_COEFF.get(key, 0.0)
+    bytes_factor = _dtype_factors(dtype)["bytes"]
+    return int((total - gather + gather * bytes_factor) * ring)
 
 
 # ---------------------------------------------------------------------------
@@ -531,6 +648,7 @@ def load_report_rows(report_dir: str) -> list[dict]:
             "mesh_shape": doc.get("mesh_shape"),
             "compile_seconds": doc.get("compile_seconds"),
             "dtype_histogram": doc.get("dtype_histogram"),
+            "dtype_policy": doc.get("dtype_policy"),
             "ts": doc.get("ts"),
         })
     return rows

@@ -18,6 +18,14 @@ TEXT without executing it.  Two outputs per compile:
 - ``hlo-large-constant``: a non-splat constant over the size threshold
   was baked into the graph (a closed-over numpy array) — it bloats the
   executable and the persistent-cache entry, and defeats donation.
+- ``hlo-dtype-policy``: the lowered program contradicts the sharding
+  plan's DECLARED dtype policy (``ShardingPlan.dtype_rules``, carried
+  in compile meta): an f32 matmul under a bf16 compute policy means a
+  cast-down never reached that op (it runs at the f32 MXU rate), and a
+  bf16/f16 ``all_reduce``/``reduce_scatter`` breaks the
+  f32-accumulation contract (gradients must accumulate in f32).  The
+  generalization of ``hlo-f64`` from one hardcoded dtype smell to the
+  policy the plan actually declared.
 
 **Analytic cost features** (the TpuGraphs direction, arXiv:2308.13490 —
 config quality as prediction over the compiled graph; these are the
@@ -187,6 +195,7 @@ class HloReport:
     mesh_shape: dict | None = None
     steps_per_dispatch: int | None = None
     xla_flags: tuple | None = None
+    dtype_policy: str | None = None
 
     def features(self) -> dict:
         """The flat feature dict exported to metrics / JSON — the cost-
@@ -223,6 +232,7 @@ class HloReport:
             "xla_flags": list(self.xla_flags) if self.xla_flags
             else None,
             "dtype_histogram": dict(self.dtype_histogram),
+            "dtype_policy": self.dtype_policy,
         }
 
 
@@ -261,10 +271,22 @@ def _conv_flops(line: str, operands: list, result: list) -> int:
     return 2 * out.elements * max(k_elems // max(out_ch, 1), 1)
 
 
+def _policy_low_precision_roles(dtype_policy) -> set:
+    """The low-precision roles a ``<regex>=<role>,...`` policy string
+    declares — the lint's activation condition (an empty set = pure-f32
+    policy, nothing to check)."""
+    roles = set()
+    for part in str(dtype_policy or "").split(","):
+        if "=" in part:
+            roles.add(part.rsplit("=", 1)[1].strip().lower())
+    return roles & {"bf16", "f16", "int8"}
+
+
 def analyze_hlo_text(
         text: str, label: str = "module",
         constant_threshold: int = DEFAULT_CONSTANT_THRESHOLD,
-        expected_collectives=DEFAULT_EXPECTED_COLLECTIVES) -> HloReport:
+        expected_collectives=DEFAULT_EXPECTED_COLLECTIVES,
+        dtype_policy: str | None = None) -> HloReport:
     """Parse a StableHLO module's text into features + findings.
 
     Line-based: each op contributes its operand/result tensor types
@@ -272,9 +294,22 @@ def analyze_hlo_text(
     ``}) : ...`` line, or the single-type elementwise form).  The
     parser is deliberately tolerant — an unrecognised line simply
     contributes nothing.
+
+    ``dtype_policy`` (the plan's ``dtype_policy_str()`` rendering,
+    normally forwarded from compile meta) arms the ``hlo-dtype-policy``
+    lint when it declares a low-precision role: f32 matmuls and
+    bf16/f16 accumulation collectives are flagged against the declared
+    contract.  ``None``/pure-f32 policies check nothing — the
+    suppressed fixture.
     """
-    rpt = HloReport(label=label)
+    rpt = HloReport(label=label, dtype_policy=dtype_policy)
+    lp_roles = _policy_low_precision_roles(dtype_policy)
+    compute_dtypes = sorted(
+        {r for r in lp_roles if r in ("bf16", "f16")}
+        or ({"bf16"} if lp_roles else set()))
     f64_lines = 0
+    f32_matmul_lines = 0
+    lp_accum_lines = 0
     # region ops (all_reduce etc.) put their signature on the closing
     # `}) : (...) -> ...` line — remember which op is waiting for it
     pending: list[tuple[str, str]] = []  # (op, original line)
@@ -408,6 +443,38 @@ def analyze_hlo_text(
                     "argument so it is not re-serialized per executable",
                     data={"bytes": size}))
 
+        nonlocal f32_matmul_lines, lp_accum_lines
+        if lp_roles:
+            if op in ("dot_general", "dot", "convolution") and any(
+                    t.dtype == "f32" for t in operands):
+                f32_matmul_lines += 1
+                if f32_matmul_lines == 1:
+                    rpt.findings.append(Finding(
+                        rule="hlo-dtype-policy",
+                        severity=Severity.WARNING,
+                        path=label, line=lineno,
+                        message=f"f32 `{op}` under a "
+                        f"{'/'.join(compute_dtypes)} compute policy "
+                        "(first of several?) — the cast-down never "
+                        "reached this op, so it runs at the f32 MXU "
+                        "rate", data={"op": op, "dtype": "f32"}))
+            base, phase = _split_async_collective(op)
+            if base in ("all_reduce", "reduce_scatter") \
+                    and phase != "done" \
+                    and any(t.dtype in ("bf16", "f16")
+                            for t in operands + results):
+                lp_accum_lines += 1
+                if lp_accum_lines == 1:
+                    rpt.findings.append(Finding(
+                        rule="hlo-dtype-policy",
+                        severity=Severity.WARNING,
+                        path=label, line=lineno,
+                        message=f"low-precision `{op}` breaks the "
+                        "f32-accumulation contract — gradients must "
+                        "accumulate in f32 (cast up BEFORE the "
+                        "collective, not after)",
+                        data={"op": op, "base": base}))
+
         nonlocal f64_lines
         if any(t.dtype == "f64" for t in operands + results):
             f64_lines += 1
@@ -452,6 +519,20 @@ def analyze_hlo_text(
             rule="hlo-f64", severity=Severity.WARNING, path=label, line=0,
             message=f"{f64_lines} f64-typed ops total in this module",
             data={"count": f64_lines}))
+    if f32_matmul_lines > 1:
+        rpt.findings.append(Finding(
+            rule="hlo-dtype-policy", severity=Severity.WARNING,
+            path=label, line=0,
+            message=f"{f32_matmul_lines} f32 matmul ops total under a "
+            f"{'/'.join(compute_dtypes)} compute policy",
+            data={"count": f32_matmul_lines, "kind": "f32-matmul"}))
+    if lp_accum_lines > 1:
+        rpt.findings.append(Finding(
+            rule="hlo-dtype-policy", severity=Severity.WARNING,
+            path=label, line=0,
+            message=f"{lp_accum_lines} low-precision accumulation "
+            "collectives total in this module",
+            data={"count": lp_accum_lines, "kind": "lp-accum"}))
     return rpt
 
 
@@ -559,7 +640,9 @@ def lint_lowered(lowered, label: str = "module",
     ``report_dir`` defaults to ``ZOO_HLO_REPORT_DIR``; pass a path to
     force a report, or rely on the env knob.  ``meta`` carries the
     schema-v2 compile context the lowered text cannot show (``plan``,
-    ``mesh_shape``, ``steps_per_dispatch``; an optional
+    ``mesh_shape``, ``steps_per_dispatch``, ``dtype_policy`` — the
+    plan's declared precision contract, which arms the
+    ``hlo-dtype-policy`` lint; an optional
     ``expected_collectives`` widens the collective lint's allow-list
     for graphs that gather by design).  ``defer_report=True``
     skips the report write — :func:`timed_compile` uses it to lint
@@ -575,8 +658,12 @@ def lint_lowered(lowered, label: str = "module",
         # regather parameters by design) — widening the expected set
         # here beats suppressing the finding after the fact
         expected = tuple(meta["expected_collectives"])
+    # the plan's declared precision contract, stamped into compile meta
+    # by compile_step — arms the hlo-dtype-policy lint
+    dtype_policy = meta.get("dtype_policy") if meta else None
     rpt = analyze_hlo_text(text, label=label,
-                           expected_collectives=expected)
+                           expected_collectives=expected,
+                           dtype_policy=dtype_policy)
     for key in ("plan", "mesh_shape", "steps_per_dispatch",
                 "xla_flags"):
         if meta and meta.get(key) is not None:

@@ -33,6 +33,7 @@ import weakref
 from typing import Iterable, Mapping, Sequence
 
 from analytics_zoo_tpu.analysis.costmodel import (
+    DTYPE_PEAK_FACTORS,
     REMAT_FLOPS_FACTORS,
     PeakTable,
     ResidualModel,
@@ -204,6 +205,7 @@ class ConfigOracle:
                     batch_bytes: int = 0,
                     activation_bytes: int = 0,
                     remat_options: Sequence[str | None] = (None,),
+                    dtype_options: Sequence[str | None] = (None,),
                     ) -> tuple[str, dict]:
         """The sharding plan ``plan="auto"`` resolves to: among the
         (plan × remat) candidates whose predicted per-chip bytes fit
@@ -222,43 +224,62 @@ class ConfigOracle:
         passes ``(None, "full")`` and an activation estimate to sweep
         the full memory plan.  Infeasible-everywhere falls back to the
         most memory-frugal candidate (training may still OOM, but that
-        config is the only one with a chance)."""
+        config is the only one with a chance).
+
+        ``dtype_options`` adds the PRECISION dimension (dtype-dependent
+        ceilings, DTYPE_PEAK_FACTORS): a ``"bf16"`` candidate's compute
+        term shrinks by the dtype's matmul-rate factor and its
+        fsdp/zero3 gather traffic by the element-size ratio (the
+        f32-accumulation contract keeps gradient collectives f32), so
+        the oracle can trade precision for speed under an SLO or HBM
+        budget.  Defaults to f32-only — existing callers sweep exactly
+        the old space; the estimator passes ``(None, "bf16")`` when
+        ``ZOO_DTYPE_POLICY=auto``."""
         budget = int(hbm_budget) if hbm_budget else int(self.peaks.hbm_bytes)
         feats = features or {}
         base_s = 1.0 / self.predict_steps_per_sec(feats, k=1)
         candidates = []
-        for remat in remat_options:
-            for plan in plans:
-                chip = predict_chip_bytes(
-                    param_bytes, opt_bytes, plan, n_shards,
-                    batch_bytes=batch_bytes,
-                    activation_bytes=activation_bytes, remat=remat)
-                coll = plan_collective_bytes(param_bytes, plan, n_shards)
-                coll_s = coll / max(self.peaks.link_bytes_per_s, 1.0)
-                # Overlap-aware roofline: a "+overlap" candidate hides
-                # (1 - exposed) of its collective time behind compute,
-                # so only the exposed slice is additive.  Serial plans
-                # have exposed == 1.0, which reduces to the old purely
-                # additive formula bit-for-bit — the default candidate
-                # sweep (and fit(plan="auto") agreement with it) is
-                # unchanged.
-                exposed = plan_exposed_fraction(plan)
-                compute_s = base_s * REMAT_FLOPS_FACTORS[remat]
-                step_s = (max(compute_s, coll_s * (1.0 - exposed))
-                          + coll_s * exposed)
-                config = f"plan={plan}" if remat is None \
-                    else f"plan={plan}+remat_{remat}"
-                candidates.append({
-                    "plan": plan, "remat": remat, "config": config,
-                    "predicted_chip_bytes": chip,
-                    "predicted_collective_bytes_per_step": coll,
-                    "predicted_steps_per_sec": round(1.0 / step_s, 3),
-                    "fits_budget": chip <= budget})
+        for dtype in dtype_options:
+            dfact = DTYPE_PEAK_FACTORS[dtype if dtype else "f32"]
+            for remat in remat_options:
+                for plan in plans:
+                    chip = predict_chip_bytes(
+                        param_bytes, opt_bytes, plan, n_shards,
+                        batch_bytes=batch_bytes,
+                        activation_bytes=activation_bytes, remat=remat,
+                        dtype=dtype)
+                    coll = plan_collective_bytes(
+                        param_bytes, plan, n_shards, dtype=dtype)
+                    coll_s = coll / max(self.peaks.link_bytes_per_s, 1.0)
+                    # Overlap-aware roofline: a "+overlap" candidate
+                    # hides (1 - exposed) of its collective time behind
+                    # compute, so only the exposed slice is additive.
+                    # Serial plans have exposed == 1.0, which reduces to
+                    # the old purely additive formula bit-for-bit — the
+                    # default candidate sweep (and fit(plan="auto")
+                    # agreement with it) is unchanged.
+                    exposed = plan_exposed_fraction(plan)
+                    compute_s = (base_s * REMAT_FLOPS_FACTORS[remat]
+                                 / dfact["flops"])
+                    step_s = (max(compute_s, coll_s * (1.0 - exposed))
+                              + coll_s * exposed)
+                    config = f"plan={plan}" if remat is None \
+                        else f"plan={plan}+remat_{remat}"
+                    if dtype:
+                        config += f"+{dtype}"
+                    candidates.append({
+                        "plan": plan, "remat": remat, "dtype": dtype,
+                        "config": config,
+                        "predicted_chip_bytes": chip,
+                        "predicted_collective_bytes_per_step": coll,
+                        "predicted_steps_per_sec": round(1.0 / step_s, 3),
+                        "fits_budget": chip <= budget})
         feasible = [c for c in candidates if c["fits_budget"]]
         pool = feasible or sorted(
             candidates, key=lambda c: c["predicted_chip_bytes"])[:1]
         chosen = max(pool, key=lambda c: c["predicted_steps_per_sec"])
         doc = {"chosen": chosen["plan"], "chosen_remat": chosen["remat"],
+               "chosen_dtype": chosen["dtype"],
                "chosen_config": chosen["config"],
                "hbm_budget_bytes": budget,
                "n_shards": int(n_shards), "param_bytes": int(param_bytes),
@@ -292,6 +313,7 @@ class ConfigOracle:
                hbm_budget: int | None = None,
                batch_bytes: int = 0, activation_bytes: int = 0,
                remat_options: Sequence[str | None] = (None, "full"),
+               dtype_options: Sequence[str | None] = (None,),
                ) -> dict:
         """ONE full (plan, K, remat) re-pick for a NEW topology — the
         elastic supervisor's generation-change hook (ISSUE 16).
@@ -310,9 +332,10 @@ class ConfigOracle:
             param_bytes, opt_bytes, n_shards, hbm_budget=hbm_budget,
             features=feats, batch_bytes=batch_bytes,
             activation_bytes=activation_bytes,
-            remat_options=remat_options)
+            remat_options=remat_options, dtype_options=dtype_options)
         k = self.predict_k(feats, k_candidates)
         return {"plan": plan, "k": int(k), "remat": doc["chosen_remat"],
+                "dtype": doc["chosen_dtype"],
                 "config": doc["chosen_config"], "doc": doc}
 
     # ------------------------------------------------------------------

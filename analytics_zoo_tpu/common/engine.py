@@ -163,6 +163,28 @@ class ZooConfig:
                                passed as objects (fit(plan=
                                tensor_parallel(rules))), not named
                                here.
+      ZOO_DTYPE_POLICY         precision plane (parallel/plan.py
+                               dtype_rules; docs/parallelism.md
+                               "Precision plane"): "f32" (no-op
+                               default), "bf16_mixed" (bf16 compute
+                               params + f32 masters / f32 grad and
+                               collective accumulation — the canned
+                               mixed_precision() plan overlay),
+                               "int8_serving" (weights marked for the
+                               plan-aware weight-only int8 serving
+                               path), "auto" (plan="auto" sweeps dtype
+                               alongside sharding × remat against the
+                               HBM budget), or an explicit
+                               "pattern=role,..." rule string (roles
+                               f32/bf16/f16/int8/keep).  Validated
+                               EAGERLY at context init naming this
+                               var.  A plan passed with its own
+                               dtype_rules wins over this env tier.
+      ZOO_DTYPE_RESUME         "cast": resuming a checkpoint whose
+                               recorded dtype policy differs from the
+                               current plan's casts deliberately
+                               (with a warning) instead of failing
+                               loudly
       ZOO_OVERLAP_BUCKET_BYTES target gradient-bucket size (bytes) for
                                "+overlap" plans — each bucket's
                                reduce-scatter/all-reduce is issued as
@@ -367,6 +389,11 @@ class ZooConfig:
     # (or zero1 when the legacy shard_optimizer flag is set).
     # Env: ZOO_SHARDING_PLAN.
     sharding_plan: str | None = None
+    # Precision plane (parallel/plan.py dtype_rules): named dtype policy
+    # ("f32" | "bf16_mixed" | "int8_serving" | "auto") or an explicit
+    # "pattern=role,..." rule string overlaid on the resolved plan.
+    # Env: ZOO_DTYPE_POLICY.
+    dtype_policy: str | None = None
     # Hybrid ICI x DCN meshes (plan.build_mesh): which axis crosses the
     # DCN when given a bare slice count.  Env: ZOO_DCN_AXIS.
     dcn_axis: str | None = None
@@ -462,10 +489,20 @@ class ZooConfig:
             # eager validation (the resolve_int contract): a typo'd plan
             # name fails at context init naming the knob, not from the
             # first fit()
-            from analytics_zoo_tpu.parallel.plan import PLAN_NAMES
+            from analytics_zoo_tpu.parallel.plan import (
+                DTYPE_ROLES,
+                PLAN_NAMES,
+            )
 
             valid = tuple(PLAN_NAMES) + ("auto",)
             name = str(self.sharding_plan).strip().lower()
+            # precision plane: any plan also accepts a trailing dtype-
+            # role suffix ("zero1+overlap+bf16") — strip it before the
+            # name check, mirroring resolve_plan's parse order
+            for role in DTYPE_ROLES:
+                if name.endswith("+" + role):
+                    name = name[:-len("+" + role)]
+                    break
             base = name[:-len("+overlap")] \
                 if name.endswith("+overlap") else name
             overlappable = ("zero1", "zero2", "zero3", "fsdp")
@@ -475,8 +512,24 @@ class ZooConfig:
                 raise ValueError(
                     f"ZOO_SHARDING_PLAN must be one of "
                     f"{', '.join(valid)} (zero1/zero2/zero3/fsdp also "
-                    f"accept a '+overlap' suffix); "
+                    f"accept a '+overlap' suffix, and any plan a "
+                    f"trailing dtype-role suffix like '+bf16'); "
                     f"got {self.sharding_plan!r}")
+        self.dtype_policy = resolve(
+            self.dtype_policy, "ZOO_DTYPE_POLICY", None, cast=str)
+        if self.dtype_policy is not None:
+            # eager validation (the resolve_int contract): a typo'd
+            # policy fails at context init naming the knob, not from
+            # the first fit()'s plan resolution
+            from analytics_zoo_tpu.parallel.plan import resolve_dtype_rules
+
+            policy = str(self.dtype_policy).strip().lower()
+            if policy != "auto":
+                try:
+                    resolve_dtype_rules(self.dtype_policy)
+                except ValueError as e:
+                    raise ValueError(
+                        f"ZOO_DTYPE_POLICY: {e}") from None
         self.dcn_axis = resolve(
             self.dcn_axis, "ZOO_DCN_AXIS", None, cast=str)
         if self.dcn_axis is not None and not str(self.dcn_axis).strip():

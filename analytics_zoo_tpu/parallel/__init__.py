@@ -7,8 +7,10 @@ first-class mesh axis (common/engine.py axes: data/model/seq/expert/pipe):
 - :mod:`plan` — the unified partitioner: :class:`~analytics_zoo_tpu.
   parallel.plan.ShardingPlan` rule tables (regex → PartitionSpec over
   logical tree paths), canned plans (``data_parallel``/``zero1``/
-  ``zero2``/``zero3``/``fsdp``/``tensor_parallel``/``pipeline_plan``),
-  remat policy as plan rules (``with_remat``/``apply_remat``), the
+  ``zero2``/``zero3``/``fsdp``/``tensor_parallel``/``pipeline_plan``/
+  ``mixed_precision``/``int8_serving``), remat policy as plan rules
+  (``with_remat``/``apply_remat``), dtype policy as plan rules
+  (``with_dtype``/``with_dtype_policy``/``resolve_dtype_rules``), the
   hybrid ICI×DCN mesh builder, and ``compile_step`` — the ONE compile
   choke point every strategy lowers through (persistent cache + HLO
   lint + compile metering).
@@ -39,12 +41,17 @@ from analytics_zoo_tpu.parallel.plan import (  # noqa: F401
     compile_step,
     data_parallel,
     fsdp,
+    int8_serving,
     live_bytes,
+    mixed_precision,
     per_chip_bytes,
     pipeline_plan,
+    resolve_dtype_rules,
     resolve_plan,
     resolve_remat,
     tensor_parallel,
+    with_dtype,
+    with_dtype_policy,
     with_remat,
     zero1,
     zero2,

@@ -115,6 +115,32 @@ def match_partition_rules(
     return specs, unused
 
 
+def match_rule_values(rules, tree, *, default=None, skip_scalars=True):
+    """First-match rule table over leaf paths → arbitrary VALUES — the
+    generic sibling of :func:`match_partition_rules` for rule tables
+    whose right-hand side is not a PartitionSpec (a plan's
+    ``dtype_rules`` map paths to dtype-role names).
+
+    Unlike partition matching, an unmatched leaf is NOT an error: a
+    value table is an overlay (leaves without a rule get ``default``),
+    not a layout that must cover the tree.  ``skip_scalars`` keeps
+    scalar / size-1 leaves at ``default`` — a loss scale or step count
+    must never be down-cast by a catch-all rule.
+    """
+    rules = [(str(pat), val) for pat, val in rules]
+
+    def value_for(path, leaf):
+        if skip_scalars and (np.ndim(leaf) == 0 or np.size(leaf) == 1):
+            return default
+        name = leaf_path_name(path)
+        for pattern, val in rules:
+            if re.search(pattern, name):
+                return val
+        return default
+
+    return jax.tree_util.tree_map_with_path(value_for, tree)
+
+
 def tree_shardings(mesh, specs):
     """NamedSharding pytree from a PartitionSpec pytree (for device_put /
     jit in_shardings)."""

@@ -65,9 +65,12 @@ from analytics_zoo_tpu.parallel.partition import (
 __all__ = [
     "ShardingPlan", "data_parallel", "fsdp", "zero1", "zero2", "zero3",
     "tensor_parallel", "pipeline_plan", "with_remat",
+    "with_dtype", "with_dtype_policy", "mixed_precision", "int8_serving",
+    "resolve_dtype_rules", "DTYPE_ROLES", "DTYPE_POLICY_NAMES",
     "resolve_plan", "build_mesh", "compile_step", "PlannedStep",
     "apply_remat", "resolve_remat", "REMAT_POLICIES",
     "per_chip_bytes", "live_bytes", "record_mem_gauges",
+    "record_dtype_gauges",
     "serialize_specs", "deserialize_specs",
     "PLAN_NAMES", "DEFAULT_BUCKET_BYTES", "default_bucket_bytes",
     "grad_bucket_indices", "fold_world_to_mesh",
@@ -86,6 +89,30 @@ PLAN_NAMES = ("dp", "data_parallel", "none", "fsdp", "zero1", "zero2",
 #: "attn_context")``; any other string resolves as an attribute of
 #: ``jax.checkpoint_policies``
 REMAT_POLICIES = ("full", "dots", "attn")
+
+#: dtype ROLES a plan's ``dtype_rules`` may map a path to.  A role is
+#: not a raw dtype: it names the leaf's job in the precision plane.
+#: ``"f32"`` = master/accumulation precision (keep the stored f32 copy —
+#: the default for every unmatched leaf); ``"bf16"`` / ``"f16"`` =
+#: low-precision COMPUTE copy (the stored master stays f32; the step
+#: casts down on use and the f32 cast-up happens before the optimizer
+#: update, so optimizer state is bitwise-stable); ``"int8"`` =
+#: weight-only quantized serving copy (training computes in bf16, the
+#: serving replica routes through ``pipeline/inference/quantize.py``).
+DTYPE_ROLES = ("f32", "bf16", "f16", "int8")
+
+#: canned policy names ``ZOO_DTYPE_POLICY`` / :func:`resolve_dtype_rules`
+#: accept (besides a ``<regex>=<role>,...`` rule string, and ``auto``
+#: which the estimator resolves through the config oracle)
+DTYPE_POLICY_NAMES = ("f32", "bf16_mixed", "int8_serving")
+
+#: the compute dtype each role casts floating leaves to inside the step
+#: (``None`` = keep the stored dtype).  The ``"int8"`` role computes in
+#: bf16 during TRAINING — int8 is a weight-only serving transform, not
+#: a training number format.
+_ROLE_COMPUTE_DTYPES = {"f32": None, None: None,
+                        "bf16": "bfloat16", "f16": "float16",
+                        "int8": "bfloat16"}
 
 #: default gradient-overlap bucket size (bytes) when a canned plan is
 #: built with ``overlap=True`` — override per process with
@@ -242,6 +269,21 @@ class ShardingPlan:
     the plan active during tracing, so activation checkpointing is plan
     configuration, not a per-layer flag.
 
+    ``dtype_rules`` is the FOURTH rule table — the precision plane:
+    ordered ``(regex, role)`` pairs over the same logical leaf paths,
+    where the role is a :data:`DTYPE_ROLES` name.  The stored params
+    stay the MASTER copy (f32); a ``"bf16"``/``"f16"`` role makes the
+    step cast that leaf down on use (:meth:`cast_params_for_compute`),
+    and because the cast is in-graph, the vjp's cast-up hands f32
+    gradients back to the f32 masters — gradient/collective
+    accumulation and the optimizer update stay in f32 (bitwise-stable
+    optimizer state, arXiv:2004.13336's sharded-master shape).  The
+    ``"int8"`` role marks weight-only serving leaves for
+    ``pipeline/inference/quantize.py``.  Scalars and unmatched leaves
+    keep their stored dtype.  Participates in :meth:`cache_key`, so
+    the persistent compile cache and per-plan labels distinguish
+    precision variants.
+
     ``bucket_bytes`` turns on bucketed gradient overlap (the latency-
     hiding plane): inside the step, gradients are grouped into
     ~bucket-sized chunks in backward-completion order and each group's
@@ -266,6 +308,7 @@ class ShardingPlan:
     remat_rules: tuple = ()
     bucket_bytes: int | None = None
     prefetch: bool = False
+    dtype_rules: tuple = ()
 
     def __post_init__(self):
         if self.mode not in ("jit", "shard_map"):
@@ -295,6 +338,15 @@ class ShardingPlan:
                     f"attribute name, or None), got {policy!r}")
             remat.append((str(pat), policy))
         object.__setattr__(self, "remat_rules", tuple(remat))
+        dtyped = []
+        for pat, role in self.dtype_rules:
+            if role is not None and role not in DTYPE_ROLES:
+                raise ValueError(
+                    f"dtype rule {pat!r}: role must be one of "
+                    f"{DTYPE_ROLES} (or None to keep the stored dtype), "
+                    f"got {role!r}")
+            dtyped.append((str(pat), role))
+        object.__setattr__(self, "dtype_rules", tuple(dtyped))
         object.__setattr__(self, "batch_axes", tuple(self.batch_axes))
 
     # -- identity ------------------------------------------------------
@@ -303,7 +355,8 @@ class ShardingPlan:
         the same rules compile the same program."""
         return (self.name, self.param_rules, self.opt_rules,
                 self.batch_axes, self.mode, self.grad_rules,
-                self.remat_rules, self.bucket_bytes, self.prefetch)
+                self.remat_rules, self.bucket_bytes, self.prefetch,
+                self.dtype_rules)
 
     @property
     def effective_opt_rules(self) -> tuple:
@@ -355,6 +408,80 @@ class ShardingPlan:
             return P()
         lead = (None, entry) if stacked else (entry,)
         return P(*lead, *([None] * (ndim - len(lead))))
+
+    # -- precision plane ----------------------------------------------
+    def dtype_policy_str(self) -> str:
+        """Canonical ``<regex>=<role>,...`` rendering of ``dtype_rules``
+        (empty string = no policy) — the form compile meta, checkpoint
+        plan records and the hlo dtype-policy lint carry; round-trips
+        through :func:`resolve_dtype_rules`."""
+        return ",".join(
+            f"{pat}={role if role is not None else 'keep'}"
+            for pat, role in self.dtype_rules)
+
+    def dtype_roles(self, tree) -> dict:
+        """Leaf path → matched dtype role, for every non-scalar leaf a
+        rule hits (first ``re.search`` over the same
+        :func:`~analytics_zoo_tpu.parallel.partition.leaf_path_name`
+        paths the other three tables use).  Unmatched leaves are absent
+        — they keep master precision."""
+        from analytics_zoo_tpu.parallel.partition import (
+            leaf_path_name,
+        )
+
+        out = {}
+
+        def visit(path, leaf):
+            if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+                return leaf
+            name = leaf_path_name(path)
+            for pat, role in self.dtype_rules:
+                if re.search(pat, name):
+                    if role is not None:
+                        out[name] = role
+                    break
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, tree)
+        return out
+
+    def compute_cast_dtype(self):
+        """The dominant low-precision compute dtype this plan's rules
+        declare (``jnp.bfloat16`` / ``jnp.float16``), or ``None`` for a
+        pure-f32 plan — what batch inputs cast to so the matmuls lower
+        in the compute dtype, not a silent f32 upcast."""
+        for _, role in self.dtype_rules:
+            name = _ROLE_COMPUTE_DTYPES.get(role)
+            if name is not None:
+                return jax.numpy.dtype(name)
+        return None
+
+    def cast_params_for_compute(self, params):
+        """The cast-down half of the accumulation contract: a COMPUTE
+        copy of ``params`` with each floating leaf whose dtype role is
+        ``bf16``/``f16`` (or ``int8`` — weight-only serving leaves
+        train in bf16) cast to its role's compute dtype.  The argument
+        tree is untouched: it remains the f32 master copy the
+        optimizer updates.  In-graph use means the vjp inserts the
+        matching cast-up, so gradients arrive f32 at the masters and
+        collectives accumulate in f32."""
+        if not self.dtype_rules:
+            return params
+        from analytics_zoo_tpu.parallel.partition import (
+            match_rule_values,
+        )
+
+        jnp = jax.numpy
+        roles = match_rule_values(self.dtype_rules, params, default="f32")
+
+        def cast(leaf, role):
+            name = _ROLE_COMPUTE_DTYPES.get(role)
+            if name is None or not hasattr(leaf, "dtype") \
+                    or not jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf
+            return leaf.astype(jnp.dtype(name))
+
+        return jax.tree_util.tree_map(cast, params, roles)
 
     # -- placement -----------------------------------------------------
     def param_shardings(self, params, mesh):
@@ -705,6 +832,110 @@ def with_remat(plan: ShardingPlan, policy: str = "full",
         remat_rules=plan.remat_rules + ((str(pattern), policy),))
 
 
+def with_dtype(plan: ShardingPlan, role: str = "bf16",
+               pattern: str = r".*") -> ShardingPlan:
+    """A copy of ``plan`` with a dtype rule appended and the role in the
+    name — ``with_dtype(fsdp(), "bf16")`` → ``"fsdp+bf16"``, so compile
+    labels, the estimator's step cache and the cost model's
+    dtype-dependent ceilings all see the precision variant as a
+    distinct program (``_plan_key`` strips ``+`` segments, so sharding
+    lookups still resolve)."""
+    if role not in DTYPE_ROLES:
+        raise ValueError(
+            f"dtype role must be one of {DTYPE_ROLES}, got {role!r}")
+    return dataclasses.replace(
+        plan,
+        name=f"{plan.name}+{role}",
+        dtype_rules=plan.dtype_rules + ((str(pattern), role),))
+
+
+def mixed_precision(plan: ShardingPlan | str | None = None) -> ShardingPlan:
+    """The canned bf16 mixed-precision policy over any base plan:
+    bf16 compute params + f32 master copies + f32 gradient/collective
+    accumulation.  The stored params ARE the f32 masters; the step
+    casts a compute copy down on use and the in-graph vjp casts
+    gradients back up before the optimizer update, so optimizer state
+    is bitwise-stable and elastic resume reshards the f32 masters
+    bit-exact across world sizes (the master copies never leave the
+    plan's normal placement path)."""
+    return with_dtype(resolve_plan(plan), "bf16")
+
+
+def int8_serving(plan: ShardingPlan | str | None = None) -> ShardingPlan:
+    """The weight-only int8 SERVING policy: matmul-sized weights carry
+    the ``"int8"`` role, and a serving replica quantizes exactly those
+    leaves through :func:`~analytics_zoo_tpu.pipeline.inference.
+    quantize.quantize_params_for_plan` (~4× weight bytes).  Training
+    under this plan still computes in bf16 — int8 is a serving
+    transform, not a training number format."""
+    return with_dtype(resolve_plan(plan), "int8")
+
+
+def resolve_dtype_rules(value) -> tuple:
+    """``dtype_rules`` from a policy spec: ``None``/``""``/``"f32"`` →
+    no rules, ``"bf16_mixed"`` → catch-all bf16 compute,
+    ``"int8_serving"`` → catch-all int8 weight-only, a
+    ``<regex>=<role>,...`` rule string → that table (role ``keep`` /
+    ``none`` pins a path to its stored dtype, shadowing later rules),
+    or an already-built rule sequence (validated).  ``"auto"`` is
+    rejected here the way ``resolve_plan`` rejects ``plan="auto"`` —
+    the estimator resolves it through the config oracle's dtype
+    sweep."""
+    if value is None:
+        return ()
+    if isinstance(value, (tuple, list)):
+        return ShardingPlan(name="_dtype_probe",
+                            dtype_rules=tuple(value)).dtype_rules
+    name = str(value).strip()
+    low = name.lower()
+    if low in ("", "f32", "none"):
+        return ()
+    if low == "bf16_mixed":
+        return ((r".*", "bf16"),)
+    if low == "int8_serving":
+        return ((r".*", "int8"),)
+    if low == "auto":
+        raise ValueError(
+            'dtype policy "auto" is resolved by the estimator (the '
+            "config oracle sweeps f32 vs bf16 with dtype-dependent "
+            "roofline ceilings — analysis/oracle.py); pass a concrete "
+            "policy here")
+    rules = []
+    for part in name.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"dtype policy rule {part!r} must be '<regex>=<role>' "
+                f"with role in {DTYPE_ROLES} (or a policy name from "
+                f"{DTYPE_POLICY_NAMES})")
+        pat, role = part.rsplit("=", 1)
+        role = role.strip().lower()
+        if role in ("keep", "none"):
+            role = None
+        elif role not in DTYPE_ROLES:
+            raise ValueError(
+                f"dtype policy rule {part!r}: role must be one of "
+                f"{DTYPE_ROLES} (or 'keep'), got {role!r}")
+        rules.append((pat.strip(), role))
+    return tuple(rules)
+
+
+def with_dtype_policy(plan: ShardingPlan, policy) -> ShardingPlan:
+    """Apply a dtype policy spec (anything :func:`resolve_dtype_rules`
+    accepts) to ``plan`` — no-op for ``None``/``"f32"``; otherwise the
+    rules are appended and the first concrete role suffixes the name
+    (``"fsdp"`` + ``"bf16_mixed"`` → ``"fsdp+bf16"``)."""
+    rules = resolve_dtype_rules(policy)
+    if not rules:
+        return plan
+    roles = [role for _, role in rules if role is not None]
+    name = f"{plan.name}+{roles[0]}" if roles else plan.name
+    return dataclasses.replace(
+        plan, name=name, dtype_rules=plan.dtype_rules + rules)
+
+
 def tensor_parallel(rules, axis: str = MODEL_AXIS,
                     name: str = "tp") -> ShardingPlan:
     """Megatron-style TP from a user rule table over the ``model`` axis
@@ -739,27 +970,43 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
             "oracle sweeps dp/zero1/zero2/fsdp/zero3 × remat against "
             "predicted per-chip bytes vs the HBM budget — "
             "analysis/oracle.py); pass a concrete plan or name here")
+    dtype_role = None
+    for role in DTYPE_ROLES:
+        if name.endswith("+" + role):
+            dtype_role = role
+            name = name[: -len(role) - 1]
+            break
     overlap = False
     if name.endswith("+overlap"):
         overlap = True
         name = name[: -len("+overlap")]
+
+    def _dtyped(plan: ShardingPlan) -> ShardingPlan:
+        # "+f32" names the explicit master-precision variant: same
+        # rules-free plan, so it resolves to the base plan unchanged
+        if dtype_role in (None, "f32"):
+            return plan
+        return with_dtype(plan, dtype_role)
+
     if name in ("dp", "data_parallel", "none", ""):
         if overlap:
             raise ValueError(
                 "dp has no collectives to overlap; bucket_bytes applies "
                 "to zero1/zero2/zero3/fsdp")
-        return data_parallel()
+        return _dtyped(data_parallel())
     if name == "fsdp":
-        return fsdp(overlap=overlap)
+        return _dtyped(fsdp(overlap=overlap))
     if name == "zero1":
-        return zero1(overlap=overlap)
+        return _dtyped(zero1(overlap=overlap))
     if name == "zero2":
-        return zero2(overlap=overlap)
+        return _dtyped(zero2(overlap=overlap))
     if name == "zero3":
-        return zero3(overlap=overlap)
+        return _dtyped(zero3(overlap=overlap))
     raise ValueError(
         f"unknown sharding plan {value!r}; valid names: "
-        f"{', '.join(PLAN_NAMES)} (tensor_parallel(...) takes a rule "
+        f"{', '.join(PLAN_NAMES)}, optionally suffixed +overlap and/or "
+        f"a dtype role (e.g. 'fsdp+overlap', 'zero1+bf16') "
+        "(tensor_parallel(...) takes a rule "
         "table, so it is built in code, not named)")
 
 
@@ -950,6 +1197,11 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
     full_meta = {"plan": plan.name, **(meta or {})}
     if "mesh_shape" not in full_meta and mesh is not None:
         full_meta["mesh_shape"] = dict(mesh.shape)
+    if plan.dtype_rules and "dtype_policy" not in full_meta:
+        # ride the compile meta into the zoo-hlo-report/2 rows AND the
+        # hlo dtype-policy lint — the lowered program is checked against
+        # the precision the plan declared
+        full_meta["dtype_policy"] = plan.dtype_policy_str()
     return PlannedStep(jitted, label or f"{plan.name}_step", plan,
                        meta=full_meta)
 
@@ -1046,6 +1298,58 @@ def record_mem_gauges(label: str, predicted_bytes: int | None = None,
                       "|measured - predicted| / predicted chip bytes",
                       lab).labels(label=label).set(rel)
     return meas
+
+
+def record_dtype_gauges(label: str, plan: ShardingPlan, params) -> dict:
+    """Publish the ``zoo_dtype_*`` gauge family for one plan label —
+    the precision plane's observable: per-role leaf counts and COMPUTE
+    bytes (what the role's compute dtype makes the leaf weigh in the
+    step — bf16 halves, int8 quarters; role ``f32`` counts every
+    unmatched/kept leaf at its stored size).  Returns
+    ``{"roles": {role: {"leaves", "compute_bytes"}}, "master_bytes",
+    "compute_bytes"}`` so benches can pin the bytes ratio."""
+    from analytics_zoo_tpu.metrics import get_registry
+
+    role_bytes = {"f32": 4, "bf16": 2, "f16": 2, "int8": 1}
+    roles = plan.dtype_roles(params)
+    per_role: dict = {}
+    master_bytes = compute_bytes = 0
+    from analytics_zoo_tpu.parallel.partition import leaf_path_name
+
+    def visit(path, leaf):
+        nonlocal master_bytes, compute_bytes
+        if not hasattr(leaf, "dtype"):
+            return leaf
+        role = roles.get(leaf_path_name(path), "f32")
+        size = int(np.size(leaf))
+        stored = size * np.dtype(leaf.dtype).itemsize
+        comp = size * role_bytes.get(role, 4) if role != "f32" else stored
+        slot = per_role.setdefault(role,
+                                   {"leaves": 0, "compute_bytes": 0})
+        slot["leaves"] += 1
+        slot["compute_bytes"] += comp
+        master_bytes += stored
+        compute_bytes += comp
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    reg = get_registry()
+    for role, slot in per_role.items():
+        lab = ("label", "role")
+        reg.gauge("zoo_dtype_leaves",
+                  "param leaves per dtype role under a plan's "
+                  "dtype_rules", lab).labels(
+            label=label, role=role).set(slot["leaves"])
+        reg.gauge("zoo_dtype_compute_bytes",
+                  "compute-copy bytes per dtype role (master stays f32)",
+                  lab).labels(
+            label=label, role=role).set(slot["compute_bytes"])
+    reg.gauge("zoo_dtype_bytes_ratio",
+              "compute-copy bytes / master bytes for a plan label",
+              ("label",)).labels(label=label).set(
+        compute_bytes / master_bytes if master_bytes else 1.0)
+    return {"roles": per_role, "master_bytes": int(master_bytes),
+            "compute_bytes": int(compute_bytes)}
 
 
 def serialize_specs(spec_tree) -> list:

@@ -300,7 +300,8 @@ def make_zero1_train_step(model, loss_fn, optimizer, mesh=None,
 
 
 def reshard_zero1_opt_state(opt_state, params, mesh=None,
-                            n_old: int | None = None):
+                            n_old: int | None = None,
+                            dtype_policy: str | None = None):
     """Re-lay an explicit-ZeRO-1 optimizer state (the
     :func:`make_zero1_train_step` layout) for a DIFFERENT data-axis size —
     the elastic slice-down/up restart (SURVEY §5): save on ``{data: 8}``,
@@ -334,6 +335,13 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None,
     Placement goes through :meth:`ShardingPlan.place_opt_state` — the
     same rule→spec→clamp path every canned plan uses — so the explicit
     layout shares one placement code path with the GSPMD plans.
+
+    ``dtype_policy`` (a ``ShardingPlan.dtype_policy_str()`` rule string)
+    is carried onto the explicit plan's ``dtype_rules`` so the resharded
+    state's placement record keeps the precision contract it was trained
+    under — resuming it under a different policy fails loudly at the
+    estimator's resume guard instead of silently mixing master widths
+    (docs/parallelism.md "Precision plane").
     """
     import re
 
@@ -342,7 +350,7 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None,
     import numpy as np
 
     from .partition import leaf_path_name
-    from .plan import ShardingPlan
+    from .plan import ShardingPlan, resolve_dtype_rules
 
     mesh = mesh or get_zoo_context().mesh
     n_new = dict(mesh.shape)[DATA_AXIS]
@@ -390,7 +398,8 @@ def reshard_zero1_opt_state(opt_state, params, mesh=None,
         name="zero1_explicit",
         opt_rules=tuple((rf"^{re.escape(name)}$", P(DATA_AXIS))
                         for name in sorted(matched))
-        + ((r".*", P()),))
+        + ((r".*", P()),),
+        dtype_rules=resolve_dtype_rules(dtype_policy))
     return plan.place_opt_state(out, mesh)
 
 

@@ -662,7 +662,13 @@ class Estimator:
         ``resolve_plan``: the config oracle (analysis/oracle.py) picks
         among the canned plans from predicted per-chip param+opt bytes
         vs the peak table's HBM budget — see :meth:`_choose_auto_plan`.
-        The choice is cached per estimator."""
+        The choice is cached per estimator.
+
+        The config tier's dtype policy (``ZOO_DTYPE_POLICY`` /
+        ``ZooConfig.dtype_policy``) is overlaid on the result — the
+        precision plane rides whatever sharding plan was picked, unless
+        the plan already carries explicit ``dtype_rules`` (explicit
+        beats environment, the documented precedence)."""
         from analytics_zoo_tpu.parallel.plan import resolve_plan
 
         requested = override if override is not None else self.plan
@@ -674,10 +680,26 @@ class Estimator:
                 if params is None:
                     params, _ = self.model.build_params()
                 self._auto_plan = self._choose_auto_plan(params)
-            return self._auto_plan
-        return resolve_plan(
+            return self._apply_dtype_policy(self._auto_plan)
+        return self._apply_dtype_policy(resolve_plan(
             override if override is not None else self.plan,
-            self.ctx.config)
+            self.ctx.config))
+
+    def _apply_dtype_policy(self, plan):
+        """Overlay ``ZooConfig.dtype_policy`` (env ZOO_DTYPE_POLICY)
+        onto a resolved plan.  No-ops when no policy is configured,
+        when the plan already carries dtype_rules (explicit > env), or
+        for policy "auto" — that one is resolved by the oracle's dtype
+        sweep inside :meth:`_choose_auto_plan` (it needs the candidate
+        predictions, not a blanket overlay)."""
+        policy = getattr(self.ctx.config, "dtype_policy", None)
+        if not policy or plan.dtype_rules:
+            return plan
+        if str(policy).strip().lower() == "auto":
+            return plan
+        from analytics_zoo_tpu.parallel.plan import with_dtype_policy
+
+        return with_dtype_policy(plan, policy)
 
     def _choose_auto_plan(self, params):
         """Ask the config oracle to pick the memory plan: predicted
@@ -690,7 +712,11 @@ class Estimator:
         prediction doc lands in ``_auto_plan_record`` (and the plan
         record / bench artifacts)."""
         from analytics_zoo_tpu.analysis.oracle import ConfigOracle
-        from analytics_zoo_tpu.parallel.plan import resolve_plan, with_remat
+        from analytics_zoo_tpu.parallel.plan import (
+            resolve_plan,
+            with_dtype,
+            with_remat,
+        )
 
         def tree_bytes(tree):
             total = 0
@@ -705,20 +731,34 @@ class Estimator:
         param_bytes = tree_bytes(params)
         opt_bytes = tree_bytes(jax.eval_shape(self.optimizer.init, params))
         oracle = ConfigOracle.from_env()
+        # ZOO_DTYPE_POLICY=auto widens the sweep to sharding × remat ×
+        # dtype: bf16 candidates get the doubled flops ceiling, the
+        # halved activation footprint and the shrunken fsdp gather
+        # bytes (analysis/costmodel.py DTYPE_PEAK_FACTORS); f32 stays
+        # the tie-break default.
+        policy = getattr(self.ctx.config, "dtype_policy", None)
+        dtype_options = ((None, "bf16")
+                         if policy
+                         and str(policy).strip().lower() == "auto"
+                         else (None,))
         name, doc = oracle.choose_plan(
             param_bytes, opt_bytes, self.ctx.data_parallel_size,
             activation_bytes=param_bytes,
-            remat_options=(None, "full"))
+            remat_options=(None, "full"),
+            dtype_options=dtype_options)
         self._auto_plan_record = doc
         logger.info(
-            "plan=auto resolved to %r (remat=%s; per-chip %s bytes vs "
-            "%s budget, %s-way)", name, doc["chosen_remat"],
+            "plan=auto resolved to %r (remat=%s dtype=%s; per-chip %s "
+            "bytes vs %s budget, %s-way)", name, doc["chosen_remat"],
+            doc.get("chosen_dtype"),
             next(c["predicted_chip_bytes"] for c in doc["candidates"]
                  if c["config"] == doc["chosen_config"]),
             doc["hbm_budget_bytes"], doc["n_shards"])
         plan = resolve_plan(name)
         if doc["chosen_remat"]:
             plan = with_remat(plan, doc["chosen_remat"])
+        if doc.get("chosen_dtype"):
+            plan = with_dtype(plan, doc["chosen_dtype"])
         return plan
 
     def _place_opt_state(self, opt_state, plan=None):
@@ -740,6 +780,7 @@ class Estimator:
         from analytics_zoo_tpu.analysis.costmodel import predict_chip_bytes
         from analytics_zoo_tpu.parallel.plan import (
             per_chip_bytes,
+            record_dtype_gauges,
             record_mem_gauges,
         )
 
@@ -757,6 +798,10 @@ class Estimator:
             record_mem_gauges(f"train_step{tag}",
                               predicted_bytes=predicted,
                               measured_bytes=measured)
+            if plan.dtype_rules:
+                # Precision plane: per-role leaf counts and the
+                # compute-vs-master byte ratio (zoo_dtype_* family)
+                record_dtype_gauges(f"train_step{tag}", plan, params)
         except Exception as e:  # telemetry must never fail a fit
             logger.debug("zoo_mem gauges skipped: %s", e)
 
@@ -855,8 +900,17 @@ class Estimator:
                 # Params-in-compute mixed precision: master params stay f32
                 # (the differentiation variable); the cast is inside the
                 # graph so its vjp returns f32 grads.  Loss math is f32.
-                pc = cast_floats(p, compute_dtype)
-                xc = cast_floats(batch["x"], compute_dtype)
+                # A plan with dtype_rules (the precision plane —
+                # mixed_precision()) takes precedence over the context-
+                # wide compute dtype: per-leaf roles, same in-graph cast.
+                if plan.dtype_rules:
+                    pc = plan.cast_params_for_compute(p)
+                    xc = cast_floats(batch["x"],
+                                     plan.compute_cast_dtype()
+                                     or compute_dtype)
+                else:
+                    pc = cast_floats(p, compute_dtype)
+                    xc = cast_floats(batch["x"], compute_dtype)
                 preds, new_state = model.forward(
                     pc, xc, state=state, training=True, rng=rng
                 )
@@ -872,7 +926,7 @@ class Estimator:
             (l, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params)
-            if compute_dtype is not None:
+            if compute_dtype is not None or plan.dtype_rules:
                 # Keep state dtypes stable across steps (donation and the
                 # next trace both require it).
                 new_state = jax.tree_util.tree_map(
@@ -954,16 +1008,24 @@ class Estimator:
 
         model, loss_fn, metrics = self.model, self.loss, self.metrics
         compute_dtype = self.ctx.compute_dtype
+        plan = self._resolved_plan()
 
         def eval_step(params, state, batch):
             if device_transform is not None:
                 batch = device_transform(batch)
             # State stays f32: BN running stats must not be rounded to bf16
-            # (the layers upcast internally where needed).
+            # (the layers upcast internally where needed).  The precision
+            # plane casts per dtype role, same as the train step — eval
+            # must see the dtypes it trained with.
+            if plan.dtype_rules:
+                pc = plan.cast_params_for_compute(params)
+                xc = cast_floats(batch["x"],
+                                 plan.compute_cast_dtype() or compute_dtype)
+            else:
+                pc = cast_floats(params, compute_dtype)
+                xc = cast_floats(batch["x"], compute_dtype)
             preds, _ = model.forward(
-                cast_floats(params, compute_dtype),
-                cast_floats(batch["x"], compute_dtype),
-                state=state, training=False)
+                pc, xc, state=state, training=False)
             preds = cast_floats(preds, jnp.float32)
             n_valid = batch.get("n_valid")
             mask = None
@@ -985,7 +1047,7 @@ class Estimator:
 
         # through the choke point too: eval programs get the same
         # compile metering / persistent cache / HLO features as train
-        return compile_step(eval_step, self._resolved_plan(),
+        return compile_step(eval_step, plan,
                             self.ctx.mesh, label="eval_step")
 
     # ------------------------------------------------------------------
@@ -1144,6 +1206,10 @@ class Estimator:
             "param_specs": serialize_specs(param_specs),
             "opt_specs": serialize_specs(
                 plan.opt_specs(opt_state, ctx.mesh)),
+            # precision contract ("" = no dtype rules): a resume under a
+            # DIFFERENT policy fails loudly below instead of silently
+            # mixing master widths
+            "dtype_policy": plan.dtype_policy_str(),
         }
         if self._auto_plan_record is not None:
             # plan="auto": keep the oracle's per-candidate predictions
@@ -1180,6 +1246,30 @@ class Estimator:
             # resuming dp; ...) is exactly the plan's placement
             # device_put — no layout surgery.
             saved_plan = resumed.get("plan")
+            saved_policy = (saved_plan or {}).get("dtype_policy")
+            if saved_policy is not None \
+                    and saved_policy != plan.dtype_policy_str():
+                # Precision contract guard: f32 masters saved under one
+                # policy must not be silently re-interpreted under
+                # another (pre-precision-plane checkpoints carry no
+                # policy key and skip the check).  ZOO_DTYPE_RESUME=cast
+                # opts into a DELIBERATE cast-on-resume.
+                if os.environ.get("ZOO_DTYPE_RESUME", "").strip().lower() \
+                        in ("cast", "force"):
+                    logger.warning(
+                        "resuming checkpoint trained under dtype policy "
+                        "%r into plan %r with policy %r "
+                        "(ZOO_DTYPE_RESUME): casting on resume",
+                        saved_policy, plan.name, plan.dtype_policy_str())
+                else:
+                    raise ValueError(
+                        f"checkpoint was trained under dtype policy "
+                        f"{saved_policy!r} but this fit's plan "
+                        f"{plan.name!r} declares "
+                        f"{plan.dtype_policy_str()!r}; resume with a "
+                        f"matching plan (mixed_precision(), "
+                        f"ZOO_DTYPE_POLICY) or set ZOO_DTYPE_RESUME=cast "
+                        f"to cast deliberately")
             if saved_plan and (saved_plan.get("name") != plan.name
                                or saved_plan.get("mesh")
                                != dict(ctx.mesh.shape)):

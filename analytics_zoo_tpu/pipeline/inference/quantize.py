@@ -79,6 +79,57 @@ def quantize_params(params, min_size: int = 1024):
     return jax.tree_util.tree_map(q, params)
 
 
+def quantize_params_for_plan(params, plan, min_size: int = 1024):
+    """Plan-aware weight-only quantization: quantize exactly the leaves
+    whose dtype role under ``plan.dtype_rules`` is ``"int8"``.
+
+    The precision plane's serving story (docs/parallelism.md "Precision
+    plane"): ``int8_serving()`` marks weights int8 in the SAME rule
+    vocabulary the other three tables use, and this function is where
+    the role becomes bytes.  The classic structural heuristic still
+    gates each marked leaf (ndim >= 2, >= ``min_size`` elements,
+    floating) — a catch-all ``.*`` int8 rule must not quantize biases
+    or norm scales, matching :func:`quantize_params`.
+
+    A plan without dtype rules (or without any int8 role) returns the
+    tree unchanged — this is an overlay, not a requirement.
+    """
+    roles = plan.dtype_roles(params)
+    if not any(r == "int8" for r in roles.values()):
+        return params
+
+    from analytics_zoo_tpu.parallel.partition import leaf_path_name
+
+    def q(path, leaf):
+        arr = jnp.asarray(leaf)
+        if (roles.get(leaf_path_name(path)) == "int8"
+                and arr.ndim >= 2 and arr.size >= min_size
+                and jnp.issubdtype(arr.dtype, jnp.floating)):
+            return _quantize_array(arr, axis=-1)
+        return arr
+
+    return jax.tree_util.tree_map_with_path(q, params)
+
+
+def quantized_bytes_ratio(params, qparams) -> float:
+    """quantized-bytes / original-bytes over the whole tree — the
+    whitepaper's 4x model-size claim as a measured number (int8 values
+    + f32 scales vs the float original; unquantized leaves count at
+    full width on both sides)."""
+    def nbytes(leaf):
+        if isinstance(leaf, QuantizedTensor):
+            return (np.size(leaf.values) * leaf.values.dtype.itemsize
+                    + np.size(leaf.scale) * leaf.scale.dtype.itemsize)
+        a = np.asarray(leaf)
+        return a.size * a.dtype.itemsize
+
+    is_qt = lambda l: isinstance(l, QuantizedTensor)  # noqa: E731
+    orig = sum(nbytes(l) for l in jax.tree_util.tree_leaves(params))
+    quant = sum(nbytes(l) for l in
+                jax.tree_util.tree_leaves(qparams, is_leaf=is_qt))
+    return float(quant) / float(orig) if orig else 1.0
+
+
 def dequantize_params(params, dtype=jnp.float32):
     """Materialize a float pytree from a quantized one (device-side; XLA
     fuses the dequant multiply into each weight's consumer)."""

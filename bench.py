@@ -707,6 +707,194 @@ def _memory_main(argv):
 
 
 # ---------------------------------------------------------------------------
+# --precision: the precision plane (parallel/plan.py dtype_rules — the
+# FOURTH rule table).  f32 vs mixed_precision() training legs on the
+# 8-device CPU mesh: bf16 loss trajectory pinned within tolerance of
+# f32, the per-leg zoo_hlo_* features plus the zoo-hlo-report dtype
+# histogram showing the MEASURED bf16 shift, predicted-vs-measured
+# steps/sec per dtype (DTYPE_PEAK_FACTORS closing the loop), the
+# predicted fsdp param-gather collective-bytes reduction (grad
+# collectives stay f32 per the accumulation contract), and the int8
+# serving leg's bytes ratio + predict parity.  CPU has no bf16 MXU, so
+# throughput wins are RECORDED, not required — the byte/feature deltas
+# are the asserted invariants (tests/test_precision.py).  Emits
+# BENCH_PRECISION_r16.json.
+# ---------------------------------------------------------------------------
+
+
+def _precision_leg(plan, epochs, report_dir, batch_size=64):
+    """One training leg under ``plan`` (a ShardingPlan or name); returns
+    losses, steps/sec, the compile plane's zoo_hlo_* features, the
+    leg's zoo-hlo-report row (dtype histogram + declared policy) and
+    the roofline's predicted steps/sec at the leg's compute dtype."""
+    import jax
+    import numpy as np
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.analysis.costmodel import (
+        histogram_compute_dtype,
+        load_report_rows,
+        predict_steps_per_sec,
+    )
+    from analytics_zoo_tpu.metrics import get_registry, snapshot
+    from analytics_zoo_tpu.parallel.plan import resolve_plan
+
+    os.environ["ZOO_HLO_REPORT_DIR"] = report_dir
+    try:
+        zoo.init_zoo_context(seed=11, mesh_shape={"data": 8},
+                             platform="cpu")
+        plan = resolve_plan(plan)
+        x, y = _partition_data()
+        m = _partition_model()
+        t0 = time.perf_counter()
+        m.fit(x, y, batch_size=batch_size, nb_epoch=epochs, plan=plan)
+        dt = time.perf_counter() - t0
+    finally:
+        os.environ.pop("ZOO_HLO_REPORT_DIR", None)
+    est = m._estimator
+    steps = est.global_step
+    label = "train_step" if plan.name == "dp" \
+        else f"train_step_{plan.name}"
+    hlo = {}
+    for s in snapshot(get_registry())["samples"]:
+        if s["name"].startswith("zoo_hlo_") \
+                and s.get("labels", {}).get("label") == label:
+            hlo[s["name"]] = s["value"]
+    row = next((r for r in load_report_rows(report_dir)
+                if r["label"] == label), None)
+    hist = (row or {}).get("dtype_histogram") or {}
+    dtype = plan.compute_cast_dtype()
+    dtype_name = {"bfloat16": "bf16", "float16": "f16"}.get(
+        str(np.dtype(dtype)) if dtype is not None else "", None)
+    predicted = None
+    if row and row["features"]:
+        predicted = predict_steps_per_sec(
+            row["features"], k=1, plan=plan.name,
+            dtype_histogram=hist or None)
+    measured = steps / max(dt, 1e-9)
+    return {
+        "plan": plan.name,
+        "dtype": dtype_name or "f32",
+        "dtype_policy": plan.dtype_policy_str(),
+        "losses": [h["loss"] for h in est.history],
+        "steps": int(steps),
+        "steps_per_sec": round(measured, 2),
+        "predicted_steps_per_sec": (round(predicted, 2)
+                                    if predicted else None),
+        "hlo": hlo,
+        "dtype_histogram": hist,
+        "measured_compute_dtype": histogram_compute_dtype(hist),
+        "model": m,
+    }
+
+
+def precision_bench(quick: bool = False,
+                    out_path: str | None = None) -> dict:
+    """f32 vs mixed_precision() vs int8 serving; writes
+    BENCH_PRECISION_r16.json."""
+    import tempfile
+
+    import numpy as np
+
+    from analytics_zoo_tpu.analysis.costmodel import plan_collective_bytes
+    from analytics_zoo_tpu.parallel.plan import int8_serving, mixed_precision
+    from analytics_zoo_tpu.pipeline.inference.quantize import (
+        dequantize_params,
+        quantize_params_for_plan,
+        quantized_bytes_ratio,
+    )
+
+    epochs = 2 if quick else 4
+    legs = {}
+    with tempfile.TemporaryDirectory() as rd:
+        legs["f32"] = _precision_leg("dp", epochs, os.path.join(rd, "f32"))
+        legs["bf16"] = _precision_leg(mixed_precision(), epochs,
+                                      os.path.join(rd, "bf16"))
+    f32, bf16 = legs["f32"], legs["bf16"]
+    max_rel = max(
+        abs(a - b) / max(abs(a), 1e-9)
+        for a, b in zip(f32["losses"], bf16["losses"]))
+
+    # int8 serving: quantize the f32 leg's trained weights under the
+    # plan's int8 role, compare predict outputs and weight bytes
+    m = f32.pop("model")
+    bf16.pop("model")
+    x, _ = _partition_data()
+    params = m.params
+    qparams = quantize_params_for_plan(params, int8_serving())
+    base = np.asarray(m.predict(x[:64]))
+    m._estimator.model.params = dequantize_params(qparams)
+    served = np.asarray(m.predict(x[:64]))
+    m._estimator.model.params = params
+    int8_leg = {
+        "plan": "dp+int8",
+        "bytes_ratio": round(quantized_bytes_ratio(params, qparams), 4),
+        "predict_max_abs_diff": float(np.max(np.abs(base - served))),
+    }
+    legs["int8_serving"] = int8_leg
+
+    # predicted collective reduction: only the fsdp param-GATHER
+    # traffic shrinks at bf16 — grad collectives are charged f32 per
+    # the accumulation contract, so the predicted ratio is 2/3, the
+    # number a real-TPU profile should reproduce
+    pb = 4 * 1024 * 1024
+    coll_f32 = plan_collective_bytes(pb, "fsdp", 8)
+    coll_bf16 = plan_collective_bytes(pb, "fsdp", 8, dtype="bf16")
+    doc = {
+        "metric": "bf16_mixed_loss_trajectory_max_rel_diff_vs_f32",
+        "unit": "ratio (lower is better; target <= 0.05)",
+        "value": round(max_rel, 6),
+        "bf16_hlo_shift": {
+            "f32_leg_bf16_ops": int(f32["dtype_histogram"].get("bf16", 0)),
+            "bf16_leg_bf16_ops": int(
+                bf16["dtype_histogram"].get("bf16", 0)),
+            "bf16_leg_compute_dtype": bf16["measured_compute_dtype"],
+        },
+        "predicted_fsdp_collective_bytes": {
+            "f32": int(coll_f32), "bf16": int(coll_bf16),
+            "ratio": round(coll_bf16 / max(coll_f32, 1), 4),
+        },
+        "int8_serving_bytes_ratio": int8_leg["bytes_ratio"],
+        "devices": 8,
+        "platform": "cpu",
+        "quick": bool(quick),
+        "legs": legs,
+        "note": ("CPU mesh: no bf16 MXU, so steps/sec parity is "
+                 "recorded (predicted-vs-measured per dtype), not "
+                 "gated; the asserted invariants are the trajectory "
+                 "tolerance, the measured bf16 histogram shift, the "
+                 "f32 masters, and the int8 bytes/parity numbers"),
+    }
+    doc["host_fingerprint"] = host_fingerprint()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_PRECISION_r16.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _precision_main(argv):
+    # the 8-device CPU mesh: dtype layout and lowering, not FLOPs
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(precision_bench(**kwargs)))
+
+
+# ---------------------------------------------------------------------------
 # --fleet: multi-replica serving fleet bench (serving/fleet.py).  No real
 # model — the replicas serve the synthetic sleep model (per-RECORD
 # GIL-releasing service time, like device inference), so the bench
@@ -2684,6 +2872,8 @@ if __name__ == "__main__":
         _partition_main(sys.argv[1:])
     elif "--memory" in sys.argv:
         _memory_main(sys.argv[1:])
+    elif "--precision" in sys.argv:
+        _precision_main(sys.argv[1:])
     elif "--data-pipeline" in sys.argv:
         _data_pipeline_main(sys.argv[1:])
     elif "--fleet" in sys.argv:
