@@ -184,6 +184,8 @@ class HloReport:
     async_collective_count: int = 0
     overlapped_collective_bytes: int = 0
     fused_dispatch_count: int = 0
+    custom_kernel_count: int = 0
+    custom_kernel_bytes: int = 0
     collectives: dict = field(default_factory=dict)
     op_histogram: dict = field(default_factory=dict)
     findings: list = field(default_factory=list)
@@ -210,6 +212,8 @@ class HloReport:
             "overlapped_collective_bytes":
                 self.overlapped_collective_bytes,
             "fused_dispatch_count": self.fused_dispatch_count,
+            "custom_kernel_count": self.custom_kernel_count,
+            "custom_kernel_bytes": self.custom_kernel_bytes,
         }
 
     def to_doc(self) -> dict:
@@ -423,6 +427,17 @@ def analyze_hlo_text(
                             "usually means a sharding mismatch is "
                             "regathering state every dispatch",
                             data={"target": target, "base": base}))
+            elif target == "tpu_custom_call" \
+                    or "mosaic" in target.lower():
+                # a Pallas/Mosaic kernel: attribute its operand+result
+                # bytes to the label so kernel-vs-XLA A/Bs can compare
+                # measured bytes-accessed against the cost model's
+                # per-kernel prediction (bytes_accessed above already
+                # counts them; this is the per-kernel slice)
+                rpt.custom_kernel_count += 1
+                rpt.custom_kernel_bytes += \
+                    sum(t.nbytes for t in operands) + \
+                    sum(t.nbytes for t in results)
             elif re.search(r"callback|python|py_", target,
                            re.IGNORECASE):
                 rpt.findings.append(Finding(
@@ -596,6 +611,12 @@ def _emit_metrics(rpt: HloReport) -> None:
         "zoo_hlo_fused_dispatches":
             ("while loops (lax.scan / fori_loop) in the lowered module",
              rpt.fused_dispatch_count),
+        "zoo_hlo_custom_kernels":
+            ("Pallas/Mosaic custom_call kernels in the lowered module",
+             rpt.custom_kernel_count),
+        "zoo_hlo_custom_kernel_bytes":
+            ("operand+result bytes of Pallas/Mosaic custom_call "
+             "kernels in the lowered module", rpt.custom_kernel_bytes),
         "zoo_hlo_ops":
             ("total StableHLO ops in the lowered module", rpt.op_count),
         "zoo_hlo_findings":

@@ -37,6 +37,7 @@ from analytics_zoo_tpu.analysis.costmodel import (
     REMAT_FLOPS_FACTORS,
     PeakTable,
     ResidualModel,
+    choose_kernel,
     plan_collective_bytes,
     plan_exposed_fraction,
     predict_chip_bytes,
@@ -49,7 +50,8 @@ from analytics_zoo_tpu.metrics import (
     get_flight_recorder,
 )
 
-__all__ = ["ConfigOracle", "oracle_enabled", "varz_doc"]
+__all__ = ["ConfigOracle", "oracle_enabled", "varz_doc",
+           "KERNEL_STEP_FACTORS"]
 
 #: plans the oracle can choose among for ``plan="auto"``, ordered from
 #: least to most sharded so infeasible-everywhere ties break toward the
@@ -63,6 +65,19 @@ DEFAULT_PLAN_CANDIDATES = ("dp", "zero1", "zero2", "fsdp", "zero3")
 #: to the smaller K (finer checkpoint cadence), mirroring the
 #: autotuner's own k_margin settle rule
 PREDICT_MARGIN = 0.05
+
+#: Step-time factor the KERNEL dimension applies to a candidate's
+#: compute term in :meth:`ConfigOracle.choose_plan`.  On TPU the fused
+#: Pallas kernels cut the optimizer/loss HBM round trips ~2.5-3x
+#: (costmodel.kernel_bytes: fused_adam 24n vs 60n, fused_softmax_xent
+#: 4BV vs 12BV) but those scopes are a slice of the whole step, so the
+#: ranking coefficient is a modest 0.9 — it exists to ORDER "+kernels"
+#: above its plain twin on TPU, like the plan_collective_bytes
+#: coefficients, not to predict seconds.  On non-TPU peaks the factor
+#: is exactly 1.0: the kernels fall back to the same XLA program, so
+#: the tie breaks toward the plain candidate (candidate order) — the
+#: oracle DECLINING pallas on the CPU tier.
+KERNEL_STEP_FACTORS = {None: 1.0, "kernels": 0.9}
 
 
 def oracle_enabled() -> bool:
@@ -206,6 +221,7 @@ class ConfigOracle:
                     activation_bytes: int = 0,
                     remat_options: Sequence[str | None] = (None,),
                     dtype_options: Sequence[str | None] = (None,),
+                    kernel_options: Sequence[str | None] = (None,),
                     ) -> tuple[str, dict]:
         """The sharding plan ``plan="auto"`` resolves to: among the
         (plan × remat) candidates whose predicted per-chip bytes fit
@@ -234,10 +250,20 @@ class ConfigOracle:
         the oracle can trade precision for speed under an SLO or HBM
         budget.  Defaults to f32-only — existing callers sweep exactly
         the old space; the estimator passes ``(None, "bf16")`` when
-        ``ZOO_DTYPE_POLICY=auto``."""
+        ``ZOO_DTYPE_POLICY=auto``.
+
+        ``kernel_options`` adds the KERNEL dimension
+        (:data:`KERNEL_STEP_FACTORS`): a ``"kernels"`` candidate's
+        compute term scales by the fused-kernel factor ON TPU PEAKS
+        ONLY — on any other platform the factor is 1.0 and the tie
+        breaks toward the plain candidate (candidate order), so the
+        CPU tier declines pallas by construction.  Defaults to
+        no-kernels-only; the estimator passes ``(None, "kernels")``
+        under ``ZOO_USE_PALLAS=1``."""
         budget = int(hbm_budget) if hbm_budget else int(self.peaks.hbm_bytes)
         feats = features or {}
         base_s = 1.0 / self.predict_steps_per_sec(feats, k=1)
+        on_tpu = self.peaks.source.lower().startswith("tpu")
         candidates = []
         for dtype in dtype_options:
             dfact = DTYPE_PEAK_FACTORS[dtype if dtype else "f32"]
@@ -259,27 +285,36 @@ class ConfigOracle:
                     # default candidate sweep (and fit(plan="auto")
                     # agreement with it) is unchanged.
                     exposed = plan_exposed_fraction(plan)
-                    compute_s = (base_s * REMAT_FLOPS_FACTORS[remat]
-                                 / dfact["flops"])
-                    step_s = (max(compute_s, coll_s * (1.0 - exposed))
-                              + coll_s * exposed)
-                    config = f"plan={plan}" if remat is None \
-                        else f"plan={plan}+remat_{remat}"
-                    if dtype:
-                        config += f"+{dtype}"
-                    candidates.append({
-                        "plan": plan, "remat": remat, "dtype": dtype,
-                        "config": config,
-                        "predicted_chip_bytes": chip,
-                        "predicted_collective_bytes_per_step": coll,
-                        "predicted_steps_per_sec": round(1.0 / step_s, 3),
-                        "fits_budget": chip <= budget})
+                    for kern in kernel_options:
+                        kfact = (KERNEL_STEP_FACTORS[kern]
+                                 if on_tpu else 1.0)
+                        compute_s = (base_s * REMAT_FLOPS_FACTORS[remat]
+                                     / dfact["flops"] * kfact)
+                        step_s = (max(compute_s,
+                                      coll_s * (1.0 - exposed))
+                                  + coll_s * exposed)
+                        config = f"plan={plan}" if remat is None \
+                            else f"plan={plan}+remat_{remat}"
+                        if dtype:
+                            config += f"+{dtype}"
+                        if kern:
+                            config += "+kernels"
+                        candidates.append({
+                            "plan": plan, "remat": remat,
+                            "dtype": dtype, "kernels": kern,
+                            "config": config,
+                            "predicted_chip_bytes": chip,
+                            "predicted_collective_bytes_per_step": coll,
+                            "predicted_steps_per_sec":
+                                round(1.0 / step_s, 3),
+                            "fits_budget": chip <= budget})
         feasible = [c for c in candidates if c["fits_budget"]]
         pool = feasible or sorted(
             candidates, key=lambda c: c["predicted_chip_bytes"])[:1]
         chosen = max(pool, key=lambda c: c["predicted_steps_per_sec"])
         doc = {"chosen": chosen["plan"], "chosen_remat": chosen["remat"],
                "chosen_dtype": chosen["dtype"],
+               "chosen_kernels": chosen["kernels"],
                "chosen_config": chosen["config"],
                "hbm_budget_bytes": budget,
                "n_shards": int(n_shards), "param_bytes": int(param_bytes),
@@ -306,6 +341,46 @@ class ConfigOracle:
             chip_bytes=chosen["predicted_chip_bytes"],
             hbm_budget=budget, feasible=bool(feasible))
         return chosen["plan"], doc
+
+    def choose_kernels(self, kernel_sizes: Mapping[str, Mapping],
+                       platform: str | None = None) -> dict:
+        """Per-kernel kernel-vs-XLA verdicts for the kernel plane.
+
+        ``kernel_sizes`` maps kernel name → the size kwargs its byte
+        model needs (:func:`~analytics_zoo_tpu.analysis.costmodel
+        .kernel_bytes`), e.g. ``{"fused_adam": {"n": 4096}}``.
+        ``platform`` defaults to the peak table's source, so an oracle
+        built from CPU peaks declines every kernel (Pallas lowers via
+        Mosaic) and one built from TPU peaks picks by the analytic byte
+        model.  Every verdict is a logged prediction under
+        ``config="kernel=<name>"`` — the bench's measured per-variant
+        steps/sec closes the pair via :meth:`record_outcome`."""
+        platform = platform or self.peaks.source
+        verdicts = {}
+        now = time.time()
+        for name, sizes in kernel_sizes.items():
+            v = choose_kernel(name, platform=platform, peaks=self.peaks,
+                              **sizes)
+            verdicts[name] = v
+            sps = 1.0 / max(v["predicted_s"][
+                "kernel" if v["choice"] == name else "xla"], 1e-12)
+            with self._lock:
+                self._remember_locked({
+                    "ts": now, "consumer": "kernel_plane",
+                    "config": f"kernel={name}",
+                    "predicted_steps_per_sec": round(sps, 3),
+                    "chosen": v["choice"] == name,
+                    "measured_steps_per_sec": None, "rel_error": None})
+            self.metrics.predictions.labels(
+                consumer="kernel_plane").inc()
+            self.metrics.predicted_sps.labels(
+                config=f"kernel={name}").set(round(sps, 3))
+            get_flight_recorder().record(
+                "oracle", consumer="kernel_plane",
+                config=f"kernel={name}", choice=v["choice"],
+                predicted_kernel_bytes=v["predicted_bytes"]["kernel"],
+                predicted_xla_bytes=v["predicted_bytes"]["xla"])
+        return verdicts
 
     def repick(self, param_bytes: int, opt_bytes: int, n_shards: int,
                k_candidates: Sequence[int] = (1, 2, 4, 8),

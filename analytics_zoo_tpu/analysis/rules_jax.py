@@ -44,7 +44,7 @@ from analytics_zoo_tpu.analysis.findings import Finding, Severity
 
 __all__ = ["JAX_RULES", "JitSideEffectRule", "PrngReuseRule",
            "HostSyncRule", "NonDonatedCarryRule", "RawJitRule",
-           "RawRematRule"]
+           "RawRematRule", "RawPallasCallRule"]
 
 # Calls that are host side effects when traced.  Exact qualnames plus
 # the numpy.random.* / random.* families.
@@ -423,5 +423,38 @@ class RawRematRule(RawJitRule):
     _BYPASSES = "the plan's remat policy"
 
 
+# Pallas entry points.  Bare `pallas_call` covers
+# `from jax.experimental.pallas import pallas_call` imports.
+_PALLAS_NAMES = {
+    "pl.pallas_call", "pallas.pallas_call", "pallas_call",
+    "jax.experimental.pallas.pallas_call",
+}
+
+
+class RawPallasCallRule(RawJitRule):
+    """Hand-written kernels live in ``ops/pallas/`` — the kernel plane:
+    every kernel there ships a jnp fallback oracle, routes selection
+    through a plan's ``kernel_rules`` (``resolve_kernel``), and lowers
+    under a ``kernel_*`` label via the compile choke point.  A
+    ``pl.pallas_call`` anywhere else hard-codes a kernel decision at
+    the call site — no fallback contract, invisible to the fifth rule
+    table and to the oracle's kernel-vs-XLA verdicts.  Files in
+    ``ops/pallas/`` carry a ``disable-file`` pragma with this
+    justification."""
+
+    name = "raw-pallas-call"
+    severity = Severity.WARNING
+    description = ("pl.pallas_call outside ops/pallas/ — the kernel "
+                   "bypasses the kernel plane (fallback oracle, "
+                   "kernel_rules selection, kernel_* compile labels)")
+
+    _CHOKE_TAILS = ()
+    _NAMES = _PALLAS_NAMES
+    _ROUTE = ("a kernel module under ops/pallas/ (fallback oracle + "
+              "kernel_rules selection)")
+    _BYPASSES = "the kernel plane"
+
+
 JAX_RULES = (JitSideEffectRule(), PrngReuseRule(), HostSyncRule(),
-             NonDonatedCarryRule(), RawJitRule(), RawRematRule())
+             NonDonatedCarryRule(), RawJitRule(), RawRematRule(),
+             RawPallasCallRule())

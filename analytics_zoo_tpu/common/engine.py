@@ -180,6 +180,21 @@ class ZooConfig:
                                EAGERLY at context init naming this
                                var.  A plan passed with its own
                                dtype_rules wins over this env tier.
+      ZOO_USE_PALLAS           "1": kernel plane (parallel/plan.py
+                               kernel_rules; docs/performance.md
+                               "Kernel plane") — overlay the default
+                               kernel table (attention=flash,
+                               optimizer.adam=fused_adam,
+                               loss.softmax_xent=fused_softmax_xent,
+                               serving.int8_matmul=int8_matmul) on the
+                               resolved plan, adding the "+kernels"
+                               name suffix.  A plan passed with its own
+                               kernel_rules wins over this env tier.
+                               Unset: no ops/pallas kernel module is
+                               even imported and the trajectory is
+                               bit-identical (flash attention keeps its
+                               pre-existing eligibility routing either
+                               way).  Validated as a boolean eagerly.
       ZOO_DTYPE_RESUME         "cast": resuming a checkpoint whose
                                recorded dtype policy differs from the
                                current plan's casts deliberately
@@ -394,6 +409,9 @@ class ZooConfig:
     # "pattern=role,..." rule string overlaid on the resolved plan.
     # Env: ZOO_DTYPE_POLICY.
     dtype_policy: str | None = None
+    # Kernel plane (parallel/plan.py kernel_rules): overlay the default
+    # pallas kernel table on the resolved plan.  Env: ZOO_USE_PALLAS=1.
+    use_pallas: bool | None = None
     # Hybrid ICI x DCN meshes (plan.build_mesh): which axis crosses the
     # DCN when given a bare slice count.  Env: ZOO_DCN_AXIS.
     dcn_axis: str | None = None
@@ -496,6 +514,10 @@ class ZooConfig:
 
             valid = tuple(PLAN_NAMES) + ("auto",)
             name = str(self.sharding_plan).strip().lower()
+            # kernel plane: "+kernels" is appended last by with_kernels,
+            # so it strips first — mirroring resolve_plan's parse order
+            if name.endswith("+kernels"):
+                name = name[:-len("+kernels")]
             # precision plane: any plan also accepts a trailing dtype-
             # role suffix ("zero1+overlap+bf16") — strip it before the
             # name check, mirroring resolve_plan's parse order
@@ -534,18 +556,24 @@ class ZooConfig:
             self.dcn_axis, "ZOO_DCN_AXIS", None, cast=str)
         if self.dcn_axis is not None and not str(self.dcn_axis).strip():
             raise ValueError("ZOO_DCN_AXIS must be a mesh axis name")
-        def parse_bool(raw):
-            s = str(raw).strip().lower()
-            if s in ("1", "true", "yes", "on"):
-                return True
-            if s in ("", "0", "false", "no", "off"):
-                return False
-            # 'false'-alikes must never silently ENABLE a controller
-            # thread; anything unrecognized fails loudly naming the var
-            raise ValueError(
-                f"ZOO_AUTOTUNE must be a boolean "
-                f"(1/0/true/false/yes/no/on/off), got {raw!r}")
+        def bool_parser(var):
+            def parse(raw):
+                s = str(raw).strip().lower()
+                if s in ("1", "true", "yes", "on"):
+                    return True
+                if s in ("", "0", "false", "no", "off"):
+                    return False
+                # 'false'-alikes must never silently ENABLE a feature;
+                # anything unrecognized fails loudly naming the var
+                raise ValueError(
+                    f"{var} must be a boolean "
+                    f"(1/0/true/false/yes/no/on/off), got {raw!r}")
+            return parse
 
+        parse_bool = bool_parser("ZOO_AUTOTUNE")
+        self.use_pallas = bool(resolve(
+            self.use_pallas, "ZOO_USE_PALLAS", False,
+            cast=bool_parser("ZOO_USE_PALLAS")))
         self.autotune = bool(resolve(
             self.autotune, "ZOO_AUTOTUNE", False, cast=parse_bool))
         if self.autotune_ram_budget is None:

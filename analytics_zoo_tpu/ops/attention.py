@@ -74,6 +74,19 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
     """
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
+    # Kernel plane: a plan's kernel_rules override the auto heuristic —
+    # "xla" pins the dense jnp path, "flash" asks for the kernel (the
+    # eligibility/backend checks still gate it: an ineligible shape
+    # falls through to jnp rather than failing).  No active plan or no
+    # "attention" rule leaves use_flash as passed.
+    if use_flash == "auto":
+        from analytics_zoo_tpu.parallel.plan import resolve_kernel
+
+        pick = resolve_kernel("attention")
+        if pick == "xla":
+            use_flash = False
+        elif pick == "flash":
+            use_flash = True
     # Route big attention — masked, dropout, or clean — through the Pallas
     # flash kernel on TPU (O(L·D) HBM traffic); the jnp path serves small /
     # oddly-shaped cases and non-TPU backends.

@@ -1,3 +1,6 @@
+# zoolint: disable-file=raw-pallas-call -- ops/pallas/ is the one home
+# for raw pl.pallas_call; everything here ships a jnp fallback oracle and
+# lowers under a kernel_* label through the compile choke point.
 """Flash attention — Pallas TPU kernel with streaming softmax.
 
 The hot op behind TransformerLayer/BERT (reference materializes the full
@@ -1053,3 +1056,29 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                                        dropout=dropout_p > 0.0)
     return _flash_core(q, k, v, bias, q_segment_ids, kv_segment_ids, seed,
                        causal, scale, float(dropout_p), block_q, block_k)
+
+
+_STEP_FNS: dict = {}
+
+
+def flash_attention_step(q, k, v, causal=False):
+    """:func:`flash_attention` compiled through the choke point.
+
+    Eager callers (bench legs, serving paths outside a train step) get
+    the kernel-plane contract: the program lowers via ``compile_step``/
+    ``timed_compile`` under the ``kernel_flash_attention`` label, so the
+    persistent cache, ``zoo_compile_seconds`` and the HLO feature pipe
+    all see it.  ``causal`` selects a separate cached program —
+    PlannedStep keys python scalars by type only, so it must not be a
+    traced argument."""
+    from analytics_zoo_tpu.ops.pallas import kernel_step
+
+    causal = bool(causal)
+    fn = _STEP_FNS.get(causal)
+    if fn is None:
+        def fn(q, k, v, _causal=causal):
+            return flash_attention(q, k, v, causal=_causal)
+
+        _STEP_FNS[causal] = fn
+    name = "flash_attention_causal" if causal else "flash_attention"
+    return kernel_step(name, fn)(q, k, v)

@@ -67,10 +67,12 @@ __all__ = [
     "tensor_parallel", "pipeline_plan", "with_remat",
     "with_dtype", "with_dtype_policy", "mixed_precision", "int8_serving",
     "resolve_dtype_rules", "DTYPE_ROLES", "DTYPE_POLICY_NAMES",
+    "with_kernels", "resolve_kernel", "KERNEL_NAMES",
+    "DEFAULT_KERNEL_RULES",
     "resolve_plan", "build_mesh", "compile_step", "PlannedStep",
     "apply_remat", "resolve_remat", "REMAT_POLICIES",
     "per_chip_bytes", "live_bytes", "record_mem_gauges",
-    "record_dtype_gauges",
+    "record_dtype_gauges", "record_kernel_gauges",
     "serialize_specs", "deserialize_specs",
     "PLAN_NAMES", "DEFAULT_BUCKET_BYTES", "default_bucket_bytes",
     "grad_bucket_indices", "fold_world_to_mesh",
@@ -105,6 +107,25 @@ DTYPE_ROLES = ("f32", "bf16", "f16", "int8")
 #: accept (besides a ``<regex>=<role>,...`` rule string, and ``auto``
 #: which the estimator resolves through the config oracle)
 DTYPE_POLICY_NAMES = ("f32", "bf16_mixed", "int8_serving")
+
+#: kernel names a plan's ``kernel_rules`` may map a scope to.  ``"xla"``
+#: is the explicit opt-out — the scope runs whatever fusion XLA emits
+#: (every kernel's jnp fallback path); the rest name modules under
+#: ``ops/pallas/``.  Scopes are logical op names, not leaf paths:
+#: ``"attention"``, ``"optimizer.adam"``, ``"loss.softmax_xent"``,
+#: ``"serving.int8_matmul"``.
+KERNEL_NAMES = ("xla", "flash", "fused_adam", "fused_softmax_xent",
+                "int8_matmul")
+
+#: the full kernel table :func:`with_kernels` applies by default — one
+#: rule per kernel the plane ships.  ``ZOO_USE_PALLAS=1`` overlays this
+#: on the resolved plan (a plan with its OWN kernel_rules wins).
+DEFAULT_KERNEL_RULES = (
+    (r"^attention$", "flash"),
+    (r"^optimizer\.adam$", "fused_adam"),
+    (r"^loss\.softmax_xent$", "fused_softmax_xent"),
+    (r"^serving\.int8_matmul$", "int8_matmul"),
+)
 
 #: the compute dtype each role casts floating leaves to inside the step
 #: (``None`` = keep the stored dtype).  The ``"int8"`` role computes in
@@ -284,6 +305,20 @@ class ShardingPlan:
     the persistent compile cache and per-plan labels distinguish
     precision variants.
 
+    ``kernel_rules`` is the FIFTH rule table — the kernel plane:
+    ordered ``(regex, kernel)`` pairs over logical OP scopes
+    (``"attention"``, ``"optimizer.adam"``, ``"loss.softmax_xent"``,
+    ``"serving.int8_matmul"``), where the kernel is a
+    :data:`KERNEL_NAMES` entry.  Consumers ask
+    :func:`resolve_kernel` during tracing (the plan is active inside
+    ``compile_step``, like ``remat_rules``): a named kernel routes the
+    scope to its ``ops/pallas/`` module, ``"xla"`` explicitly pins the
+    jnp/XLA fallback (a table with every scope at ``"xla"`` is
+    trajectory-identical to no table), and no match leaves the
+    consumer's own heuristics in charge.  Participates in
+    :meth:`cache_key`; :func:`with_kernels` appends the default table
+    and the ``+kernels`` name suffix.
+
     ``bucket_bytes`` turns on bucketed gradient overlap (the latency-
     hiding plane): inside the step, gradients are grouped into
     ~bucket-sized chunks in backward-completion order and each group's
@@ -309,6 +344,7 @@ class ShardingPlan:
     bucket_bytes: int | None = None
     prefetch: bool = False
     dtype_rules: tuple = ()
+    kernel_rules: tuple = ()
 
     def __post_init__(self):
         if self.mode not in ("jit", "shard_map"):
@@ -347,6 +383,15 @@ class ShardingPlan:
                     f"got {role!r}")
             dtyped.append((str(pat), role))
         object.__setattr__(self, "dtype_rules", tuple(dtyped))
+        kerneled = []
+        for pat, kernel in self.kernel_rules:
+            if kernel is not None and kernel not in KERNEL_NAMES:
+                raise ValueError(
+                    f"kernel rule {pat!r}: kernel must be one of "
+                    f"{KERNEL_NAMES} (or None to defer to later rules), "
+                    f"got {kernel!r}")
+            kerneled.append((str(pat), kernel))
+        object.__setattr__(self, "kernel_rules", tuple(kerneled))
         object.__setattr__(self, "batch_axes", tuple(self.batch_axes))
 
     # -- identity ------------------------------------------------------
@@ -356,7 +401,7 @@ class ShardingPlan:
         return (self.name, self.param_rules, self.opt_rules,
                 self.batch_axes, self.mode, self.grad_rules,
                 self.remat_rules, self.bucket_bytes, self.prefetch,
-                self.dtype_rules)
+                self.dtype_rules, self.kernel_rules)
 
     @property
     def effective_opt_rules(self) -> tuple:
@@ -444,6 +489,26 @@ class ShardingPlan:
 
         jax.tree_util.tree_map_with_path(visit, tree)
         return out
+
+    # -- kernel plane --------------------------------------------------
+    def kernel_policy_str(self) -> str:
+        """Canonical ``<regex>=<kernel>,...`` rendering of
+        ``kernel_rules`` (empty string = no table) — the form compile
+        meta and checkpoint plan records carry."""
+        return ",".join(
+            f"{pat}={kernel if kernel is not None else 'defer'}"
+            for pat, kernel in self.kernel_rules)
+
+    def kernel_for(self, scope: str, default: str | None = None):
+        """Kernel name for a logical op scope (``"attention"``,
+        ``"optimizer.adam"``, ...): first ``kernel_rules``
+        ``re.search`` match wins; ``"xla"`` is the explicit fallback
+        pick, no match returns ``default``."""
+        for pat, kernel in self.kernel_rules:
+            if re.search(pat, scope):
+                if kernel is not None:
+                    return kernel
+        return default
 
     def compute_cast_dtype(self):
         """The dominant low-precision compute dtype this plan's rules
@@ -671,6 +736,25 @@ def resolve_remat(path: str, default: str | None = None) -> str | None:
     return default
 
 
+def resolve_kernel(scope: str, default: str | None = None) -> str | None:
+    """Kernel pick for a logical op scope under the plan currently
+    being compiled (the kernel-plane twin of :func:`resolve_remat`):
+    first ``kernel_rules`` match on the innermost active plan wins.
+    ``"xla"`` is an explicit pick — the consumer must take its jnp/XLA
+    fallback path; no active plan or no match returns ``default``
+    (``None`` = the consumer's own routing heuristics apply, e.g.
+    flash's eligibility check).  Consumers: ``ops/attention.py``
+    (``"attention"``), the estimator's optimizer swap
+    (``"optimizer.adam"``), ``objectives.py``
+    (``"loss.softmax_xent"``), ``pipeline/inference/quantize.py``
+    (``"serving.int8_matmul"``)."""
+    for plan in reversed(_ACTIVE_PLANS):
+        kernel = plan.kernel_for(scope)
+        if kernel is not None:
+            return kernel
+    return default
+
+
 def apply_remat(fn, policy: str | None, *, static_argnums=()):
     """Wrap ``fn`` in ``jax.checkpoint`` under a named policy — the one
     remat site every layer and pipeline schedule routes through.
@@ -849,6 +933,27 @@ def with_dtype(plan: ShardingPlan, role: str = "bf16",
         dtype_rules=plan.dtype_rules + ((str(pattern), role),))
 
 
+def with_kernels(plan: ShardingPlan | str | None = None,
+                 rules=DEFAULT_KERNEL_RULES) -> ShardingPlan:
+    """A copy of ``plan`` with a ``kernel_rules`` table appended and
+    ``+kernels`` suffixed to the name — the kernel-plane twin of
+    :func:`with_dtype`.  Compile labels, the estimator's step cache and
+    the persistent compile cache all see the kernel variant as a
+    distinct program (:meth:`ShardingPlan.cache_key` includes the
+    table); ``resolve_plan`` strips the suffix, so checkpoint plan
+    records round-trip.  Default rules route every op the plane ships a
+    kernel for (:data:`DEFAULT_KERNEL_RULES`); pass an explicit table
+    to pick per scope (``(("optimizer.adam", "xla"),)`` forces the
+    optax chain)."""
+    plan = resolve_plan(plan)
+    frozen = ShardingPlan(name="_kernel_probe",
+                          kernel_rules=tuple(rules)).kernel_rules
+    name = plan.name if plan.name.endswith("+kernels") \
+        else f"{plan.name}+kernels"
+    return dataclasses.replace(
+        plan, name=name, kernel_rules=plan.kernel_rules + frozen)
+
+
 def mixed_precision(plan: ShardingPlan | str | None = None) -> ShardingPlan:
     """The canned bf16 mixed-precision policy over any base plan:
     bf16 compute params + f32 master copies + f32 gradient/collective
@@ -970,6 +1075,12 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
             "oracle sweeps dp/zero1/zero2/fsdp/zero3 × remat against "
             "predicted per-chip bytes vs the HBM budget — "
             "analysis/oracle.py); pass a concrete plan or name here")
+    # +kernels is appended LAST by with_kernels, so it strips first —
+    # then the dtype role, then +overlap (mirrors construction order)
+    kernels = False
+    if name.endswith("+kernels"):
+        kernels = True
+        name = name[: -len("+kernels")]
     dtype_role = None
     for role in DTYPE_ROLES:
         if name.endswith("+" + role):
@@ -985,8 +1096,10 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
         # "+f32" names the explicit master-precision variant: same
         # rules-free plan, so it resolves to the base plan unchanged
         if dtype_role in (None, "f32"):
-            return plan
-        return with_dtype(plan, dtype_role)
+            plan = plan
+        else:
+            plan = with_dtype(plan, dtype_role)
+        return with_kernels(plan) if kernels else plan
 
     if name in ("dp", "data_parallel", "none", ""):
         if overlap:
@@ -1004,8 +1117,9 @@ def resolve_plan(value=None, config=None) -> ShardingPlan:
         return _dtyped(zero3(overlap=overlap))
     raise ValueError(
         f"unknown sharding plan {value!r}; valid names: "
-        f"{', '.join(PLAN_NAMES)}, optionally suffixed +overlap and/or "
-        f"a dtype role (e.g. 'fsdp+overlap', 'zero1+bf16') "
+        f"{', '.join(PLAN_NAMES)}, optionally suffixed +overlap, "
+        f"a dtype role and/or +kernels (e.g. 'fsdp+overlap', "
+        f"'zero1+bf16', 'dp+kernels') "
         "(tensor_parallel(...) takes a rule "
         "table, so it is built in code, not named)")
 
@@ -1184,10 +1298,11 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
             mesh = get_zoo_context().mesh
         step_fn = jax.shard_map(step_fn, mesh=mesh, in_specs=in_specs,
                                 out_specs=out_specs, check_vma=check_vma)
-    if plan.remat_rules:
-        # enter the plan for the duration of TRACING, so resolve_remat
-        # inside any layer sees this plan's remat_rules (tracing happens
-        # under the jit call below, inside this wrapper's with-block)
+    if plan.remat_rules or plan.kernel_rules:
+        # enter the plan for the duration of TRACING, so resolve_remat /
+        # resolve_kernel inside any layer sees this plan's rule tables
+        # (tracing happens under the jit call below, inside this
+        # wrapper's with-block)
         inner = step_fn
 
         def step_fn(*args):
@@ -1202,6 +1317,8 @@ def compile_step(step_fn, plan: ShardingPlan | None = None, mesh=None, *,
         # hlo dtype-policy lint — the lowered program is checked against
         # the precision the plan declared
         full_meta["dtype_policy"] = plan.dtype_policy_str()
+    if plan.kernel_rules and "kernel_policy" not in full_meta:
+        full_meta["kernel_policy"] = plan.kernel_policy_str()
     return PlannedStep(jitted, label or f"{plan.name}_step", plan,
                        meta=full_meta)
 
@@ -1350,6 +1467,49 @@ def record_dtype_gauges(label: str, plan: ShardingPlan, params) -> dict:
         compute_bytes / master_bytes if master_bytes else 1.0)
     return {"roles": per_role, "master_bytes": int(master_bytes),
             "compute_bytes": int(compute_bytes)}
+
+
+#: the logical op scopes the kernel plane routes (consumers listed in
+#: :func:`resolve_kernel`) — what record_kernel_gauges resolves a plan's
+#: table against
+KERNEL_SCOPES = ("attention", "optimizer.adam", "loss.softmax_xent",
+                 "serving.int8_matmul")
+
+
+def record_kernel_gauges(label: str, plan: ShardingPlan) -> dict:
+    """Publish the ``zoo_kernel_*`` selection/routing gauges for one
+    plan label — the kernel plane's observable (the twin of
+    :func:`record_dtype_gauges` for the fifth rule table):
+    ``zoo_kernel_selections{label, scope, kernel}`` is what the plan's
+    ``kernel_rules`` resolve to per known scope (kernel ``"xla"``
+    included — a declined kernel is a decision, not an absence), and
+    ``zoo_kernel_invocations{kernel, backend}`` re-exports each kernel
+    module's pallas/fallback routing counters.  Returns
+    ``{"selections": {scope: kernel}, "invocations": {...}}``."""
+    from analytics_zoo_tpu.metrics import get_registry
+    from analytics_zoo_tpu.ops.pallas import kernel_invocation_counts
+
+    reg = get_registry()
+    selections = {}
+    for scope in KERNEL_SCOPES:
+        kernel = plan.kernel_for(scope)
+        if kernel is None:
+            continue
+        selections[scope] = kernel
+        reg.gauge("zoo_kernel_selections",
+                  "kernel a plan's kernel_rules resolve for an op scope "
+                  "(1 = selected; 'xla' is the explicit fallback pick)",
+                  ("label", "scope", "kernel")).labels(
+            label=label, scope=scope, kernel=kernel).set(1)
+    invocations = kernel_invocation_counts()
+    for kernel, counts in invocations.items():
+        for backend, n in counts.items():
+            reg.gauge("zoo_kernel_invocations",
+                      "per-kernel routing counter: compiles that took "
+                      "the pallas path vs the jnp fallback",
+                      ("kernel", "backend")).labels(
+                kernel=kernel, backend=backend).set(n)
+    return {"selections": selections, "invocations": invocations}
 
 
 def serialize_specs(spec_tree) -> list:

@@ -134,13 +134,28 @@ def sparse_categorical_crossentropy(y_true, y_pred):
 
 
 def sparse_categorical_crossentropy_from_logits(y_true, logits):
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == logits.ndim:
+        labels = labels.squeeze(-1)
+    if logits.ndim == 2 and labels.ndim == 1:
+        # Kernel plane: a plan routing loss.softmax_xent to the fused
+        # pallas kernel computes lse - logits[label] without ever
+        # materializing the (B, V) log-prob tensor in HBM — numerically
+        # the same f32 quantity as the log_softmax path below.  Only
+        # the plain (B, V) + (B,) shape routes; anything else (and any
+        # plan picking "xla" or carrying no table) takes the XLA path.
+        from analytics_zoo_tpu.parallel.plan import resolve_kernel
+
+        if resolve_kernel("loss.softmax_xent") == "fused_softmax_xent":
+            from analytics_zoo_tpu.ops.pallas.fused_softmax_xent import (
+                softmax_xent,
+            )
+
+            return softmax_xent(logits, labels)
     # f32 softmax-CE regardless of compute dtype: a bf16 log-softmax over
     # a 32k-vocab axis loses the tail of the normalizer; the upcast fuses
     # into the reduction while the lm-head matmul stays bf16
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    labels = y_true.astype(jnp.int32)
-    if labels.ndim == logp.ndim:
-        labels = labels.squeeze(-1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     if picked.ndim > 1:
         picked = picked.reshape(picked.shape[0], -1).mean(axis=-1)

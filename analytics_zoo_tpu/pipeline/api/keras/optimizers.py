@@ -129,6 +129,12 @@ class Adam(Optimizer):
         tx = optax.adam(_scheduled(lr, sched), b1=beta_1, b2=beta_2,
                         eps=epsilon)
         super().__init__(tx, "adam", lr, sched)
+        # the exact optax.adam arguments, so the kernel plane
+        # (ops/pallas/fused_adam.py) can rebuild a transform whose inner
+        # chain — and therefore state structure and fallback trajectory —
+        # is identical to self._tx
+        self.hyperparams = {"learning_rate": _scheduled(lr, sched),
+                            "b1": beta_1, "b2": beta_2, "eps": epsilon}
 
 
 class AdamWeightDecay(Optimizer):

@@ -680,10 +680,12 @@ class Estimator:
                 if params is None:
                     params, _ = self.model.build_params()
                 self._auto_plan = self._choose_auto_plan(params)
-            return self._apply_dtype_policy(self._auto_plan)
-        return self._apply_dtype_policy(resolve_plan(
-            override if override is not None else self.plan,
-            self.ctx.config))
+            return self._apply_kernel_policy(
+                self._apply_dtype_policy(self._auto_plan))
+        return self._apply_kernel_policy(self._apply_dtype_policy(
+            resolve_plan(
+                override if override is not None else self.plan,
+                self.ctx.config)))
 
     def _apply_dtype_policy(self, plan):
         """Overlay ``ZooConfig.dtype_policy`` (env ZOO_DTYPE_POLICY)
@@ -700,6 +702,19 @@ class Estimator:
         from analytics_zoo_tpu.parallel.plan import with_dtype_policy
 
         return with_dtype_policy(plan, policy)
+
+    def _apply_kernel_policy(self, plan):
+        """Overlay the default kernel table (env ZOO_USE_PALLAS /
+        ``ZooConfig.use_pallas``) onto a resolved plan — the kernel
+        plane's env tier, same precedence contract as
+        :meth:`_apply_dtype_policy`: no-op when the knob is off or the
+        plan already carries kernel_rules (explicit > env)."""
+        if not getattr(self.ctx.config, "use_pallas", False) \
+                or plan.kernel_rules:
+            return plan
+        from analytics_zoo_tpu.parallel.plan import with_kernels
+
+        return with_kernels(plan)
 
     def _choose_auto_plan(self, params):
         """Ask the config oracle to pick the memory plan: predicted
@@ -741,16 +756,26 @@ class Estimator:
                          if policy
                          and str(policy).strip().lower() == "auto"
                          else (None,))
+        # ZOO_USE_PALLAS=1 widens the sweep with the kernel dimension:
+        # "+kernels" candidates get the fused-kernel compute factor on
+        # TPU peaks and tie-break AGAINST kernels everywhere else, so
+        # the CPU tier's auto plan declines pallas while recording the
+        # declined candidate in the prediction log.
+        kernel_options = ((None, "kernels")
+                          if getattr(self.ctx.config, "use_pallas", False)
+                          else (None,))
         name, doc = oracle.choose_plan(
             param_bytes, opt_bytes, self.ctx.data_parallel_size,
             activation_bytes=param_bytes,
             remat_options=(None, "full"),
-            dtype_options=dtype_options)
+            dtype_options=dtype_options,
+            kernel_options=kernel_options)
         self._auto_plan_record = doc
         logger.info(
-            "plan=auto resolved to %r (remat=%s dtype=%s; per-chip %s "
-            "bytes vs %s budget, %s-way)", name, doc["chosen_remat"],
-            doc.get("chosen_dtype"),
+            "plan=auto resolved to %r (remat=%s dtype=%s kernels=%s; "
+            "per-chip %s bytes vs %s budget, %s-way)", name,
+            doc["chosen_remat"], doc.get("chosen_dtype"),
+            doc.get("chosen_kernels"),
             next(c["predicted_chip_bytes"] for c in doc["candidates"]
                  if c["config"] == doc["chosen_config"]),
             doc["hbm_budget_bytes"], doc["n_shards"])
@@ -759,6 +784,10 @@ class Estimator:
             plan = with_remat(plan, doc["chosen_remat"])
         if doc.get("chosen_dtype"):
             plan = with_dtype(plan, doc["chosen_dtype"])
+        if doc.get("chosen_kernels"):
+            from analytics_zoo_tpu.parallel.plan import with_kernels
+
+            plan = with_kernels(plan)
         return plan
 
     def _place_opt_state(self, opt_state, plan=None):
@@ -781,6 +810,7 @@ class Estimator:
         from analytics_zoo_tpu.parallel.plan import (
             per_chip_bytes,
             record_dtype_gauges,
+            record_kernel_gauges,
             record_mem_gauges,
         )
 
@@ -802,6 +832,10 @@ class Estimator:
                 # Precision plane: per-role leaf counts and the
                 # compute-vs-master byte ratio (zoo_dtype_* family)
                 record_dtype_gauges(f"train_step{tag}", plan, params)
+            if plan.kernel_rules:
+                # Kernel plane: per-scope kernel selections and the
+                # pallas/fallback routing counters (zoo_kernel_* family)
+                record_kernel_gauges(f"train_step{tag}", plan)
         except Exception as e:  # telemetry must never fail a fit
             logger.debug("zoo_mem gauges skipped: %s", e)
 
@@ -870,6 +904,19 @@ class Estimator:
         mesh = self.ctx.mesh
         model, loss_fn = self.model, self.loss
         opt, grad_clip = self.optimizer, self.grad_clip
+        # Kernel plane: a plan routing optimizer.adam to the fused
+        # pallas kernel swaps the transform here — fused_adam's inner
+        # chain is built from the SAME optax.adam arguments, so init()
+        # state structure, checkpoints and the fallback trajectory are
+        # identical; only the TPU lowering changes.  "xla" (or no rule)
+        # leaves the original optimizer untouched.
+        if plan.kernel_rules \
+                and getattr(opt, "name", None) == "adam" \
+                and hasattr(opt, "hyperparams") \
+                and plan.kernel_for("optimizer.adam") == "fused_adam":
+            from analytics_zoo_tpu.ops.pallas.fused_adam import fused_adam
+
+            opt = fused_adam(**opt.hyperparams)
         compute_dtype = self.ctx.compute_dtype
         # Transfer learning (KerasNet.freeze/freeze_up_to): frozen layers'
         # grads AND optimizer updates are masked to zero — updates too, so

@@ -111,6 +111,34 @@ def quantize_params_for_plan(params, plan, min_size: int = 1024):
     return jax.tree_util.tree_map_with_path(q, params)
 
 
+def quantized_matmul(x, qt: QuantizedTensor):
+    """``x @ dequantize(qt)`` — THE consumer for a quantized dense
+    weight, kernel-plane aware.
+
+    Under a plan whose ``kernel_rules`` route ``serving.int8_matmul``
+    to the pallas kernel, a 2D last-axis-scaled weight runs the
+    weight-stationary int8 MXU path
+    (:func:`analytics_zoo_tpu.ops.pallas.int8_matmul.int8_matmul`):
+    the weight stays 1 byte/param through HBM and VMEM instead of
+    being expanded to f32 before a plain dot.  Every other case — no
+    rule, an explicit ``"xla"`` pick, non-2D weights, axis-0 scales —
+    is the classic dequantize-then-dot, where XLA fuses the dequant
+    multiply into the consumer."""
+    if isinstance(qt, QuantizedTensor) and qt.values.ndim == 2 \
+            and qt.axis == qt.values.ndim - 1:
+        from analytics_zoo_tpu.parallel.plan import resolve_kernel
+
+        if resolve_kernel("serving.int8_matmul") == "int8_matmul":
+            from analytics_zoo_tpu.ops.pallas.int8_matmul import (
+                int8_matmul,
+            )
+
+            return int8_matmul(x, qt.values, qt.scale.reshape(-1))
+    if isinstance(qt, QuantizedTensor):
+        return x @ qt.dequantize(x.dtype)
+    return x @ qt
+
+
 def quantized_bytes_ratio(params, qparams) -> float:
     """quantized-bytes / original-bytes over the whole tree — the
     whitepaper's 4x model-size claim as a measured number (int8 values

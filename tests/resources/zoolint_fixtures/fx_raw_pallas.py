@@ -1,0 +1,26 @@
+"""zoolint fixture: raw-pallas-call — decorator/partial/call-site
+positives plus a suppressed negative.  Never imported; linted
+statically."""
+
+from functools import partial
+
+import jax.experimental.pallas as pl
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+@pl.pallas_call  # POSITIVE (decorator)
+def bare_decorated(x):
+    return x
+
+
+@partial(pl.pallas_call, grid=(1,))  # POSITIVE (partial decorator)
+def partial_decorated(x):
+    return x
+
+
+bad_call = pl.pallas_call(kernel, out_shape=None)  # POSITIVE (call site)
+
+justified = pl.pallas_call(kernel)  # zoolint: disable=raw-pallas-call -- fixture: deliberate bypass with a recorded reason

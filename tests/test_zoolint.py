@@ -269,6 +269,48 @@ class TestRawRematRule:
         assert not active, [str(f) for f in active]
 
 
+class TestRawPallasCallRule:
+    FX = "fx_raw_pallas.py"
+
+    def test_raw_pallas_positives(self):
+        """Decorator, partial-decorator and call-site pallas_calls
+        outside ops/pallas/ are flagged."""
+        active = _active(_lint_fixture(self.FX, "raw-pallas-call"))
+        lines = {f.line for f in active}
+        assert _line_of(self.FX, "POSITIVE (decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (partial decorator)") in lines
+        assert _line_of(self.FX, "POSITIVE (call site)") in lines
+        assert len(active) == 3
+
+    def test_suppressed_negative(self):
+        sup = _suppressed(_lint_fixture(self.FX, "raw-pallas-call"))
+        assert [f.line for f in sup] == \
+            [_line_of(self.FX, "deliberate bypass")]
+
+    def test_package_kernels_routed(self):
+        """The kernel plane's contract: every pl.pallas_call in the
+        package lives in ops/pallas/ (where the modules carry the
+        disable-file justification) and the kernel CONSUMERS carry
+        none at all — zero active raw-pallas-call findings."""
+        import glob
+
+        from analytics_zoo_tpu.analysis import lint_paths
+
+        mods = sorted(glob.glob(os.path.join(
+            REPO, "analytics_zoo_tpu", "ops", "pallas", "*.py")))
+        mods += [
+            os.path.join(REPO, "analytics_zoo_tpu", p) for p in (
+                "ops/attention.py",
+                "pipeline/api/keras/objectives.py",
+                "pipeline/inference/quantize.py",
+                "pipeline/estimator/estimator.py",
+            )
+        ]
+        active = [f for f in _active(lint_paths(mods))
+                  if f.rule == "raw-pallas-call"]
+        assert not active, [str(f) for f in active]
+
+
 class TestGuardedByRule:
     FX = "fx_guarded_by.py"
 

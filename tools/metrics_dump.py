@@ -48,7 +48,18 @@ per-target `zoo_scrape_fetches_total` / `zoo_scrape_errors_total` /
 pull-latency histogram), and `zoo_slo` (the burn-rate engine,
 metrics/slo.py: `zoo_slo_burn_rate{slo,window}` for the short/long
 alert windows, `zoo_slo_alert_active{slo}`, `zoo_slo_alerts_total`
-and `zoo_slo_evaluations_total`).  When the scraped ``/varz`` carries
+and `zoo_slo_evaluations_total`), and `zoo_kernel` (the Pallas kernel
+plane, parallel/plan.py kernel_rules + ops/pallas:
+`zoo_kernel_selections{label,scope,kernel}` — what the fifth rule
+table resolved per compile label,
+`zoo_kernel_invocations{kernel,backend}` — pallas vs fallback routing
+counts, and the bytes loop
+`zoo_kernel_measured_bytes{label}` /
+`zoo_kernel_predicted_bytes{label}` /
+`zoo_kernel_bytes_rel_error{label}` — measured custom-call HBM bytes
+against costmodel.kernel_bytes; the HLO side is
+`zoo_hlo_custom_kernels{label}` / `zoo_hlo_custom_kernel_bytes{label}`
+under the `zoo_hlo` family).  When the scraped ``/varz`` carries
 a structured decision log (``autotune`` / ``fleet`` / ``oracle`` /
 ``elastic`` / ``scrape`` / ``slo`` sections), it is additionally
 rendered as a table — time, knob/action, old → new, reason; predicted
@@ -364,6 +375,61 @@ def render_slo(doc, prefix="", out=None):
                      f"{d.get('state', '?'):<10}{burns:<16}")
 
 
+def render_kernels(doc, prefix="", out=None):
+    """Kernel-plane panel from the ``zoo_kernel_*`` gauge family
+    (parallel/plan.py record_kernel_gauges + ops/pallas
+    record_kernel_bytes): per-label scope→kernel selections from the
+    plan's fifth rule table, measured-vs-predicted custom-call bytes
+    with their relative error, and the per-kernel pallas/fallback
+    routing counters.  Skipped when the snapshot carries no zoo_kernel
+    samples or ``--prefix`` filters them out."""
+    if prefix and not "zoo_kernel".startswith(prefix):
+        return
+    samples = [s for s in doc.get("samples", [])
+               if s["name"].startswith("zoo_kernel_")]
+    if not samples:
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    selections = [s for s in samples
+                  if s["name"] == "zoo_kernel_selections"]
+    if selections:
+        emit(f"\nkernels: {'label':<22}{'scope':<22}kernel")
+        for s in sorted(selections,
+                        key=lambda s: (s["labels"].get("label", ""),
+                                       s["labels"].get("scope", ""))):
+            lab = s["labels"]
+            emit(f"         {lab.get('label', '?'):<22}"
+                 f"{lab.get('scope', '?'):<22}{lab.get('kernel', '?')}")
+    by_label = {}
+    for s in samples:
+        if s["name"] in ("zoo_kernel_measured_bytes",
+                         "zoo_kernel_predicted_bytes",
+                         "zoo_kernel_bytes_rel_error"):
+            by_label.setdefault(
+                s["labels"].get("label", "?"), {})[s["name"]] = s["value"]
+    if by_label:
+        emit(f"\n  {'label':<28}{'measured':>12}{'predicted':>12}"
+             f"{'rel_err':>9}")
+        for label in sorted(by_label):
+            row = by_label[label]
+            pred = row.get("zoo_kernel_predicted_bytes")
+            err = row.get("zoo_kernel_bytes_rel_error")
+            emit(f"  {label:<28}"
+                 f"{row.get('zoo_kernel_measured_bytes', 0):>12.0f}"
+                 f"{('-' if pred is None else f'{pred:.0f}'):>12}"
+                 f"{('-' if err is None else f'{err:.4f}'):>9}")
+    invocations = [s for s in samples
+                   if s["name"] == "zoo_kernel_invocations"]
+    if invocations:
+        emit(f"\n  {'kernel':<24}{'backend':<12}count")
+        for s in sorted(invocations,
+                        key=lambda s: (s["labels"].get("kernel", ""),
+                                       s["labels"].get("backend", ""))):
+            lab = s["labels"]
+            emit(f"  {lab.get('kernel', '?'):<24}"
+                 f"{lab.get('backend', '?'):<12}{s['value']:.0f}")
+
+
 def render(docs, a):
     """One full render pass over a snapshot list — the body shared by
     the one-shot path and the ``--watch`` loop."""
@@ -410,6 +476,7 @@ def render(docs, a):
     render_elastic(last, prefix=a.prefix)
     render_scrape(last, prefix=a.prefix)
     render_slo(last, prefix=a.prefix)
+    render_kernels(last, prefix=a.prefix)
     if hist_rows:
         print(f"\n{'histogram':<52}{'count':>9}{'mean':>11}"
               f"{'p50':>11}{'p95':>11}{'p99':>11}")
