@@ -56,6 +56,8 @@ __all__ = [
     "KERNEL_BYTE_MODELS", "kernel_bytes", "choose_kernel",
     "ResidualModel", "load_report_rows", "load_bench_rows",
     "load_tune_log_rows", "training_rows",
+    "predict_serving_seconds", "serving_bucket_label",
+    "load_serving_rows", "SERVING_LABEL_PREFIX",
 ]
 
 #: below this many joined (features, K, measured steps/sec) samples the
@@ -350,6 +352,84 @@ def predict_steps_per_sec(features: Mapping, k: int = 1,
                              exposed_fraction=exposed_fraction,
                              dtype=dtype,
                              dtype_histogram=dtype_histogram), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Serving (predict-step) roofline — ISSUE 20, the TpuGraphs framing
+# applied to inference: the per-bucket predict programs the
+# InferenceModel compiles through timed_compile carry the same
+# zoo_hlo_* feature vector as train steps, so the same roofline
+# predicts their wall seconds BEFORE the first request.
+# ---------------------------------------------------------------------------
+
+#: compile-label prefix of the bucketed predict programs
+#: (pipeline/inference/inference_model.py ``_get_compiled``)
+SERVING_LABEL_PREFIX = "inference_b"
+
+
+def serving_bucket_label(bucket: int) -> str:
+    """The compile label ``InferenceModel`` stamps on the pad-bucket's
+    predict program — the join key between a bucket's hlo report row
+    and its measured predict seconds."""
+    return f"{SERVING_LABEL_PREFIX}{int(bucket)}"
+
+
+def predict_serving_seconds(features: Mapping, batch: int = 1,
+                            peaks: PeakTable | None = None,
+                            dtype: str | None = None,
+                            dtype_histogram: Mapping | None = None,
+                            ) -> float:
+    """Roofline wall seconds for ONE dispatch of a bucketed predict
+    program.
+
+    ``features`` is the zoo_hlo_* vector of the PAD-BUCKET program
+    (already sized for the padded batch); ``batch`` only matters when
+    the features were extracted at a different bucket size — the
+    compute/memory byte terms scale linearly with the batch dimension
+    (activations dominate a forward pass), while the dispatch overhead
+    is per-call and does not.  Serving dispatches are k=1 by
+    construction (each request batch is one executable call — there is
+    no multi-step fusion to amortize the overhead across), which is why
+    the overhead term matters MORE here than in training: at small
+    buckets it is the floor the pad-bucket set must respect."""
+    peaks = peaks if peaks is not None else resolve_peaks()
+    if dtype is None:
+        dtype = histogram_compute_dtype(dtype_histogram)
+    peaks = dtype_peaks(peaks, dtype)
+    f = normalize_features(features)
+    scale = max(float(batch), 1.0) / max(
+        float(f.get("feature_batch") or batch or 1), 1.0)
+    compute_s = scale * f["matmul_flops"] / max(peaks.flops, 1.0)
+    memory_s = scale * f["bytes_accessed"] \
+        / max(peaks.hbm_bytes_per_s, 1.0)
+    collective_s = f["collective_bytes"] \
+        / max(peaks.link_bytes_per_s, 1.0)
+    return max(compute_s, memory_s) + collective_s \
+        + peaks.dispatch_overhead_s
+
+
+def load_serving_rows(report_dir: str) -> list[dict]:
+    """The predict-labelled slice of :func:`load_report_rows`, keyed by
+    pad bucket: one row per ``inference_b<bucket>`` report (latest file
+    per label wins), with ``bucket`` parsed from the label or the
+    stamped meta.  The serving oracle's feature source — empty until an
+    :class:`InferenceModel` has compiled (or warmed) its buckets under
+    ``ZOO_HLO_REPORT_DIR``."""
+    by_label: dict[str, dict] = {}
+    for row in load_report_rows(report_dir):
+        label = str(row.get("label") or "")
+        if not label.startswith(SERVING_LABEL_PREFIX):
+            continue
+        bucket = row.get("bucket")
+        if bucket is None:
+            suffix = label[len(SERVING_LABEL_PREFIX):]
+            if not suffix.isdigit():
+                continue
+            bucket = int(suffix)
+        row = dict(row)
+        row["bucket"] = int(bucket)
+        by_label[label] = row  # sorted read order: later files win
+    return sorted(by_label.values(), key=lambda r: r["bucket"])
 
 
 # ---------------------------------------------------------------------------
@@ -752,6 +832,7 @@ def load_report_rows(report_dir: str) -> list[dict]:
             "compile_seconds": doc.get("compile_seconds"),
             "dtype_histogram": doc.get("dtype_histogram"),
             "dtype_policy": doc.get("dtype_policy"),
+            "bucket": doc.get("bucket"),
             "ts": doc.get("ts"),
         })
     return rows

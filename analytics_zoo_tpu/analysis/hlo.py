@@ -198,6 +198,10 @@ class HloReport:
     steps_per_dispatch: int | None = None
     xla_flags: tuple | None = None
     dtype_policy: str | None = None
+    # serving context (ISSUE 20): the pad bucket a predict-labelled
+    # program was compiled for — lets the serving cost model key
+    # inference_b* rows by bucket without parsing labels
+    bucket: int | None = None
 
     def features(self) -> dict:
         """The flat feature dict exported to metrics / JSON — the cost-
@@ -237,6 +241,7 @@ class HloReport:
             else None,
             "dtype_histogram": dict(self.dtype_histogram),
             "dtype_policy": self.dtype_policy,
+            "bucket": self.bucket,
         }
 
 
@@ -686,7 +691,7 @@ def lint_lowered(lowered, label: str = "module",
                            expected_collectives=expected,
                            dtype_policy=dtype_policy)
     for key in ("plan", "mesh_shape", "steps_per_dispatch",
-                "xla_flags"):
+                "xla_flags", "bucket"):
         if meta and meta.get(key) is not None:
             setattr(rpt, key, meta[key])
     remember_report(rpt)

@@ -26,6 +26,7 @@ blind sweep everywhere.
 from __future__ import annotations
 
 import collections
+import math
 import os
 import threading
 import time
@@ -38,9 +39,12 @@ from analytics_zoo_tpu.analysis.costmodel import (
     PeakTable,
     ResidualModel,
     choose_kernel,
+    normalize_features,
     plan_collective_bytes,
     plan_exposed_fraction,
     predict_chip_bytes,
+    predict_serving_seconds,
+    predict_step_seconds,
     predict_steps_per_sec,
     resolve_peaks,
     training_rows,
@@ -51,7 +55,8 @@ from analytics_zoo_tpu.metrics import (
 )
 
 __all__ = ["ConfigOracle", "oracle_enabled", "varz_doc",
-           "KERNEL_STEP_FACTORS"]
+           "KERNEL_STEP_FACTORS", "SERVING_SLO_FRACTION",
+           "SERVING_UTILIZATION"]
 
 #: plans the oracle can choose among for ``plan="auto"``, ordered from
 #: least to most sharded so infeasible-everywhere ties break toward the
@@ -78,6 +83,21 @@ PREDICT_MARGIN = 0.05
 #: the tie breaks toward the plain candidate (candidate order) — the
 #: oracle DECLINING pallas on the CPU tier.
 KERNEL_STEP_FACTORS = {None: 1.0, "kernels": 0.9}
+
+#: Share of the p99 SLO :meth:`ConfigOracle.choose_serving` budgets for
+#: SERVICE time (the padded dispatch itself); the remainder is queueing
+#: headroom — Little's-law delay under the target utilization plus the
+#: batcher's fill wait.  A bucket whose predicted dispatch exceeds this
+#: slice of the SLO cannot meet the tail even on an idle replica, so it
+#: is excluded from the pad-bucket set.
+SERVING_SLO_FRACTION = 0.5
+
+#: Per-replica utilization the replica math plans to: predicted
+#: capacity is derated by this factor so the fleet absorbs arrival
+#: burstiness without the queue estimate blowing through the SLO
+#: headroom (the classic M/M/1 knee — above ~0.7 the queue term
+#: dominates).
+SERVING_UTILIZATION = 0.6
 
 
 def oracle_enabled() -> bool:
@@ -381,6 +401,166 @@ class ConfigOracle:
                 predicted_kernel_bytes=v["predicted_bytes"]["kernel"],
                 predicted_xla_bytes=v["predicted_bytes"]["xla"])
         return verdicts
+
+    def choose_serving(self, model_features, slo_p99_ms: float,
+                       offered_rate: float, model: str = "default",
+                       max_replicas: int = 8,
+                       kernel_sizes: Mapping[str, Mapping] | None = None,
+                       ) -> dict:
+        """The serving config a model should be PRIMED with before its
+        first request — the TpuGraphs cost-model plane applied to
+        inference (ISSUE 20).
+
+        ``model_features`` is the per-bucket feature source: either the
+        row list :func:`~analytics_zoo_tpu.analysis.costmodel
+        .load_serving_rows` returns (one ``inference_b<bucket>`` report
+        row per pad bucket, produced by ``InferenceModel.warmup`` under
+        ``ZOO_HLO_REPORT_DIR``) or a plain ``{bucket: features}``
+        mapping.  Per bucket the serving roofline
+        (:func:`predict_serving_seconds`, corrected by the fitted
+        residual once it is ready) predicts one dispatch's wall
+        seconds; from those predictions the oracle derives
+
+        - **pad_buckets** — buckets whose predicted dispatch fits the
+          service slice of the SLO (:data:`SERVING_SLO_FRACTION`); the
+          smallest bucket always qualifies so the set is never empty;
+        - **replicas** — ``ceil(offered_rate / capacity)`` where
+          capacity is the best bucket's ``bucket/seconds`` derated by
+          :data:`SERVING_UTILIZATION`, clamped to ``[1, max_replicas]``
+          — the :class:`~analytics_zoo_tpu.serving.scaler.SloScaler`
+          prior target, so the fleet starts AT the predicted size
+          instead of discovering it through a violation;
+        - **batch_budget_ms** — the ``ZOO_SERVING_BATCH_BUDGET_MS``
+          slice left after the best bucket's service time, i.e. how
+          long the batcher may wait filling a bucket without eating the
+          tail headroom;
+        - **quantize** — ``"int8"`` exactly when the predict program is
+          memory-bound (weight-stationary int8 quarters HBM traffic —
+          ``quantize_params_for_plan`` applies it plan-aware); a
+          dispatch- or compute-bound program keeps f32;
+        - **kernels** — per-kernel verdicts via :meth:`choose_kernels`
+          when ``kernel_sizes`` is given (CPU peaks decline by
+          construction).
+
+        Every per-bucket prediction is a logged pair under
+        ``config="serving:<model>:b<bucket>"`` (dispatches/sec); the
+        bench's measured per-bucket latency closes them via
+        :meth:`record_outcome`.  Returns the config doc the router
+        primes a fleet from."""
+        slo_s = float(slo_p99_ms) / 1e3
+        if slo_s <= 0:
+            raise ValueError(f"slo_p99_ms must be > 0, got {slo_p99_ms}")
+        rows: dict[int, Mapping] = {}
+        dtype_hists: dict[int, Mapping | None] = {}
+        if isinstance(model_features, Mapping):
+            for bucket, feats in model_features.items():
+                rows[int(bucket)] = feats or {}
+                dtype_hists[int(bucket)] = None
+        else:
+            for row in model_features or ():
+                bucket = int(row.get("bucket") or 0)
+                if bucket <= 0:
+                    continue
+                rows[bucket] = row.get("features") or {}
+                dtype_hists[bucket] = row.get("dtype_histogram")
+        predicted: dict[str, dict] = {}
+        feasible: list[int] = []
+        for bucket in sorted(rows):
+            feats = rows[bucket]
+            pred_s = predict_serving_seconds(
+                feats, batch=bucket, peaks=self.peaks,
+                dtype_histogram=dtype_hists.get(bucket))
+            if self.residual.ready:
+                # the residual is fitted on step seconds from the SAME
+                # feature vector; apply its correction as a ratio so
+                # the serving-specific terms (per-call overhead, batch
+                # scaling) survive
+                analytic_s = predict_step_seconds(
+                    feats, k=1, peaks=self.peaks)
+                fitted_s = 1.0 / max(
+                    self.residual.predict_steps_per_sec(feats, k=1),
+                    1e-12)
+                pred_s *= fitted_s / max(analytic_s, 1e-12)
+            fits = pred_s <= slo_s * SERVING_SLO_FRACTION
+            if fits:
+                feasible.append(bucket)
+            predicted[str(bucket)] = {
+                "bucket": bucket,
+                "predict_seconds": pred_s,
+                "capacity_rps":
+                    bucket / max(pred_s, 1e-12) * SERVING_UTILIZATION,
+                "feasible": fits,
+            }
+        if not feasible and rows:
+            # nothing fits the service slice: serve at the smallest
+            # bucket anyway (the only config with a chance), mirroring
+            # choose_plan's infeasible-everywhere fallback
+            feasible = [min(rows)]
+        best = max(feasible) if feasible else 0
+        if best:
+            best_doc = predicted[str(best)]
+            replicas = max(1, min(int(max_replicas), math.ceil(
+                max(float(offered_rate), 0.0)
+                / max(best_doc["capacity_rps"], 1e-12))))
+            budget_ms = min(
+                max((slo_s * SERVING_SLO_FRACTION
+                     - best_doc["predict_seconds"]) * 1e3, 1.0),
+                float(slo_p99_ms) * SERVING_SLO_FRACTION)
+            f = normalize_features(rows[best])
+            mem_s = f["bytes_accessed"] / max(
+                self.peaks.hbm_bytes_per_s, 1.0)
+            comp_s = f["matmul_flops"] / max(self.peaks.flops, 1.0)
+            quantize = "int8" if mem_s > comp_s else None
+        else:
+            # zero feature rows (no warmup has run): conservative prior
+            replicas, budget_ms, quantize = 1, slo_p99_ms / 4.0, None
+        kernels = (self.choose_kernels(kernel_sizes)
+                   if kernel_sizes else {})
+        config = f"serving:{model}"
+        doc = {
+            "model": str(model), "config": config,
+            "replicas": int(replicas),
+            "pad_buckets": sorted(feasible),
+            "batch_budget_ms": round(float(budget_ms), 3),
+            "quantize": quantize, "kernels": kernels,
+            "predicted": predicted,
+            "slo_p99_ms": float(slo_p99_ms),
+            "offered_rate": float(offered_rate),
+            "fit_samples": self.residual.n_samples,
+        }
+        now = time.time()
+        with self._lock:
+            for key, p in sorted(predicted.items(),
+                                 key=lambda kv: kv[1]["bucket"]):
+                self._remember_locked({
+                    "ts": now, "consumer": "serving",
+                    "config": f"{config}:b{p['bucket']}",
+                    "predicted_steps_per_sec":
+                        round(1.0 / max(p["predict_seconds"], 1e-12), 3),
+                    "chosen": p["bucket"] == best,
+                    "measured_steps_per_sec": None, "rel_error": None})
+        self.metrics.predictions.labels(consumer="serving").inc()
+        for p in predicted.values():
+            self.metrics.serving_predicted_seconds.labels(
+                model=str(model), bucket=str(p["bucket"])).set(
+                    p["predict_seconds"])
+        self.metrics.serving_predicted_replicas.labels(
+            model=str(model)).set(replicas)
+        self.metrics.serving_predicted_budget_ms.labels(
+            model=str(model)).set(doc["batch_budget_ms"])
+        if best:
+            self.metrics.predicted_sps.labels(config=config).set(
+                round(1.0 / max(
+                    predicted[str(best)]["predict_seconds"], 1e-12), 3))
+        get_flight_recorder().record(
+            "oracle", consumer="serving", config=config,
+            replicas=int(replicas), pad_buckets=sorted(feasible),
+            batch_budget_ms=doc["batch_budget_ms"],
+            quantize=quantize,
+            slo_p99_ms=float(slo_p99_ms),
+            offered_rate=float(offered_rate),
+            fit_samples=self.residual.n_samples)
+        return doc
 
     def repick(self, param_bytes: int, opt_bytes: int, n_shards: int,
                k_candidates: Sequence[int] = (1, 2, 4, 8),

@@ -432,6 +432,12 @@ class ZooConfig:
     fleet_max_replicas: int | None = None
     fleet_interval: float | None = None
     fleet_lease_ms: int | None = None
+    # Predictive serving plane (serving/router.py, serving/admission.py):
+    # front-door admission control and the multi-tenant model roster
+    # ("name=slo_p99_ms[@offered_rate],..." — one oracle-primed fleet
+    # per entry).  Env: ZOO_ADMISSION=1, ZOO_SERVING_MODELS.
+    admission: bool | None = None
+    serving_models: str | None = None
     # Elastic training runtime (elastic/): membership lease, cohort
     # floor, and shutdown grace.  Env: ZOO_ELASTIC,
     # ZOO_ELASTIC_LEASE_MS, ZOO_ELASTIC_MIN_WORKERS,
@@ -639,6 +645,23 @@ class ZooConfig:
         self.fleet_lease_ms = resolve_int(
             self.fleet_lease_ms, "ZOO_FLEET_LEASE_MS", 10_000,
             minimum=100)
+        self.admission = bool(resolve(
+            self.admission, "ZOO_ADMISSION", False,
+            cast=bool_parser("ZOO_ADMISSION")))
+        self.serving_models = resolve(
+            self.serving_models, "ZOO_SERVING_MODELS", None, cast=str)
+        if self.serving_models is not None:
+            # eager validation (the resolve_int contract): a malformed
+            # model roster fails at context init naming the env var,
+            # not from the router's first tenant build.  Lazy import —
+            # serving.modelspec is pure stdlib, but keep engine's
+            # import graph serving-free (the parallel.plan precedent).
+            from analytics_zoo_tpu.serving.modelspec import (
+                parse_model_specs,
+            )
+
+            parse_model_specs(self.serving_models,
+                              source="ZOO_SERVING_MODELS")
 
         # Elastic-training tier (elastic/): validated eagerly so a bad
         # knob fails at context init, never from inside a training

@@ -74,11 +74,13 @@ from analytics_zoo_tpu.metrics.registry import (
     set_registry,
 )
 from analytics_zoo_tpu.metrics.runtime import (
+    AdmissionMetrics,
     AutotuneMetrics,
     DataPipelineMetrics,
     ElasticMetrics,
     FleetMetrics,
     OracleMetrics,
+    RouterMetrics,
     ScrapeMetrics,
     ServingMetrics,
     SloMetrics,
@@ -114,6 +116,7 @@ __all__ = [
     "StepMetrics", "ServingMetrics", "DataPipelineMetrics",
     "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
     "ElasticMetrics", "ScrapeMetrics", "SloMetrics",
+    "RouterMetrics", "AdmissionMetrics",
     "record_device_memory",
     "TimeSeriesStore", "SloSpec", "SloEngine", "default_slos",
     "VarzScraper", "fleet_varz_targets",

@@ -195,6 +195,17 @@ class MetricsServer:
         fleet = sys.modules.get("analytics_zoo_tpu.serving.fleet")
         if fleet is not None:
             doc["fleet"] = fleet.varz_doc()
+        # Router panel (serving/router.py): per-model fleet state +
+        # the prime/scale/stop decision log — same contract.
+        router = sys.modules.get("analytics_zoo_tpu.serving.router")
+        if router is not None:
+            doc["router"] = router.varz_doc()
+        # Admission panel (serving/admission.py): per-stream verdicts +
+        # the accept/shed transition log — same contract.
+        admission = sys.modules.get(
+            "analytics_zoo_tpu.serving.admission")
+        if admission is not None:
+            doc["admission"] = admission.varz_doc()
         # Oracle panel (analysis/oracle.py): peak table, residual-fit
         # size and the predicted-vs-measured pairs per config.
         oracle = sys.modules.get("analytics_zoo_tpu.analysis.oracle")

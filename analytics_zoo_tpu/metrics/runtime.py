@@ -32,6 +32,7 @@ from analytics_zoo_tpu.metrics.registry import (
 __all__ = ["StepMetrics", "ServingMetrics", "DataPipelineMetrics",
            "AutotuneMetrics", "FleetMetrics", "OracleMetrics",
            "ElasticMetrics", "ScrapeMetrics", "SloMetrics",
+           "RouterMetrics", "AdmissionMetrics",
            "record_device_memory"]
 
 # Step-time shaped buckets (seconds): the shared latency bounds minus
@@ -257,6 +258,22 @@ class OracleMetrics:
             "zoo_oracle_fit_samples",
             "training rows behind the residual model "
             "(0 = analytic-only fallback)")
+        # predictive serving plane (ISSUE 20): the choose_serving
+        # verdict per model — what the fleet was PRIMED with before the
+        # first request, scored against measured predict latency the
+        # same way every oracle pick is
+        self.serving_predicted_seconds = reg.gauge(
+            "zoo_serving_predicted_seconds",
+            "oracle-predicted predict-step wall seconds per pad bucket",
+            labelnames=("model", "bucket"))
+        self.serving_predicted_replicas = reg.gauge(
+            "zoo_serving_predicted_replicas",
+            "oracle-predicted replica target for the offered rate",
+            labelnames=("model",))
+        self.serving_predicted_budget_ms = reg.gauge(
+            "zoo_serving_predicted_batch_budget_ms",
+            "oracle-picked continuous-batching budget per model",
+            labelnames=("model",))
 
 
 class FleetMetrics:
@@ -314,6 +331,70 @@ class FleetMetrics:
             "zoo_fleet_hosts_target",
             "scaler's host target from replicas-per-host packing "
             "(advisory — an external provisioner acts on it)")
+
+
+class RouterMetrics:
+    """Multi-tenant router telemetry (``zoo_router_*`` +
+    per-model ``zoo_fleet_*{model=}``, serving/router.py).
+
+    One ``ModelRouter`` supervises a heterogeneous set of per-model
+    fleets; the model-labeled fleet trio (replicas / backlog /
+    est p99) is the per-tenant view the unlabeled ``zoo_fleet_*``
+    families cannot carry (two controllers on one registry would
+    collide), and a merged scrape across hosts keeps the label — the
+    zoowatch federation plane sees each tenant separately."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.models = reg.gauge(
+            "zoo_router_models", "models currently routed")
+        self.decisions = reg.counter(
+            "zoo_router_decisions_total",
+            "router control actions (prime / scale / stop), "
+            "by model and action", labelnames=("model", "action"))
+        self.replicas = reg.gauge(
+            "zoo_fleet_model_replicas",
+            "live serving replicas, by model", labelnames=("model",))
+        self.backlog = reg.gauge(
+            "zoo_fleet_model_backlog",
+            "unclaimed per-model stream backlog at the last tick",
+            labelnames=("model",))
+        self.est_p99 = reg.gauge(
+            "zoo_fleet_model_est_p99_seconds",
+            "scaler's estimated request p99, by model",
+            labelnames=("model",))
+
+
+class AdmissionMetrics:
+    """Front-door admission telemetry (``zoo_admission_*``,
+    serving/admission.py).
+
+    The accept/shed counter pair is the shedding audit: every enqueue
+    verdict is counted by model, so `accepted == served` (the
+    exactly-once audit) and the shed fraction under overload are both
+    one scrape away.  ``state`` is the current verdict gauge (0 =
+    accepting, 1 = shedding) and ``retry_after_seconds`` the hint the
+    last shed carried — what a client backoff loop actually obeys."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.enabled = reg.enabled
+        self.requests = reg.counter(
+            "zoo_admission_requests_total",
+            "front-door verdicts, by model and verdict (accept/shed)",
+            labelnames=("model", "verdict"))
+        self.state = reg.gauge(
+            "zoo_admission_state",
+            "current admission state (0 accepting, 1 shedding), "
+            "by model", labelnames=("model",))
+        self.retry_after = reg.gauge(
+            "zoo_admission_retry_after_seconds",
+            "retry-after hint carried by the latest shed verdict",
+            labelnames=("model",))
+        self.evaluations = reg.counter(
+            "zoo_admission_evaluations_total",
+            "admission re-evaluation ticks across all models")
 
 
 class ElasticMetrics:

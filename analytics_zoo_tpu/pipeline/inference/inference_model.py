@@ -226,12 +226,24 @@ class InferenceModel:
                     # ZOO_COMPILE_CACHE: an already-served bucket shape
                     # compiles as a persistent-cache hit on restart
                     maybe_enable_persistent_cache()
+                    # ISSUE 20: stamp the serving context (pad bucket,
+                    # precision-qualified plan, device footprint) so the
+                    # predict-labelled zoo-hlo-report rows are joinable
+                    # cost-model training examples like train rows
+                    precision = ("int8" if self._quantized
+                                 else "bf16" if self._bf16 else "f32")
+                    meta = {
+                        "bucket": int(bucket) if bucket.isdigit() else None,
+                        "plan": f"serving+{precision}",
+                        "mesh_shape": {"replica": jax.local_device_count()},
+                    }
                     with ctx, span("zoo.inference.compile",
                                    args={"bucket": bucket}):
                         exe = timed_compile(
                             jax.jit(self._forward_fn())
                             .lower(self._params, self._state, list(xs)),
                             f"inference_b{bucket}",
+                            meta=meta,
                         )
                     self._m_compiles.labels(bucket=bucket).inc()
                     self._compiled[key] = exe

@@ -10,23 +10,37 @@ XLA executable per bucket), and writes results back.  The broker is
 pluggable: in-memory (tests/embedded), file-spool (multi-process, no
 external service), or Redis when the ``redis`` package is importable —
 same stream/hash data model in all three.
+
+The predictive serving plane (ISSUE 20) adds multi-tenant routing on
+top: :class:`~analytics_zoo_tpu.serving.router.ModelRouter` runs one
+oracle-primed fleet per :class:`~analytics_zoo_tpu.serving.modelspec
+.ModelSpec` on per-model streams, and
+:class:`~analytics_zoo_tpu.serving.admission.AdmissionController`
+sheds overload at the front door (clients see the typed
+:class:`~analytics_zoo_tpu.serving.client.ServingRejected`) so
+accepted work keeps the exactly-once claim guarantee.
 """
 
 from .broker import FileBroker, InMemoryBroker, RedisBroker, connect_broker
-from .client import InputQueue, OutputQueue, ServingTimeout
+from .client import InputQueue, OutputQueue, ServingRejected, \
+    ServingTimeout, model_stream
+from .modelspec import ModelSpec, format_model_specs, parse_model_specs
 from .server import ClusterServing, ClusterServingHelper
 
 __all__ = [
     "InMemoryBroker", "FileBroker", "RedisBroker", "connect_broker",
-    "InputQueue", "OutputQueue", "ServingTimeout",
+    "InputQueue", "OutputQueue", "ServingTimeout", "ServingRejected",
+    "model_stream", "ModelSpec", "parse_model_specs",
+    "format_model_specs",
     "ClusterServing", "ClusterServingHelper",
     "FleetController", "SloScaler",
+    "ModelRouter", "AdmissionController",
 ]
 
 
 def __getattr__(name):
-    # fleet/scaler lazy-load (PEP 562): the fleet control plane pulls in
-    # ZooConfig (jax) — a client-only process importing the package for
+    # control-plane lazy-load (PEP 562): fleet/router pull in ZooConfig
+    # (jax) — a client-only process importing the package for
     # InputQueue/OutputQueue must not pay that
     if name == "FleetController":
         from .fleet import FleetController
@@ -34,4 +48,10 @@ def __getattr__(name):
     if name == "SloScaler":
         from .scaler import SloScaler
         return SloScaler
+    if name == "ModelRouter":
+        from .router import ModelRouter
+        return ModelRouter
+    if name == "AdmissionController":
+        from .admission import AdmissionController
+        return AdmissionController
     raise AttributeError(name)

@@ -18,6 +18,39 @@ from .broker import connect_broker
 
 INPUT_STREAM = "image_stream"  # reference stream key, ClusterServing.scala:108
 RESULT_PREFIX = "result:"
+# Front-door admission verdict hash per stream (serving/admission.py):
+# {"state": "accept"|"shed", "retry_after_ms", "reason", "ts"}.  The
+# client reads it at enqueue; an absent hash means no admission
+# controller guards the stream and every enqueue is accepted.
+ADMISSION_KEY_PREFIX = "admission:"
+
+
+def model_stream(model: str) -> str:
+    """Input stream for one routed model (serving/router.py): the
+    single-tenant default stream stays ``image_stream`` so existing
+    clients are untouched; routed models get ``model_stream:<name>``."""
+    return f"model_stream:{model}"
+
+
+class ServingRejected(RuntimeError):
+    """The admission controller shed this enqueue at the front door.
+
+    Typed like :class:`ServingTimeout`: carries the ``uri``, the
+    ``retry_after_s`` hint the verdict published (obey it — the
+    controller sized it from the backlog drain rate), and the
+    ``reason`` (broker_pressure / slo_burn / backlog).  Raised BEFORE
+    the record enters the stream — a rejected request was never
+    accepted, so the exactly-once guarantee over accepted work is
+    undiluted."""
+
+    def __init__(self, uri: str, retry_after_s: float, reason: str = ""):
+        super().__init__(
+            f"enqueue of {uri!r} shed by admission control"
+            f"{f' ({reason})' if reason else ''}; retry after "
+            f"{retry_after_s:.2f}s")
+        self.uri = uri
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
 
 
 class ServingTimeout(TimeoutError):
@@ -48,19 +81,33 @@ def decode_ndarray(s: str) -> np.ndarray:
 
 
 class API:
-    """Shared connection state (reference client.py:25-56)."""
+    """Shared connection state (reference client.py:25-56).
+
+    ``model`` routes to a per-model stream (serving/router.py);
+    ``stream`` overrides the stream name directly.  Default: the
+    single-tenant ``image_stream``."""
 
     def __init__(self, broker=None, host: str = "localhost",
-                 port: int = 6379):
+                 port: int = 6379, model: str | None = None,
+                 stream: str | None = None):
         if broker is None:
             broker = f"{host}:{port}"
         self.db = connect_broker(broker)
+        self.stream = stream if stream is not None else (
+            model_stream(model) if model else INPUT_STREAM)
 
 
 class InputQueue(API):
     def enqueue_image(self, uri: str, data) -> None:
         """Push one record.  ``data``: ndarray, or a path to ``.npy`` /
-        an image file (decoded via PIL when available)."""
+        an image file (decoded via PIL when available).
+
+        When an admission controller guards this stream
+        (serving/admission.py publishes its verdict under
+        ``admission:<stream>``), a shedding verdict raises
+        :class:`ServingRejected` BEFORE the record is added — the one
+        extra broker read per enqueue is the price of never trimming
+        accepted work."""
         if isinstance(data, str):
             if data.endswith(".npy"):
                 data = np.load(data)
@@ -73,12 +120,20 @@ class InputQueue(API):
                         "or .npy path instead") from e
                 data = np.asarray(Image.open(data))
         arr = np.asarray(data)
-        self.db.xadd(INPUT_STREAM, {"uri": uri, "image": encode_ndarray(arr)})
+        verdict = self.db.hgetall(ADMISSION_KEY_PREFIX + self.stream)
+        if verdict and verdict.get("state") == "shed":
+            raise ServingRejected(
+                uri,
+                retry_after_s=float(verdict.get("retry_after_ms", 1000.0))
+                / 1e3,
+                reason=verdict.get("reason", ""))
+        self.db.xadd(self.stream, {"uri": uri,
+                                   "image": encode_ndarray(arr)})
 
     enqueue = enqueue_image
 
     def backlog(self) -> int:
-        return self.db.xlen(INPUT_STREAM)
+        return self.db.xlen(self.stream)
 
 
 class OutputQueue(API):

@@ -152,7 +152,8 @@ class FleetController:
                  serve_log: str | None = None, broker_spec=None,
                  registry=None, log_capacity: int = 256,
                  replica_extra_args=(), signal_source=None,
-                 replica_metrics: bool = False):
+                 replica_metrics: bool = False,
+                 stream: str = INPUT_STREAM, trim: bool = True):
         if mode not in ("thread", "process"):
             raise ValueError(f"mode must be thread|process, got {mode!r}")
         self.helper = helper
@@ -180,6 +181,12 @@ class FleetController:
         # publishes its URL for scraper discovery.
         self.signal_source = signal_source
         self.replica_metrics = bool(replica_metrics)
+        # multi-tenant routing (ISSUE 20): one controller serves ONE
+        # stream; a ModelRouter runs a controller per model stream.
+        # trim=False for admission-guarded streams — overload is shed
+        # at the front door, accepted records are never dropped.
+        self.stream = str(stream)
+        self.trim = bool(trim)
         self.metrics = FleetMetrics(registry=registry)
         # scaler signal sources: the SAME registry children the serving
         # replicas record into (thread mode) — family names resolve to
@@ -189,7 +196,9 @@ class FleetController:
 
         self._lock = threading.Lock()
         self._replicas: list = []  # guarded-by: _lock
-        self._target = self.scaler.min_replicas  # guarded-by: _lock
+        # oracle-primed fleets START at the scaler's seeded prior
+        # (initial_target == min_replicas when no prior was given)
+        self._target = self.scaler.initial_target()  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
         self._decisions: deque = (  # guarded-by: _lock
             deque(maxlen=int(log_capacity)))
@@ -236,7 +245,8 @@ class FleetController:
                 else self.helper.load_inference_model()
             srv = ClusterServing(helper=self.helper, model=model,
                                  broker=self.db, owner=owner,
-                                 serve_log=self.serve_log)
+                                 serve_log=self.serve_log,
+                                 stream=self.stream, trim=self.trim)
             srv.start()
             rep = _ThreadReplica(owner, srv)
         else:
@@ -246,7 +256,10 @@ class FleetController:
                    "--owner", owner,
                    "--batch-size", str(self.helper.batch_size),
                    "--budget-ms", str(self.helper.batch_budget_ms),
-                   "--lease-ms", str(self.helper.lease_ms)]
+                   "--lease-ms", str(self.helper.lease_ms),
+                   "--stream", self.stream]
+            if not self.trim:
+                cmd += ["--no-trim"]
             if self.helper.model_path:
                 cmd += ["--model", str(self.helper.model_path)]
             if self.serve_log:
@@ -285,10 +298,18 @@ class FleetController:
     # lifecycle
     # ------------------------------------------------------------------
     def start(self) -> "FleetController":
-        """Spawn up to ``scaler.min_replicas`` and start the control
-        loop (idempotent)."""
-        while self.replica_count() < self.scaler.min_replicas:
+        """Spawn up to the scaler's initial target (the oracle-seeded
+        prior when one exists, else ``min_replicas``) and start the
+        control loop (idempotent)."""
+        initial = self.scaler.initial_target()
+        primed = initial > self.scaler.min_replicas \
+            and self.replica_count() < initial
+        while self.replica_count() < initial:
             self._spawn()
+        if primed:
+            self._record_decision(
+                "prime", self.scaler.min_replicas, initial,
+                "oracle_prior", None, 0)
         self._stop_evt.clear()
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
@@ -340,7 +361,7 @@ class FleetController:
         delta = hist.delta_since(p_base)
         records = self._serving.records.get()
         new_p_base = hist.snapshot_state()
-        depth = int(self.db.unclaimed(INPUT_STREAM))
+        depth = int(self.db.unclaimed(self.stream))
         rate = 0.0
         if r_base is not None and t0 is not None and now > t0:
             rate = max(0.0, records - r_base) / (now - t0)
@@ -479,6 +500,7 @@ class FleetController:
                 "target": self._target,
                 "owners": [r.owner for r in self._replicas],
                 "mode": self.mode,
+                "stream": self.stream,
                 "federated": self.signal_source is not None,
                 "hosts": self._hosts,
                 "hosts_target": self._hosts_target,
@@ -542,6 +564,13 @@ def _replica_main(argv) -> int:
     p.add_argument("--synthetic-sleep-ms", type=float, default=0.0,
                    help="per-record service time of the synthetic model")
     p.add_argument("--serve-log", default=None)
+    p.add_argument("--stream", default=INPUT_STREAM,
+                   help="input stream to claim from (per-model streams "
+                        "under the router)")
+    p.add_argument("--no-trim", action="store_true",
+                   help="never trim the stream under broker pressure "
+                        "(admission-guarded streams shed at the front "
+                        "door instead)")
     p.add_argument("--idle-timeout", type=float, default=None)
     p.add_argument("--max-records", type=int, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
@@ -560,7 +589,8 @@ def _replica_main(argv) -> int:
     helper = ClusterServingHelper(broker=a.broker, **over)
     model = None if a.model else _SyntheticModel(a.synthetic_sleep_ms)
     srv = ClusterServing(helper=helper, model=model, owner=owner,
-                         serve_log=a.serve_log)
+                         serve_log=a.serve_log, stream=a.stream,
+                         trim=not a.no_trim)
     metrics_srv, varz_db = None, None
     if a.metrics_port is not None:
         # federated replica: export this process's registry at

@@ -76,7 +76,8 @@ class SloScaler:
     def __init__(self, slo_p99_ms: float = DEFAULT_SLO_P99_MS,
                  min_replicas: int = 1, max_replicas: int = 4,
                  up_windows: int = 2, down_windows: int = 6,
-                 slack_ratio: float = 0.5, memory_high: float = 0.5):
+                 slack_ratio: float = 0.5, memory_high: float = 0.5,
+                 prior_target: int | None = None):
         if slo_p99_ms <= 0:
             raise ValueError(f"slo_p99_ms must be > 0, got {slo_p99_ms}")
         if not 1 <= min_replicas <= max_replicas:
@@ -90,8 +91,26 @@ class SloScaler:
         self.down_windows = max(1, int(down_windows))
         self.slack_ratio = float(slack_ratio)
         self.memory_high = float(memory_high)
+        # oracle-seeded prior (ISSUE 20): where a FRESH fleet should
+        # START.  None = the old reactive behavior (min_replicas, then
+        # up_windows of violations before the first scale-up).  The
+        # prior is consumed by the first decide() on an empty window —
+        # after real telemetry arrives the reactive policy owns the
+        # target again (the prior never caps or floors later decisions).
+        if prior_target is not None:
+            prior_target = min(self.max_replicas,
+                               max(self.min_replicas, int(prior_target)))
+        self.prior_target = prior_target
+        self._prior_pending = prior_target is not None
         self._up_streak = 0
         self._down_streak = 0
+
+    # ------------------------------------------------------------------
+    def initial_target(self) -> int:
+        """The replica count a fresh controller should SPAWN at: the
+        oracle prior when one was seeded, ``min_replicas`` otherwise."""
+        return self.prior_target if self.prior_target is not None \
+            else self.min_replicas
 
     # ------------------------------------------------------------------
     def estimate_p99_s(self, sig: FleetSignals) -> float:
@@ -112,6 +131,16 @@ class SloScaler:
         ``replicas`` means hold (reason explains which streak is
         building, empty when fully steady)."""
         slo_s = self.slo_p99_ms / 1e3
+        if self._prior_pending:
+            # cold start: an empty window says NOTHING (no requests
+            # have arrived), so without a prior the fleet would sit at
+            # min_replicas for up_windows after the first load lands.
+            # Jump straight to the oracle's target; real telemetry
+            # takes over from the next window.
+            self._prior_pending = False
+            if sig.window_count == 0 and sig.queue_depth == 0 \
+                    and replicas < self.prior_target:
+                return self.prior_target, "oracle_prior"
         est = self.estimate_p99_s(sig)
         pressure = sig.memory_ratio >= self.memory_high
         violated = pressure or est > slo_s

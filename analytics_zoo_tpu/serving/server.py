@@ -207,11 +207,22 @@ class ClusterServing:
     def __init__(self, helper: ClusterServingHelper | None = None,
                  model=None, broker=None, config_path: str | None = None,
                  owner: str | None = None, serve_log: str | None = None,
+                 stream: str = INPUT_STREAM, trim: bool = True,
                  **overrides):
         self.helper = helper or ClusterServingHelper(config_path,
                                                      **overrides)
         self.db = connect_broker(broker if broker is not None
                                  else self.helper.broker_spec)
+        # Multi-tenant routing (ISSUE 20): which stream this server
+        # polls/claims.  The default is the single-tenant input stream;
+        # the router runs one fleet per model stream.
+        self.stream = str(stream)
+        # trim=False: the stream is admission-guarded (serving/
+        # admission.py sheds at the FRONT door), so the overload valve
+        # must never drop records that were already accepted — the
+        # exactly-once guarantee covers them.  Default True preserves
+        # the unguarded backpressure behavior (scala parity).
+        self.trim = bool(trim)
         self.model = model if model is not None \
             else self.helper.load_inference_model()
         # Fleet replica identity (serving/fleet.py): when set, run()
@@ -342,12 +353,12 @@ class ClusterServing:
         """One poll + predict + write-back cycle; returns #records served."""
         ratio = self.db.memory_ratio()
         self.metrics.memory_ratio.set(ratio)
-        if ratio >= self.INPUT_THRESHOLD:
+        if self.trim and ratio >= self.INPUT_THRESHOLD:
             # zoolint: disable=host-sync -- broker-side host integer, no device involved
-            keep = int(self.db.xlen(INPUT_STREAM) * self.CUT_RATIO)
-            self.db.xtrim(INPUT_STREAM, keep)
+            keep = int(self.db.xlen(self.stream) * self.CUT_RATIO)
+            self.db.xtrim(self.stream, keep)
             self.metrics.trims.inc()
-        records = self.db.xread(INPUT_STREAM, self.helper.batch_size,
+        records = self.db.xread(self.stream, self.helper.batch_size,
                                 last_id=self._last_id, block_ms=block_ms)
         t0 = time.perf_counter()
         if records:
@@ -369,7 +380,7 @@ class ClusterServing:
         finally:
             if records:
                 # ack consumed records so the stream cannot grow unbounded
-                self.db.ack(INPUT_STREAM, self._last_id)
+                self.db.ack(self.stream, self._last_id)
         # service latency endpoint taken BEFORE any metrics-only broker
         # traffic below, so enabling metrics cannot inflate the very
         # latency being measured
@@ -379,7 +390,7 @@ class ClusterServing:
         # runs when metrics are on and this cycle actually served
         # (an empty poll means the backlog was already drained)
         if records and self.metrics.enabled:
-            self.metrics.queue_depth.set(self.db.xlen(INPUT_STREAM))
+            self.metrics.queue_depth.set(self.db.xlen(self.stream))
         if records:
             # service latency for this cycle: decode + batch formation +
             # predict + write-back (poll wait excluded — the records
@@ -503,14 +514,14 @@ class ClusterServing:
                     try:
                         ratio = self.db.memory_ratio()
                         self.metrics.memory_ratio.set(ratio)
-                        if ratio >= self.INPUT_THRESHOLD:
+                        if self.trim and ratio >= self.INPUT_THRESHOLD:
                             # zoolint: disable=host-sync -- broker-side host integer, no device involved
-                            keep = int(self.db.xlen(INPUT_STREAM)
+                            keep = int(self.db.xlen(self.stream)
                                        * self.CUT_RATIO)
-                            self.db.xtrim(INPUT_STREAM, keep)
+                            self.db.xtrim(self.stream, keep)
                             self.metrics.trims.inc()
                         records = self.db.xread(
-                            INPUT_STREAM, self.helper.batch_size,
+                            self.stream, self.helper.batch_size,
                             last_id=self._last_id, block_ms=100)
                         health.heartbeat("serving_reader")
                         if not records:
@@ -525,7 +536,7 @@ class ClusterServing:
                             records, pool=decode_pool)
                         if self.metrics.enabled:
                             self.metrics.queue_depth.set(
-                                self.db.xlen(INPUT_STREAM))
+                                self.db.xlen(self.stream))
                         if not bput(in_q, (len(records), self._last_id,
                                            uris, arrs)):
                             return
@@ -557,7 +568,7 @@ class ClusterServing:
                             self.db.hset_many(writes)
                         # results durable (or judged unservable): NOW the
                         # records may leave the stream
-                        self.db.ack(INPUT_STREAM, upto_id)
+                        self.db.ack(self.stream, upto_id)
                     except Exception:
                         logger.exception(
                             "serving: write-back failed; continuing")
@@ -700,7 +711,7 @@ class ClusterServing:
                 if not ids:
                     continue
                 try:
-                    self.db.extend(INPUT_STREAM, owner, ids, lease_ms)
+                    self.db.extend(self.stream, owner, ids, lease_ms)
                 except Exception:
                     logger.exception(
                         "serving: lease keepalive failed; continuing")
@@ -732,7 +743,7 @@ class ClusterServing:
                 if bad:
                     # undecodable/mis-shaped: judged unservable — ack
                     # so no replica loops on them (serial-mode parity)
-                    self.db.release(INPUT_STREAM, owner, bad, done=True)
+                    self.db.release(self.stream, owner, bad, done=True)
                     with inflight_lock:
                         inflight.difference_update(bad)
                     admitted.update(bad)  # handled: don't requeue
@@ -747,7 +758,7 @@ class ClusterServing:
                 with inflight_lock:
                     inflight.difference_update(leftover)
                 try:
-                    self.db.release(INPUT_STREAM, owner, leftover,
+                    self.db.release(self.stream, owner, leftover,
                                     done=False)
                 except Exception:
                     pass  # broker down: leases expire to survivors
@@ -761,11 +772,11 @@ class ClusterServing:
                     try:
                         ratio = self.db.memory_ratio()
                         self.metrics.memory_ratio.set(ratio)
-                        if ratio >= self.INPUT_THRESHOLD:
+                        if self.trim and ratio >= self.INPUT_THRESHOLD:
                             # zoolint: disable=host-sync -- broker-side host integer, no device involved
-                            keep = int(self.db.xlen(INPUT_STREAM)
+                            keep = int(self.db.xlen(self.stream)
                                        * self.CUT_RATIO)
-                            self.db.xtrim(INPUT_STREAM, keep)
+                            self.db.xtrim(self.stream, keep)
                             self.metrics.trims.inc()
                         # block until records OR the nearest partial
                         # bucket's budget, whichever is sooner
@@ -773,7 +784,7 @@ class ClusterServing:
                         block = 100 if nd is None else max(
                             0, min(100, int((nd - time.monotonic()) * 1e3)))  # zoolint: disable=host-sync -- host clock math, no device value
                         records = self.db.claim(
-                            INPUT_STREAM, owner, self.helper.batch_size,
+                            self.stream, owner, self.helper.batch_size,
                             lease_ms, block_ms=block)
                         health.heartbeat("serving_reader")
                         now = time.monotonic()
@@ -787,7 +798,7 @@ class ClusterServing:
                                 # per-batch hot-path cost for a gauge
                                 depth_refreshed = now
                                 self.metrics.queue_depth.set(
-                                    self.db.unclaimed(INPUT_STREAM))
+                                    self.db.unclaimed(self.stream))
                         for bucket in batcher.take_ready(time.monotonic()):
                             fleet.batch_flushes.labels(
                                 reason=bucket[3]).inc()
@@ -820,7 +831,7 @@ class ClusterServing:
                         # results durable (or the batch judged failed):
                         # NOW the claims end and the records leave the
                         # stream — the exactly-once commit point
-                        self.db.release(INPUT_STREAM, owner, ids,
+                        self.db.release(self.stream, owner, ids,
                                         done=True)
                         if self.serve_log and writes:
                             with open(self.serve_log, "a") as f:
@@ -908,7 +919,7 @@ class ClusterServing:
                 inflight.clear()
             if leftover:
                 try:
-                    self.db.release(INPUT_STREAM, owner, leftover,
+                    self.db.release(self.stream, owner, leftover,
                                     done=False)
                 except Exception:
                     logger.exception(

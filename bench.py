@@ -3124,6 +3124,462 @@ def _federated_main(argv):
     print(json.dumps(federated_bench(**kwargs)))
 
 
+# ---------------------------------------------------------------------------
+# --serving-predict: the predictive serving plane (ISSUE 20).  Three
+# legs against the synthetic sleep model (the control-plane bench
+# convention): (a) an oracle-primed fleet takes the BENCH_FED_r15 10x
+# load step with zero hard SLO-violation windows where the reactive
+# baseline accumulates seconds of violation, plus predicted-vs-measured
+# predict-step latency per pad bucket; (b) a two-model router holds
+# BOTH per-model p99 SLOs under skewed load; (c) under 20x overload the
+# admission controller keeps accepted-work p99 under the SLO, sheds
+# with typed retry-after, and the serve-log audit shows every accepted
+# record served exactly once.  Emits BENCH_SERVE_r19.json.
+# ---------------------------------------------------------------------------
+
+
+def _serving_features(service_ms: float, buckets) -> dict:
+    """Per-bucket cost-model features whose analytic predict time on
+    the CPU peak table equals the synthetic model's service time
+    (bucket * service_ms): flops = t * peak_flops, nothing else."""
+    return {int(b): {"matmul_flops": int(b) * service_ms / 1e3 * 5e10,
+                     "bytes_accessed": 0.0}
+            for b in buckets}
+
+
+def _p99(vals):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def _load_step_run(quick: bool, prior_target=None) -> dict:
+    """One 10x load-step run (light -> heavy, then drain) against a
+    1-min fleet; ``prior_target`` seeds the scaler (the oracle-primed
+    leg).  Returns the violation-window count the acceptance compares."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue, \
+        OutputQueue
+    from analytics_zoo_tpu.serving.scaler import SloScaler
+
+    service_ms = 20.0          # one replica saturates at ~50 rec/s
+    slo_p99_ms = 400.0
+    light_rps, heavy_rps = 8.0, 80.0  # the BENCH_FED_r15 10x step
+    light_s = 3.0 if quick else 5.0
+    heavy_s = 6.0 if quick else 12.0
+    interval = 0.25
+
+    scaler = SloScaler(slo_p99_ms=slo_p99_ms, min_replicas=1,
+                       max_replicas=3, up_windows=2,
+                       down_windows=10_000, prior_target=prior_target)
+    broker = InMemoryBroker()
+    ctrl = _fleet_controller(broker, 1, service_ms, scaler=scaler,
+                             interval=interval, slo_p99_ms=slo_p99_ms)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+    served = {}
+    stop = threading.Event()
+    violations = [0]
+    timeline = []
+    t0 = time.time()
+
+    def sampler():
+        while not stop.is_set():
+            cur = ctrl.current()
+            win = cur["window"]
+            est_ms = win["predict_p99_ms"]
+            if win["queue_depth"]:
+                est_ms = est_ms + (
+                    win["queue_depth"] / win["service_rate"] * 1e3
+                    if win["service_rate"] > 0 else float("inf"))
+            if est_ms > slo_p99_ms:
+                violations[0] += 1
+            timeline.append({
+                "t_s": round(time.time() - t0, 2),
+                "replicas": cur["replicas"],
+                "est_p99_ms": (None if est_ms == float("inf")
+                               else round(est_ms, 1))})
+            time.sleep(0.1)
+
+    def collector():
+        while not stop.is_set():
+            served.update(outq.dequeue())
+            time.sleep(0.01)
+
+    ctrl.start()
+    seq = 0
+    try:
+        threading.Thread(target=sampler, daemon=True).start()
+        threading.Thread(target=collector, daemon=True).start()
+        rec = np.zeros((8,), np.float32)
+        for rate, duration in ((light_rps, light_s),
+                               (heavy_rps, heavy_s)):
+            t_phase = time.perf_counter()
+            while time.perf_counter() - t_phase < duration:
+                inq.enqueue(f"q{seq}", rec)
+                seq += 1
+                time.sleep(1.0 / rate)
+        deadline = time.time() + 120
+        while len(served) < seq and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        ctrl.stop()
+    return {
+        "prior_target": prior_target,
+        "slo_p99_ms": slo_p99_ms,
+        "load_step": {"light_rps": light_rps, "heavy_rps": heavy_rps,
+                      "factor": heavy_rps / light_rps},
+        "enqueued": seq, "served": len(served),
+        "violation_windows": violations[0],
+        "violation_seconds": round(violations[0] * 0.1, 2),
+        "max_replicas_seen": max(
+            [t["replicas"] for t in timeline] + [1]),
+        "decisions": [
+            {k: d.get(k) for k in ("action", "old", "new", "reason")}
+            for d in ctrl.decision_log()],
+        "timeline": timeline[:: 4 if quick else 2],
+    }
+
+
+def serving_predict_primed_bench(quick: bool = False) -> dict:
+    """Leg (a): the same 10x load step twice — reactive baseline
+    (scaler starts at min_replicas, scales on observed violation) vs
+    oracle-primed (``choose_serving`` predicts the replica target from
+    the per-bucket serving cost model and SEEDS the scaler).  Also
+    closes the oracle's prediction log with measured per-bucket predict
+    latencies so the rel_error lands per bucket."""
+    import numpy as np
+
+    from analytics_zoo_tpu.analysis.costmodel import resolve_peaks
+    from analytics_zoo_tpu.analysis.oracle import ConfigOracle
+    from analytics_zoo_tpu.serving.fleet import _SyntheticModel
+
+    service_ms = 20.0
+    heavy_rps = 80.0
+    slo_p99_ms = 400.0
+    buckets = (8, 16)
+    reactive = _load_step_run(quick)
+
+    oracle = ConfigOracle(peaks=resolve_peaks("cpu"))
+    feats = _serving_features(service_ms, buckets)
+    verdict = oracle.choose_serving(
+        feats, slo_p99_ms=slo_p99_ms, offered_rate=heavy_rps,
+        model="step")
+    primed = _load_step_run(quick, prior_target=verdict["replicas"])
+
+    # close the prediction -> outcome loop: measure the synthetic
+    # model's real per-bucket service time and hand it back to the
+    # oracle, so rel_error lands per bucket like every oracle pick
+    model = _SyntheticModel(service_ms)
+    rel_errors = {}
+    for b in buckets:
+        arr = np.zeros((b, 8), np.float32)
+        t0 = time.perf_counter()
+        model.predict(arr)
+        measured_s = time.perf_counter() - t0
+        oracle.record_outcome(f"serving:step:b{b}", 1.0 / measured_s,
+                              consumer="serving")
+    for row in oracle.prediction_log():
+        if row["config"].startswith("serving:step:b") \
+                and row.get("rel_error") is not None:
+            rel_errors[row["config"]] = round(row["rel_error"], 4)
+    return {
+        "service_ms_per_record": service_ms,
+        "verdict": verdict,
+        "reactive": reactive,
+        "primed": primed,
+        "primed_zero_violations": primed["violation_windows"] == 0,
+        "predict_rel_error_by_bucket": rel_errors,
+    }
+
+
+def serving_multi_model_bench(quick: bool = False) -> dict:
+    """Leg (b): a two-model router under skewed load — a fast
+    high-rate model and a slow low-rate one share ONE broker on
+    per-model streams, and BOTH client-observed p99s stay under their
+    own SLOs."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.analysis.costmodel import resolve_peaks
+    from analytics_zoo_tpu.analysis.oracle import ConfigOracle
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue, \
+        OutputQueue
+    from analytics_zoo_tpu.serving.fleet import _SyntheticModel
+    from analytics_zoo_tpu.serving.modelspec import ModelSpec
+    from analytics_zoo_tpu.serving.router import ModelRouter
+
+    service = {"fast": 5.0, "slow": 20.0}           # ms per record
+    specs = [ModelSpec("fast", slo_p99_ms=300.0, offered_rate=60.0),
+             ModelSpec("slow", slo_p99_ms=800.0, offered_rate=10.0)]
+    duration = 4.0 if quick else 8.0
+
+    broker = InMemoryBroker()
+    oracle = ConfigOracle(peaks=resolve_peaks("cpu"))
+    router = ModelRouter(
+        broker, specs,
+        model_factory=lambda spec: _SyntheticModel(service[spec.name]),
+        oracle=oracle,
+        features={name: _serving_features(ms, (8, 16))
+                  for name, ms in service.items()},
+        max_replicas=3, interval=0.25)
+    t_enq = {}
+    lock = threading.Lock()
+    latencies = {"fast": [], "slow": []}
+    stop = threading.Event()
+    outq = OutputQueue(broker=broker)
+
+    def collector():
+        while not stop.is_set():
+            done = outq.dequeue()
+            now = time.perf_counter()
+            with lock:
+                for uri in done:
+                    if uri in t_enq:
+                        latencies[uri.split(":", 1)[0]].append(
+                            now - t_enq.pop(uri))
+            time.sleep(0.01)
+
+    def load(name, rate):
+        inq = InputQueue(broker=broker, model=name)
+        rec = np.zeros((8,), np.float32)
+        i = 0
+        t_phase = time.perf_counter()
+        while time.perf_counter() - t_phase < duration:
+            uri = f"{name}:{i}"
+            with lock:
+                t_enq[uri] = time.perf_counter()
+            inq.enqueue(uri, rec)
+            i += 1
+            time.sleep(1.0 / rate)
+
+    router.start()
+    try:
+        threading.Thread(target=collector, daemon=True).start()
+        loaders = [threading.Thread(
+            target=load, args=(s.name, s.offered_rate)) for s in specs]
+        for t in loaders:
+            t.start()
+        for t in loaders:
+            t.join()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with lock:
+                if not t_enq:
+                    break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        router.stop()
+
+    out = {"models": {}}
+    all_met = True
+    for s in specs:
+        p99 = _p99(latencies[s.name])
+        met = p99 is not None and p99 * 1e3 < s.slo_p99_ms
+        all_met = all_met and met
+        out["models"][s.name] = {
+            "slo_p99_ms": s.slo_p99_ms,
+            "offered_rate": s.offered_rate,
+            "served": len(latencies[s.name]),
+            "client_p99_ms": (None if p99 is None
+                              else round(p99 * 1e3, 1)),
+            "slo_met": met,
+            "verdict": router.verdict(s.name),
+        }
+    out["router_decisions"] = router.decision_log()
+    out["both_slos_met"] = all_met
+    return out
+
+
+def serving_admission_bench(quick: bool = False) -> dict:
+    """Leg (c): 20x overload through the admission-guarded router —
+    the front door sheds with typed retry-after, accepted-work p99
+    stays under the SLO, and the serve-log audit shows every accepted
+    record served exactly once (trim is OFF on the guarded stream)."""
+    import collections
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.analysis.costmodel import resolve_peaks
+    from analytics_zoo_tpu.analysis.oracle import ConfigOracle
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue, \
+        OutputQueue, ServingRejected
+    from analytics_zoo_tpu.serving.fleet import _SyntheticModel
+    from analytics_zoo_tpu.serving.modelspec import ModelSpec
+    from analytics_zoo_tpu.serving.router import ModelRouter
+
+    service_ms = 10.0
+    slo_p99_ms = 500.0
+    light_rps, overload_rps = 12.5, 250.0  # the 20x overload
+    light_s = 2.0
+    overload_s = 4.0 if quick else 8.0
+
+    broker = InMemoryBroker()
+    oracle = ConfigOracle(peaks=resolve_peaks("cpu"))
+    serve_log = tempfile.NamedTemporaryFile(
+        prefix="zoo-admission-audit-", suffix=".log", delete=False)
+    serve_log.close()
+    router = ModelRouter(
+        broker,
+        [ModelSpec("gate", slo_p99_ms=slo_p99_ms,
+                   offered_rate=overload_rps)],
+        model_factory=lambda spec: _SyntheticModel(service_ms),
+        oracle=oracle,
+        features={"gate": _serving_features(service_ms, (8, 16))},
+        admission=True, max_replicas=2, interval=0.25,
+        serve_log=serve_log.name,
+        admission_kwargs={"backlog_limit": 20, "interval": 0.05})
+    t_enq = {}
+    lock = threading.Lock()
+    latencies = []
+    rejections = []
+    stop = threading.Event()
+    outq = OutputQueue(broker=broker)
+
+    def collector():
+        while not stop.is_set():
+            done = outq.dequeue()
+            now = time.perf_counter()
+            with lock:
+                for uri in done:
+                    if uri in t_enq:
+                        latencies.append(now - t_enq.pop(uri))
+            time.sleep(0.01)
+
+    accepted = []
+    router.start()
+    try:
+        threading.Thread(target=collector, daemon=True).start()
+        inq = InputQueue(broker=broker, model="gate")
+        rec = np.zeros((8,), np.float32)
+        seq = 0
+        phase_base = 0
+        for rate, duration in ((light_rps, light_s),
+                               (overload_rps, overload_s)):
+            t_phase = time.perf_counter()
+            while True:
+                elapsed = time.perf_counter() - t_phase
+                if elapsed >= duration:
+                    break
+                # rate-paced without per-record sleeps: catch the
+                # enqueue count up to the offered-rate schedule
+                due = phase_base + int(elapsed * rate)
+                while seq < due:
+                    uri = f"a{seq}"
+                    seq += 1
+                    try:
+                        with lock:
+                            t_enq[uri] = time.perf_counter()
+                        inq.enqueue(uri, rec)
+                        accepted.append(uri)
+                    except ServingRejected as e:
+                        with lock:
+                            t_enq.pop(uri, None)
+                        rejections.append(e.retry_after_s)
+                time.sleep(0.002)
+            phase_base = seq
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            with lock:
+                if not t_enq:
+                    break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        router.stop()
+
+    with open(serve_log.name) as f:
+        served_uris = [line.split()[-1] for line in f
+                       if line.strip()]
+    os.unlink(serve_log.name)
+    counts = collections.Counter(served_uris)
+    audit_ok = (set(counts) == set(accepted)
+                and all(c == 1 for c in counts.values()))
+    p99 = _p99(latencies)
+    return {
+        "service_ms_per_record": service_ms,
+        "slo_p99_ms": slo_p99_ms,
+        "overload": {"light_rps": light_rps,
+                     "overload_rps": overload_rps,
+                     "factor": overload_rps / light_rps},
+        "offered": len(accepted) + len(rejections),
+        "accepted": len(accepted),
+        "rejected": len(rejections),
+        "shed_fraction": round(
+            len(rejections) / max(len(accepted) + len(rejections), 1),
+            3),
+        "accepted_p99_ms": (None if p99 is None
+                            else round(p99 * 1e3, 1)),
+        "accepted_p99_under_slo": (p99 is not None
+                                   and p99 * 1e3 < slo_p99_ms),
+        "retry_after_s": {
+            "min": round(min(rejections), 3) if rejections else None,
+            "max": round(max(rejections), 3) if rejections else None,
+        },
+        "all_rejections_carry_retry_after": (
+            bool(rejections) and all(r > 0 for r in rejections)),
+        "served": len(latencies),
+        "audit_exactly_once": audit_ok,
+        "admission_decisions": (
+            router.admission("gate").decision_log()
+            if router.admission("gate") is not None else []),
+    }
+
+
+def serving_predict_bench(quick: bool = False,
+                          out_path: str | None = None) -> dict:
+    doc = {
+        "metric": "predictive_serving_primed_violations_and_admission",
+        "unit": "primed fleet violation windows (0 = SLO held through "
+                "the 10x step)",
+        "platform": "cpu",
+        "quick": bool(quick),
+        "primed_vs_reactive": serving_predict_primed_bench(quick=quick),
+        "multi_model": serving_multi_model_bench(quick=quick),
+        "admission": serving_admission_bench(quick=quick),
+    }
+    leg_a = doc["primed_vs_reactive"]
+    doc["value"] = leg_a["primed"]["violation_windows"]
+    doc["acceptance"] = {
+        "primed_no_worse_than_reactive": (
+            leg_a["primed"]["violation_windows"]
+            <= leg_a["reactive"]["violation_windows"]),
+        "both_model_slos_met": doc["multi_model"]["both_slos_met"],
+        "accepted_p99_under_slo":
+            doc["admission"]["accepted_p99_under_slo"],
+        "audit_exactly_once": doc["admission"]["audit_exactly_once"],
+    }
+    doc["host_fingerprint"] = host_fingerprint()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SERVE_r19.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    doc["artifact"] = out_path
+    return doc
+
+
+def _serving_predict_main(argv):
+    # control-plane bench: synthetic models, no mesh — plain CPU
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    kwargs = {}
+    if "--quick" in argv:
+        kwargs["quick"] = True
+    if "--out" in argv:
+        kwargs["out_path"] = argv[argv.index("--out") + 1]
+    print(json.dumps(serving_predict_bench(**kwargs)))
+
+
 if __name__ == "__main__":
     if "--partition" in sys.argv:
         _partition_main(sys.argv[1:])
@@ -3147,6 +3603,8 @@ if __name__ == "__main__":
         _elastic_main(sys.argv[1:])
     elif "--federated" in sys.argv:
         _federated_main(sys.argv[1:])
+    elif "--serving-predict" in sys.argv:
+        _serving_predict_main(sys.argv[1:])
     elif "--dispatch-child" in sys.argv:
         _dispatch_child_main(sys.argv[1:])
     elif "--dispatch" in sys.argv:

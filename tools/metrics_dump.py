@@ -35,7 +35,17 @@ fault-tolerance pair `zoo_fleet_lease_takeovers_total` /
 `zoo_fleet_replica_deaths_total`, the scaler's
 `zoo_fleet_est_p99_seconds` / `zoo_fleet_unclaimed_backlog` window
 signals, and `zoo_fleet_batch_flushes_total{reason}` from the
-continuous batcher), and `zoo_oracle` (the predictive compile plane,
+continuous batcher), `zoo_router` (the multi-tenant serving plane,
+serving/router.py: `zoo_router_models`,
+`zoo_router_decisions_total{model,action}` and the per-model
+`zoo_fleet_model_replicas` / `zoo_fleet_model_backlog` /
+`zoo_fleet_model_est_p99_seconds` gauges), `zoo_admission` (the
+front-door shedding plane, serving/admission.py:
+`zoo_admission_requests_total{model,verdict}`,
+`zoo_admission_state{model}`,
+`zoo_admission_retry_after_seconds{model}` and
+`zoo_admission_evaluations_total`), and `zoo_oracle` (the predictive
+compile plane,
 analysis/oracle.py: `zoo_oracle_predictions_total{consumer}`,
 `zoo_oracle_predicted_steps_per_sec{config}` /
 `zoo_oracle_measured_steps_per_sec{config}` /
@@ -60,8 +70,9 @@ counts, and the bytes loop
 against costmodel.kernel_bytes; the HLO side is
 `zoo_hlo_custom_kernels{label}` / `zoo_hlo_custom_kernel_bytes{label}`
 under the `zoo_hlo` family).  When the scraped ``/varz`` carries
-a structured decision log (``autotune`` / ``fleet`` / ``oracle`` /
-``elastic`` / ``scrape`` / ``slo`` sections), it is additionally
+a structured decision log (``autotune`` / ``fleet`` / ``router`` /
+``admission`` / ``oracle`` / ``elastic`` / ``scrape`` / ``slo``
+sections), it is additionally
 rendered as a table — time, knob/action, old → new, reason; predicted
 vs measured per config; per-target scrape health; firing SLO alerts
 with their short/long burn rates — above the metric rows.
@@ -209,6 +220,91 @@ def render_fleet(doc, prefix="", out=None):
                  f"{str(d['old']) + ' -> ' + str(d['new']):<11}"
                  f"{est:<11}{str(d.get('queue_depth', '-')):<7}"
                  f"{d['reason']}")
+
+
+def render_router(doc, prefix="", out=None):
+    """Router panel for the ``router`` section a live ``/varz`` carries
+    when a ModelRouter ran (serving/router.py): each router's per-model
+    state (stream, replicas, backlog, the oracle verdict's pad buckets
+    and batch budget, the admission verdict), then one row per
+    prime/scale/stop decision.  Skipped when the snapshot has no router
+    section or ``--prefix`` filters it out."""
+    import datetime
+
+    router = doc.get("router")
+    if not router or (prefix and not "zoo_router".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for r in router.get("routers", []):
+        cur = r.get("current", {})
+        emit("\nrouter: admission={admission} mode={mode}".format(
+            **{k: cur.get(k) for k in ("admission", "mode")}))
+        models = cur.get("models", {})
+        if models:
+            emit(f"  {'model':<12}{'replicas':>9}{'backlog':>9}"
+                 f"{'slo_p99':>9}{'buckets':<16}{'budget':>9}  admission")
+            for name in sorted(models):
+                m = models[name]
+                verdict = m.get("verdict") or {}
+                adm = m.get("admission") or {}
+                buckets = verdict.get("pad_buckets")
+                budget = verdict.get("batch_budget_ms")
+                emit(f"  {name:<12}{m.get('replicas', 0):>9}"
+                     f"{m.get('backlog', 0):>9}"
+                     f"{m.get('spec', {}).get('slo_p99_ms', 0):>8g}m"
+                     f" {str(buckets or '-'):<15}"
+                     f"{('-' if budget is None else f'{budget:.1f}ms'):>9}"
+                     f"  {adm.get('state', '-')}")
+    decisions = router.get("decisions", [])
+    if decisions:
+        emit(f"\n  {'time':<14}{'model':<12}{'action':<8}detail")
+        for d in decisions:
+            t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            if d.get("action") == "scale":
+                detail = (f"{d.get('old')} -> {d.get('new')} "
+                          f"backlog={d.get('backlog')}")
+            else:
+                detail = (f"replicas={d.get('replicas')} "
+                          f"buckets={d.get('pad_buckets')} "
+                          f"budget={d.get('batch_budget_ms')}")
+            emit(f"  {t:<14}{d.get('model', '?'):<12}"
+                 f"{d.get('action', '?'):<8}{detail}")
+
+
+def render_admission(doc, prefix="", out=None):
+    """Admission panel for the ``admission`` section a live ``/varz``
+    carries when an AdmissionController ran (serving/admission.py):
+    each controller's current verdict (state, reason, retry-after, the
+    observed drain rate), then one row per accept/shed transition.
+    Skipped when the snapshot has no admission section or ``--prefix``
+    filters it out."""
+    import datetime
+
+    admission = doc.get("admission")
+    if not admission or (prefix
+                         and not "zoo_admission".startswith(prefix)):
+        return
+    emit = print if out is None else (lambda s: out.append(s))
+    for ctl in admission.get("controllers", []):
+        cur = ctl.get("current", {})
+        emit("\nadmission: model={model} stream={stream} state={state} "
+             "retry_after={retry_after_ms}ms backlog_limit="
+             "{backlog_limit} drain={drain_rate}/s".format(
+                 **{k: cur.get(k) for k in
+                    ("model", "stream", "state", "retry_after_ms",
+                     "backlog_limit", "drain_rate")}))
+    decisions = admission.get("decisions", [])
+    if decisions:
+        emit(f"\n  {'time':<14}{'model':<12}{'state':<8}"
+             f"{'retry_after':>12}{'backlog':>9}  reason")
+        for d in decisions:
+            t = datetime.datetime.fromtimestamp(d["ts"]).strftime(
+                "%H:%M:%S.%f")[:-3]
+            emit(f"  {t:<14}{d.get('model', '?'):<12}"
+                 f"{d.get('state', '?'):<8}"
+                 f"{d.get('retry_after_ms', 0):>10.0f}ms"
+                 f"{d.get('backlog', 0):>9}  {d.get('reason', '')}")
 
 
 def render_oracle(doc, prefix="", out=None):
@@ -472,6 +568,8 @@ def render(docs, a):
     print(f"# {src}: {len(docs)} snapshot(s), window {dt:.1f}s")
     render_autotune(last, prefix=a.prefix)
     render_fleet(last, prefix=a.prefix)
+    render_router(last, prefix=a.prefix)
+    render_admission(last, prefix=a.prefix)
     render_oracle(last, prefix=a.prefix)
     render_elastic(last, prefix=a.prefix)
     render_scrape(last, prefix=a.prefix)
